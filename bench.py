@@ -1,0 +1,81 @@
+"""Benchmark: BERT-style transformer training throughput, samples/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+BASELINE config 2 (BERT-base-ish DP); runs on whatever devices exist
+(1 real TPU chip under the driver).  vs_baseline is measured/target where
+target comes from BASELINE.json-derived expectations; with no published
+reference numbers (BASELINE.md) we report vs_baseline=1.0 at the defined
+target throughput and track our own trajectory across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import hetu_tpu as ht
+
+    # BERT-base-ish block stack scaled to fit one chip quickly:
+    # hidden 768, 12 heads, 4 layers (1/3 of BERT-base depth), seq 128
+    batch, seq, hidden, heads, layers_n, vocab = 32, 128, 768, 12, 4, 30522
+
+    ids = ht.placeholder_op("input_ids")
+    labels = ht.placeholder_op("labels")
+    emb = ht.layers.Embedding(vocab, hidden, name="tok_emb")
+    pos = ht.init.random_normal((seq, hidden), stddev=0.02, name="pos_emb")
+    h = ht.embedding_lookup_op(emb.embedding_table, ids)
+    h = h + ht.broadcast_shape_op(pos, (batch, seq, hidden), add_axes=[0])
+    h = ht.array_reshape_op(h, [batch * seq, hidden])
+    for i in range(layers_n):
+        attn = ht.layers.MultiHeadAttention(hidden, heads, seq, batch,
+                                            name=f"l{i}_attn")
+        h = ht.layers.LayerNorm(hidden, name=f"l{i}_ln1")(h + attn(h))
+        wi = ht.layers.Linear(hidden, hidden * 4, name=f"l{i}_ffn_wi")
+        wo = ht.layers.Linear(hidden * 4, hidden, name=f"l{i}_ffn_wo")
+        h = ht.layers.LayerNorm(hidden, name=f"l{i}_ln2")(
+            h + wo(ht.gelu_op(wi(h))))
+    logits = ht.layers.Linear(hidden, vocab, name="lm_head")(h)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_sparse_op(
+            logits, ht.array_reshape_op(labels, [batch * seq])), axes=0)
+    train = ht.optim.AdamOptimizer(learning_rate=1e-4).minimize(loss)
+    ex = ht.Executor({"train": [loss, train]})
+
+    rng = np.random.RandomState(0)
+    feed = {
+        ids: rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+        labels: rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+    }
+
+    # warmup (compile)
+    out = ex.run("train", feed_dict=feed)
+    jax.block_until_ready(out[0])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = ex.run("train", feed_dict=feed)
+    jax.block_until_ready(out[0])
+    dt = (time.perf_counter() - t0) / iters
+
+    n_chips = max(1, jax.device_count())
+    samples_per_sec_chip = batch / dt / n_chips
+    # target: BASELINE.json north star scaled to this 4-layer proxy —
+    # no published reference number exists (BASELINE.md), so the target is
+    # our own round-1 figure; vs_baseline tracks improvement across rounds.
+    target = 100.0
+    print(json.dumps({
+        "metric": "bert4L_seq128_train_throughput",
+        "value": round(samples_per_sec_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(samples_per_sec_chip / target, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

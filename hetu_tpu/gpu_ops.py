@@ -1,0 +1,84 @@
+"""Runtime bring-up compatibility shims.
+
+Reference exports (gpu_ops/__init__.py:118-296 "Executor/runtime" group):
+``wrapped_mpi_nccl_init``, ``new_group_comm``, ``worker_init`` etc. — the
+MPI/NCCL/PS process bootstrap (executor.py:60-105).
+
+On TPU: `jax.distributed.initialize()` replaces MPI+NCCL bootstrap; mesh
+axes replace communicator groups; the PS roles map to hetu_tpu.ps server
+processes.  These functions keep reference scripts runnable.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_worker_comm = None
+
+
+def wrapped_mpi_nccl_init(init_nccl=True, devices=None):
+    """Multi-host bring-up (reference executor.py:60-71).  Under a single
+    process this is a no-op returning a handle exposing rank info."""
+    import os
+
+    class _Comm:
+        def __init__(self):
+            self.rank = jax.process_index()
+            self.nrank = jax.process_count()
+            self.local_rank = 0
+            self.dev_id = 0
+
+        def ncclCommInitRank(self):
+            pass
+
+    if os.environ.get("HETU_TPU_COORDINATOR"):
+        jax.distributed.initialize(
+            coordinator_address=os.environ["HETU_TPU_COORDINATOR"],
+            num_processes=int(os.environ.get("HETU_TPU_NUM_PROCS", "1")),
+            process_id=int(os.environ.get("HETU_TPU_PROC_ID", "0")))
+    return _Comm()
+
+
+def new_group_comm(device_group=None):
+    """Sub-communicator creation (mpi_nccl_comm.py:164-250) — on TPU a
+    mesh-axis name stands in for a communicator; nothing to allocate."""
+    return device_group
+
+
+def get_worker_communicate():
+    global _worker_comm
+    if _worker_comm is None:
+        from .ps.client import PSClient
+        _worker_comm = PSClient.get()
+    return _worker_comm
+
+
+def worker_init():
+    from .ps.client import PSClient
+    global _worker_comm
+    _worker_comm = PSClient.get()
+
+
+def worker_finish():
+    global _worker_comm
+    if _worker_comm is not None:
+        _worker_comm.finalize()
+        _worker_comm = None
+
+
+def server_init():
+    from .ps.server import PSServer
+    PSServer.serve_from_env()
+
+
+def server_finish():
+    pass
+
+
+def scheduler_init():
+    from .ps.server import Scheduler
+    Scheduler.serve_from_env()
+
+
+def scheduler_finish():
+    pass

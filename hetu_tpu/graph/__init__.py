@@ -1,0 +1,54 @@
+"""Graph IR: op node base + the full op-factory surface.
+
+The factory names mirror the reference's gpu_ops/__init__.py exports
+(Appendix A of SURVEY.md) so reference example scripts map 1:1.
+"""
+
+from .node import Op, SimpleOp, TraceContext
+from .autodiff import gradients, find_topo_sort, sum_node_list
+from .ops_misc import (
+    PlaceholderOp, Variable, placeholder_op, VJPOp, SumOp, sum_op,
+    OnesLikeOp, ZerosLikeOp, oneslike_op, zeroslike_op, full_op,
+    full_like_op, arange_op, rand_op,
+)
+from .ops_math import (
+    add_op, minus_op, mul_op, div_op, addbyconst_op, minus_byconst_op,
+    mul_byconst_op, div_const_op, opposite_op, abs_op, abs_gradient_op,
+    exp_op, log_op, log_grad_op, pow_op, pow_gradient_op, const_pow_op,
+    const_pow_gradient_op, sqrt_op, rsqrt_op, sin_op, cos_op, floor_op,
+    ceil_op, clamp_op, bool_op, where_op, where_const_op, masked_fill_op,
+    sign_op, max_op, min_op, relu_op, relu_gradient_op, leaky_relu_op,
+    leaky_relu_gradient_op, gelu_op, gelu_gradient_op, sigmoid_op, tanh_op,
+    tanh_gradient_op, softmax_op, softmax_gradient_op, softmax_func,
+    log_softmax_op,
+)
+from .ops_matmul import (
+    matmul_op, linear_op, batch_matmul_op, baddbmm_op, addmm_op,
+    addmm_gradient_op, matrix_dot_op, outer_op, csrmv_op, csrmm_op,
+)
+from .ops_conv import (
+    conv2d_op, conv2d_add_bias_op, conv2d_broadcastto_op,
+    conv2d_reducesum_op, max_pool2d_op, avg_pool2d_op,
+    batch_normalization_op, layer_normalization_op,
+    instance_normalization2d_op, dropout_op, dropout2d_op, BatchNormOp,
+    DropoutOp,
+)
+from .ops_shape import (
+    broadcast_reduce_op, broadcastto_op, broadcast_shape_op, reduce_sum_op,
+    reduce_mean_op, reducesumaxiszero_op, reduce_min_op, reduce_norm1_op,
+    reduce_norm2_op, norm_op, array_reshape_op, transpose_op, slice_op,
+    slice_assign_op, slice_assign_matrix_op, slice_by_matrix_op, split_op,
+    concat_op, concatenate_op, pad_op, flatten_op, tile_op, repeat_op,
+    roll_op, interpolate_op, gather_op, scatter_op, scatter1d_op,
+    indexing_op, one_hot_op, argmax_op, argsort_op, argmax_partial_op,
+    cumsum_with_bias_op, cumsum_op, topk_idx_op, topk_val_op, min_dist_op,
+)
+from .ops_loss import (
+    softmaxcrossentropy_op, softmaxcrossentropy_sparse_op, crossentropy_op,
+    crossentropy_sparse_op, binarycrossentropy_op,
+    binarycrossentropywithlogits_op, nll_loss_op, mseloss_op,
+)
+from .ops_embed import (
+    EmbeddingLookupOp, embedding_lookup_op, IndexedSlicesOp,
+    unique_indices_op,
+)

@@ -1,0 +1,99 @@
+"""Reverse-mode autodiff over the dataflow graph.
+
+Mirrors the reference ``gradients()`` (gpu_ops/executor.py:1071-1189):
+reverse topo walk, per-node ``gradient()`` building backward nodes, partial
+adjoints merged with SumOp (executor.py:1393 sum_node_list).  Sparse
+(IndexedSlices) adjoints pass through un-merged when single, densified when
+summed — same policy as executor.py:1119-1127.
+
+The backward2forward / forward2backward maps are preserved because the
+pipeline partitioner uses them (reference: gpipe partition at
+pipeline_subexecutor.py:29-81).
+"""
+
+from __future__ import annotations
+
+from .node import Op
+from .ops_misc import OnesLikeOp, SumOp, PlaceholderOp
+from .ops_embed import IndexedSlicesOp
+
+
+def find_topo_sort(node_list):
+    visited = set()
+    topo = []
+
+    def dfs(n):
+        if id(n) in visited:
+            return
+        visited.add(id(n))
+        for i in n.inputs:
+            dfs(i)
+        topo.append(n)
+
+    for n in node_list:
+        dfs(n)
+    return topo
+
+
+def sum_node_list(node_list):
+    node_list = [n for n in node_list if n is not None]
+    if not node_list:
+        return None
+    if len(node_list) == 1:
+        return node_list[0]
+    return SumOp(node_list)
+
+
+def gradients(output_node, node_list, insert_grad=None, return_all=False):
+    """Build gradient nodes of ``output_node`` w.r.t. each node in
+    ``node_list``.  ``insert_grad`` seeds a custom output adjoint
+    (reference executor.py:1071 signature parity)."""
+    if insert_grad is None:
+        insert_grad = OnesLikeOp(output_node)
+    node_to_grads = {id(output_node): [insert_grad]}
+    node_to_grad = {}
+    key_to_node = {id(output_node): output_node}
+
+    reverse_topo = list(reversed(find_topo_sort([output_node])))
+    backward2forward = {}
+    forward2backward = {}
+
+    for node in reverse_topo:
+        grads = node_to_grads.get(id(node))
+        if grads is None:
+            continue
+        # merge partial adjoints; keep sparse adjoints sparse when single
+        grad = sum_node_list(grads)
+        if grad is None:
+            continue
+        node_to_grad[id(node)] = grad
+        key_to_node[id(node)] = node
+        if isinstance(node, PlaceholderOp):
+            continue
+        if isinstance(node, (OnesLikeOp,)):
+            continue
+        try:
+            input_grads = node.gradient(grad)
+        except NotImplementedError:
+            from .node import vjp_gradient
+            input_grads = vjp_gradient(node, grad)
+        if input_grads is None:
+            continue
+        assert len(input_grads) == len(node.inputs), (
+            f"{node}: gradient returned {len(input_grads)} for "
+            f"{len(node.inputs)} inputs")
+        forward2backward[node] = [g for g in input_grads if g is not None]
+        for inp, g in zip(node.inputs, input_grads):
+            if g is None:
+                continue
+            backward2forward[g] = (node, inp)
+            node_to_grads.setdefault(id(inp), []).append(g)
+
+    results = []
+    for n in node_list:
+        g = node_to_grad.get(id(n))
+        assert g is not None, f"no gradient path from output to {n}"
+        results.append(g)
+    if return_all:
+        return results, backward2forward, forward2backward
+    return results

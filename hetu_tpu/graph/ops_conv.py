@@ -1,0 +1,198 @@
+"""Conv / pooling / normalization / dropout op factories.
+
+Reference: gpu_ops/Conv2d*.py, MaxPool.py, AvgPool.py, BatchNorm.py,
+LayerNorm.py, InstanceNorm2d.py, Dropout.py (cuDNN kernels in
+src/ops/Cudnn*.cu).  Layout is NCHW / OIHW to match the reference API; XLA
+re-lays-out internally for the MXU so this costs nothing.
+
+BatchNorm running stats are *graph state*: the op owns hidden non-trainable
+state variables threaded through the jitted step by the executor (the
+reference mutates kernel-side buffers instead, src/ops/CudnnBn.cu).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .node import Op, TraceContext
+from .ops_math import _simple
+from .ops_misc import PlaceholderOp
+
+
+_DIMNUMS = ("NCHW", "OIHW", "NCHW")
+
+
+def conv2d_op(a, w, stride=1, padding=0, ctx=None):
+    if not isinstance(stride, (list, tuple)):
+        stride = (stride, stride)
+    if not isinstance(padding, (list, tuple)):
+        padding = (padding, padding)
+
+    def f(x, k):
+        return jax.lax.conv_general_dilated(
+            x, k, window_strides=tuple(stride),
+            padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+            dimension_numbers=_DIMNUMS,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+    return _simple("Conv2d", f, a, w, ctx=ctx)
+
+
+def conv2d_add_bias_op(a, w, bias, stride=1, padding=0, ctx=None):
+    if not isinstance(stride, (list, tuple)):
+        stride = (stride, stride)
+    if not isinstance(padding, (list, tuple)):
+        padding = (padding, padding)
+
+    def f(x, k, b):
+        y = jax.lax.conv_general_dilated(
+            x, k, window_strides=tuple(stride),
+            padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+            dimension_numbers=_DIMNUMS,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        return y + b.reshape(1, -1, 1, 1)
+    return _simple("Conv2dAddBias", f, a, w, bias, ctx=ctx)
+
+
+def conv2d_broadcastto_op(bias, target, ctx=None):
+    """(C,) -> (N,C,H,W) broadcast (reference gpu_ops/Conv2dBroadcast.py)."""
+    return _simple("Conv2dBroadcastTo",
+                   lambda b, t: jnp.broadcast_to(b.reshape(1, -1, 1, 1), t.shape),
+                   bias, target, ctx=ctx)
+
+
+def conv2d_reducesum_op(a, ctx=None):
+    """Sum over N,H,W — bias gradient (reference gpu_ops/Conv2dReduceSum.py)."""
+    return _simple("Conv2dReduceSum", lambda x: jnp.sum(x, axis=(0, 2, 3)), a,
+                   ctx=ctx)
+
+
+def max_pool2d_op(a, kernel_H, kernel_W, padding=0, stride=1, ctx=None):
+    if not isinstance(stride, (list, tuple)):
+        stride = (stride, stride)
+    if not isinstance(padding, (list, tuple)):
+        padding = (padding, padding)
+
+    def f(x):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 1, kernel_H, kernel_W),
+            window_strides=(1, 1) + tuple(stride),
+            padding=((0, 0), (0, 0),
+                     (padding[0], padding[0]), (padding[1], padding[1])))
+    return _simple("MaxPool2d", f, a, ctx=ctx)
+
+
+def avg_pool2d_op(a, kernel_H, kernel_W, padding=0, stride=1, ctx=None):
+    if not isinstance(stride, (list, tuple)):
+        stride = (stride, stride)
+    if not isinstance(padding, (list, tuple)):
+        padding = (padding, padding)
+
+    def f(x):
+        s = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, kernel_H, kernel_W),
+            window_strides=(1, 1) + tuple(stride),
+            padding=((0, 0), (0, 0),
+                     (padding[0], padding[0]), (padding[1], padding[1])))
+        return s / (kernel_H * kernel_W)
+    return _simple("AvgPool2d", f, a, ctx=ctx)
+
+
+class BatchNormOp(Op):
+    """BatchNorm over NCHW with running-stat state variables.
+
+    Reference gpu_ops/BatchNorm.py (momentum/eps defaults match
+    batch_normalization_op(x, scale, bias, momentum=0.99, eps=0.01); the
+    ResNet example passes momentum=0.9, eps=1e-5).  Train mode uses batch
+    stats and updates running stats; eval mode uses running stats.
+    """
+
+    def __init__(self, x, scale, bias, momentum=0.99, eps=0.01, ctx=None):
+        super().__init__(x, scale, bias, name="BatchNorm", ctx=ctx)
+        self.momentum = momentum
+        self.eps = eps
+        c = scale.shape[0] if scale.shape else None
+        self.running_mean = PlaceholderOp(
+            f"{self.name}_running_mean",
+            value=jnp.zeros((c,)) if c else None, trainable=False)
+        self.running_var = PlaceholderOp(
+            f"{self.name}_running_var",
+            value=jnp.ones((c,)) if c else None, trainable=False)
+        self.state_vars = [self.running_mean, self.running_var]
+
+    def compute(self, input_vals, tc: TraceContext):
+        x, scale, bias = input_vals
+        rm = tc.params[self.running_mean]
+        rv = tc.params[self.running_var]
+        if tc.training:
+            axes = (0, 2, 3) if x.ndim == 4 else (0,)
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            m = self.momentum
+            tc.extra_outputs[self.running_mean] = m * rm + (1 - m) * mean
+            tc.extra_outputs[self.running_var] = m * rv + (1 - m) * var
+        else:
+            mean, var = rm, rv
+        shape = (1, -1, 1, 1) if x.ndim == 4 else (1, -1)
+        inv = jax.lax.rsqrt(var.reshape(shape) + self.eps)
+        return (x - mean.reshape(shape)) * inv * scale.reshape(shape) \
+            + bias.reshape(shape)
+
+    def gradient(self, output_grad):
+        from .node import vjp_gradient
+        return vjp_gradient(self, output_grad)
+
+
+def batch_normalization_op(x, scale, bias, momentum=0.99, eps=0.01, ctx=None):
+    return BatchNormOp(x, scale, bias, momentum, eps, ctx=ctx)
+
+
+def layer_normalization_op(x, scale, bias, eps=0.01, ctx=None):
+    """LayerNorm over the last dim (reference gpu_ops/LayerNorm.py)."""
+    def f(a, s, b):
+        mean = jnp.mean(a, axis=-1, keepdims=True)
+        var = jnp.var(a, axis=-1, keepdims=True)
+        return (a - mean) * jax.lax.rsqrt(var + eps) * s + b
+    return _simple("LayerNorm", f, x, scale, bias, ctx=ctx)
+
+
+def instance_normalization2d_op(x, eps=1e-7, ctx=None):
+    def f(a):
+        mean = jnp.mean(a, axis=(2, 3), keepdims=True)
+        var = jnp.var(a, axis=(2, 3), keepdims=True)
+        return (a - mean) * jax.lax.rsqrt(var + eps)
+    return _simple("InstanceNorm2d", f, x, ctx=ctx)
+
+
+class DropoutOp(Op):
+    """Inverted dropout with per-step RNG from the trace context; identity
+    in eval mode (reference gpu_ops/Dropout.py keeps a seed per op —
+    here the key is fold_in(step_key, node.id), so backward recomputation
+    inside VJP sees the identical mask)."""
+
+    def __init__(self, x, keep_prob, spatial=False, ctx=None):
+        super().__init__(x, name="Dropout", ctx=ctx)
+        self.keep_prob = keep_prob
+        self.spatial = spatial
+
+    def compute(self, input_vals, tc: TraceContext):
+        (x,) = input_vals
+        if not tc.training or self.keep_prob >= 1.0:
+            return x
+        shape = (x.shape[0], x.shape[1], 1, 1) if self.spatial else x.shape
+        mask = jax.random.bernoulli(tc.rng_for(self), self.keep_prob, shape)
+        return jnp.where(mask, x / self.keep_prob, 0.0).astype(x.dtype)
+
+    def gradient(self, output_grad):
+        from .node import vjp_gradient
+        return vjp_gradient(self, output_grad)
+
+
+def dropout_op(x, keep_prob, ctx=None):
+    return DropoutOp(x, keep_prob, ctx=ctx)
+
+
+def dropout2d_op(x, keep_prob, ctx=None):
+    return DropoutOp(x, keep_prob, spatial=True, ctx=ctx)

@@ -1,0 +1,76 @@
+"""Embedding lookup with IndexedSlices-style sparse gradients.
+
+Reference: gpu_ops/EmbeddingLookUp.py + src/ops/EmbeddingLookup.cu;
+IndexedSlices dedup/to-dense in python/hetu/ndarray.py:507-606 and
+src/ops/IndexedSlices.cu.  Here the sparse adjoint is a graph-level
+``IndexedSlicesOp`` carrying (ids, rows); the optimizer consumes it with a
+row-wise scatter update (XLA scatter-add), never materializing the dense
+vocab-sized gradient.  ``to_dense`` exists for the generic path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .node import Op, TraceContext
+from .ops_math import _simple
+
+
+class EmbeddingLookupOp(Op):
+    def __init__(self, table, ids, ctx=None):
+        super().__init__(table, ids, name="EmbeddingLookup", ctx=ctx)
+        table.is_embed = True
+
+    def jax_fn(self, table, ids):
+        return jnp.take(table, ids.astype(jnp.int32), axis=0)
+
+    def gradient(self, output_grad):
+        return [IndexedSlicesOp(self.inputs[0], self.inputs[1], output_grad),
+                None]
+
+
+def embedding_lookup_op(table, ids, ctx=None):
+    return EmbeddingLookupOp(table, ids, ctx=ctx)
+
+
+class IndexedSlicesOp(Op):
+    """Sparse adjoint of an embedding table: rows ``values`` at ``ids``.
+
+    When *evaluated* it densifies (scatter-add into a zero table) — but the
+    optimizer recognizes the node type and instead applies a row-sparse
+    update, mirroring the reference's IndexedSlices path
+    (optimizer.py sparse updates + src/ops/OptimizersSparse.cu).
+    """
+
+    sparse = True
+
+    def __init__(self, table, ids, values, ctx=None):
+        super().__init__(table, ids, values, name="IndexedSlices", ctx=ctx)
+
+    @property
+    def ids_node(self):
+        return self.inputs[1]
+
+    @property
+    def values_node(self):
+        return self.inputs[2]
+
+    def jax_fn(self, table, ids, values):
+        ids = ids.astype(jnp.int32).reshape(-1)
+        vals = values.reshape(-1, values.shape[-1])
+        return jnp.zeros_like(table).at[ids].add(vals)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+
+def unique_indices_op(ids, ctx=None):
+    """Deduplicated indices padded with -1 (reference ndarray.py deduplicate).
+    Static output shape = input shape (worst case all-unique)."""
+    def f(i):
+        flat = i.astype(jnp.int32).reshape(-1)
+        uniq, _ = jnp.unique(flat, size=flat.shape[0], fill_value=-1,
+                             return_index=True)
+        return uniq.astype(jnp.float32)
+    return _simple("UniqueIndices", f, ids, nondiff=True, ctx=ctx)

@@ -1,0 +1,101 @@
+"""Loss op factories.
+
+Reference: gpu_ops/SoftmaxCrossEntropy.py, SoftmaxCrossEntropySparse.py,
+CrossEntropy.py, CrossEntropySparse.py, BinaryCrossEntropy.py, NllLoss.py
+(kernels src/ops/SoftmaxCrossEntropy.cu etc.).  Reference ops return the
+per-example loss vector (reduction happens via reduce_mean in user code),
+and we preserve that.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops_math import _simple
+
+
+def softmaxcrossentropy_op(a, labels, ctx=None):
+    """One-hot labels; returns per-example loss (N,)."""
+    def f(x, y):
+        lse = jax.nn.log_softmax(x, axis=-1)
+        return -jnp.sum(y * lse, axis=-1)
+    return _simple("SoftmaxCrossEntropy", f, a, labels,
+                   grad_rule=lambda n, g: _sce_grad(n, g), ctx=ctx)
+
+
+def _sce_grad(node, g):
+    x, y = node.inputs
+
+    def f(gr, xx, yy):
+        p = jax.nn.softmax(xx, axis=-1)
+        return gr[..., None] * (p - yy)
+    return [_simple("SoftmaxCrossEntropyGrad", f, g, x, y), None]
+
+
+def softmaxcrossentropy_sparse_op(a, labels, ignored_index=-1, ctx=None):
+    """Integer labels; entries equal to ignored_index contribute 0."""
+    def f(x, y):
+        y = y.astype(jnp.int32)
+        lse = jax.nn.log_softmax(x, axis=-1)
+        safe = jnp.where(y == ignored_index, 0, y)
+        ll = jnp.take_along_axis(lse, safe[..., None], axis=-1)[..., 0]
+        return jnp.where(y == ignored_index, 0.0, -ll)
+    return _simple("SoftmaxCrossEntropySparse", f, a, labels,
+                   grad_rule=lambda n, g: _sce_sparse_grad(n, g, ignored_index),
+                   ctx=ctx)
+
+
+def _sce_sparse_grad(node, g, ignored_index):
+    x, y = node.inputs
+
+    def f(gr, xx, yy):
+        yy = yy.astype(jnp.int32)
+        p = jax.nn.softmax(xx, axis=-1)
+        onehot = jax.nn.one_hot(jnp.where(yy == ignored_index, 0, yy),
+                                xx.shape[-1], dtype=xx.dtype)
+        grad = gr[..., None] * (p - onehot)
+        return jnp.where((yy == ignored_index)[..., None], 0.0, grad)
+    return [_simple("SoftmaxCrossEntropySparseGrad", f, g, x, y), None]
+
+
+def crossentropy_op(probs, labels, ctx=None):
+    """-sum(y * log p) given probabilities (reference CrossEntropy.py)."""
+    def f(p, y):
+        return -jnp.sum(y * jnp.log(jnp.maximum(p, 1e-12)), axis=-1)
+    return _simple("CrossEntropy", f, probs, labels, ctx=ctx)
+
+
+def crossentropy_sparse_op(probs, labels, ignored_index=-1, ctx=None):
+    def f(p, y):
+        y = y.astype(jnp.int32)
+        safe = jnp.where(y == ignored_index, 0, y)
+        pl = jnp.take_along_axis(p, safe[..., None], axis=-1)[..., 0]
+        loss = -jnp.log(jnp.maximum(pl, 1e-12))
+        return jnp.where(y == ignored_index, 0.0, loss)
+    return _simple("CrossEntropySparse", f, probs, labels, ctx=ctx)
+
+
+def binarycrossentropy_op(preds, labels, ctx=None):
+    def f(p, y):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        return -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+    return _simple("BinaryCrossEntropy", f, preds, labels, ctx=ctx)
+
+
+def binarycrossentropywithlogits_op(logits, labels, ctx=None):
+    def f(z, y):
+        return jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return _simple("BCEWithLogits", f, logits, labels, ctx=ctx)
+
+
+def nll_loss_op(log_probs, labels, ctx=None):
+    def f(lp, y):
+        y = y.astype(jnp.int32)
+        return -jnp.take_along_axis(lp, y[..., None], axis=-1)[..., 0]
+    return _simple("NllLoss", f, log_probs, labels, ctx=ctx)
+
+
+def mseloss_op(preds, labels, ctx=None):
+    return _simple("MSELoss", lambda p, y: jnp.mean((p - y) ** 2), preds, labels,
+                   ctx=ctx)

@@ -1,0 +1,272 @@
+"""Elementwise math + activation op factories.
+
+Covers the reference's arithmetic/activation op surface
+(gpu_ops/__init__.py exports; kernels in src/ops/*.cu): every op is a thin
+jnp/lax composition — XLA fuses chains of these into single kernels, which
+replaces the reference's per-op CUDA kernel launches.  Gradients come from
+the generic VJP fallback unless a rule is attached.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .node import Op, SimpleOp, TraceContext
+
+
+def _simple(name, fn, *inputs, grad_rule=None, nondiff=False, ctx=None):
+    op = SimpleOp(fn, *inputs, name=name, grad_rule=grad_rule, ctx=ctx)
+    if nondiff:
+        op.gradient = lambda output_grad: [None] * len(op.inputs)
+    return op
+
+
+def _bb(g, x):
+    """Reduce a broadcasted adjoint back to x's shape (numpy-style rules)."""
+    from .ops_shape import broadcast_reduce_op
+    return broadcast_reduce_op(g, x)
+
+
+# ----------------------------------------------------------------------- #
+# binary arithmetic (broadcasting like the reference's elementwise kernels)
+# ----------------------------------------------------------------------- #
+
+def add_op(a, b, ctx=None):
+    return _simple("Add", lambda x, y: x + y, a, b,
+                   grad_rule=lambda n, g: [_bb(g, n.inputs[0]), _bb(g, n.inputs[1])],
+                   ctx=ctx)
+
+
+def minus_op(a, b, ctx=None):
+    return _simple("Minus", lambda x, y: x - y, a, b,
+                   grad_rule=lambda n, g: [_bb(g, n.inputs[0]),
+                                           _bb(opposite_op(g), n.inputs[1])],
+                   ctx=ctx)
+
+
+def mul_op(a, b, ctx=None):
+    return _simple("Mul", lambda x, y: x * y, a, b,
+                   grad_rule=lambda n, g: [_bb(mul_op(g, n.inputs[1]), n.inputs[0]),
+                                           _bb(mul_op(g, n.inputs[0]), n.inputs[1])],
+                   ctx=ctx)
+
+
+def div_op(a, b, ctx=None):
+    return _simple("Div", lambda x, y: x / y, a, b, ctx=ctx)
+
+
+def addbyconst_op(a, c, ctx=None):
+    return _simple("AddConst", lambda x: x + c, a,
+                   grad_rule=lambda n, g: [g], ctx=ctx)
+
+
+def minus_byconst_op(c, a, ctx=None):
+    """const - node (reference gpu_ops/MinusByConst.py)."""
+    return _simple("MinusByConst", lambda x: c - x, a,
+                   grad_rule=lambda n, g: [opposite_op(g)], ctx=ctx)
+
+
+def mul_byconst_op(a, c, ctx=None):
+    return _simple("MulConst", lambda x: x * c, a,
+                   grad_rule=lambda n, g: [mul_byconst_op(g, c)], ctx=ctx)
+
+
+def div_const_op(c, a, ctx=None):
+    """const / node (reference gpu_ops/Division.py div_const_op)."""
+    return _simple("DivConst", lambda x: c / x, a, ctx=ctx)
+
+
+def opposite_op(a, ctx=None):
+    return _simple("Opposite", lambda x: -x, a,
+                   grad_rule=lambda n, g: [opposite_op(g)], ctx=ctx)
+
+
+# ----------------------------------------------------------------------- #
+# unary math
+# ----------------------------------------------------------------------- #
+
+def abs_op(a, ctx=None):
+    return _simple("Abs", jnp.abs, a, ctx=ctx)
+
+
+def abs_gradient_op(grad, a, ctx=None):
+    return _simple("AbsGrad", lambda g, x: g * jnp.sign(x), grad, a, ctx=ctx)
+
+
+def exp_op(a, ctx=None):
+    return _simple("Exp", jnp.exp, a, ctx=ctx)
+
+
+def log_op(a, eps=0.0, ctx=None):
+    return _simple("Log", lambda x: jnp.log(x + eps) if eps else jnp.log(x), a, ctx=ctx)
+
+
+def log_grad_op(grad, a, ctx=None):
+    return _simple("LogGrad", lambda g, x: g / x, grad, a, ctx=ctx)
+
+
+def pow_op(a, p, ctx=None):
+    return _simple("Pow", lambda x: jnp.power(x, p), a, ctx=ctx)
+
+
+def pow_gradient_op(grad, a, p, ctx=None):
+    return _simple("PowGrad", lambda g, x: g * p * jnp.power(x, p - 1), grad, a, ctx=ctx)
+
+
+def const_pow_op(c, a, ctx=None):
+    return _simple("ConstPow", lambda x: jnp.power(c, x), a, ctx=ctx)
+
+
+def const_pow_gradient_op(grad, a, c, ctx=None):
+    import math
+    return _simple("ConstPowGrad",
+                   lambda g, x: g * jnp.power(c, x) * math.log(c), grad, a, ctx=ctx)
+
+
+def sqrt_op(a, ctx=None):
+    return _simple("Sqrt", jnp.sqrt, a, ctx=ctx)
+
+
+def rsqrt_op(a, ctx=None):
+    return _simple("ReciprocalSqrt", jax.lax.rsqrt, a, ctx=ctx)
+
+
+def sin_op(a, ctx=None):
+    return _simple("Sin", jnp.sin, a, ctx=ctx)
+
+
+def cos_op(a, ctx=None):
+    return _simple("Cos", jnp.cos, a, ctx=ctx)
+
+
+def floor_op(a, ctx=None):
+    return _simple("Floor", jnp.floor, a, nondiff=True, ctx=ctx)
+
+
+def ceil_op(a, ctx=None):
+    return _simple("Ceil", jnp.ceil, a, nondiff=True, ctx=ctx)
+
+
+def clamp_op(a, mmin=None, mmax=None, ctx=None):
+    return _simple("Clamp", lambda x: jnp.clip(x, mmin, mmax), a, ctx=ctx)
+
+
+def bool_op(a, b, cond=0, ctx=None):
+    """Elementwise comparison (reference gpu_ops/Bool.py): cond 0 '=', 1 '<',
+    2 '>', 3 '<=', 4 '>='; returns float mask like the reference kernel."""
+    fns = {
+        0: lambda x, y: (x == y),
+        1: lambda x, y: (x < y),
+        2: lambda x, y: (x > y),
+        3: lambda x, y: (x <= y),
+        4: lambda x, y: (x >= y),
+    }
+    f = fns[cond]
+    return _simple("Bool", lambda x, y: f(x, y).astype(jnp.float32), a, b,
+                   nondiff=True, ctx=ctx)
+
+
+def where_op(cond, a, b, ctx=None):
+    def _grad(n, g):
+        c = n.inputs[0]
+        ga = _simple("WhereGradA",
+                     lambda gr, cc: jnp.where(cc.astype(bool), gr, 0.0), g, c)
+        gb = _simple("WhereGradB",
+                     lambda gr, cc: jnp.where(cc.astype(bool), 0.0, gr), g, c)
+        return [None, _bb(ga, n.inputs[1]), _bb(gb, n.inputs[2])]
+
+    return _simple("Where", lambda c, x, y: jnp.where(c.astype(bool), x, y),
+                   cond, a, b, grad_rule=_grad, ctx=ctx)
+
+
+def where_const_op(cond, a, const_attr, ctx=None):
+    return _simple("WhereConst",
+                   lambda c, x: jnp.where(c.astype(bool), x, const_attr),
+                   cond, a, ctx=ctx)
+
+
+def masked_fill_op(a, mask, val=0.0, ctx=None):
+    """Reference gpu_ops/MaskedFill.py: fill where mask is set."""
+    return _simple("MaskedFill",
+                   lambda x, m: jnp.where(m.astype(bool), jnp.asarray(val, x.dtype), x),
+                   a, mask, ctx=ctx)
+
+
+def sign_op(a, ctx=None):
+    return _simple("Sign", jnp.sign, a, nondiff=True, ctx=ctx)
+
+
+def max_op(a, b, ctx=None):
+    return _simple("Max", jnp.maximum, a, b, ctx=ctx)
+
+
+def min_op(a, b, ctx=None):
+    return _simple("Min", jnp.minimum, a, b, ctx=ctx)
+
+
+# ----------------------------------------------------------------------- #
+# activations (reference: src/ops/Relu.cu, Gelu.cu, ... via gpu_ops/*)
+# ----------------------------------------------------------------------- #
+
+def relu_op(a, ctx=None):
+    return _simple("Relu", jax.nn.relu, a,
+                   grad_rule=lambda n, g: [relu_gradient_op(n.inputs[0], g)],
+                   ctx=ctx)
+
+
+def relu_gradient_op(a, grad, ctx=None):
+    return _simple("ReluGrad", lambda x, g: g * (x > 0).astype(g.dtype),
+                   a, grad, ctx=ctx)
+
+
+def leaky_relu_op(a, alpha=0.01, ctx=None):
+    return _simple("LeakyRelu", lambda x: jax.nn.leaky_relu(x, alpha), a, ctx=ctx)
+
+
+def leaky_relu_gradient_op(a, grad, alpha=0.01, ctx=None):
+    return _simple("LeakyReluGrad",
+                   lambda x, g: g * jnp.where(x > 0, 1.0, alpha), a, grad, ctx=ctx)
+
+
+def gelu_op(a, ctx=None):
+    # tanh approximation, matching the reference kernel (src/ops/Gelu.cu)
+    return _simple("Gelu", lambda x: jax.nn.gelu(x, approximate=True), a, ctx=ctx)
+
+
+def gelu_gradient_op(a, grad, ctx=None):
+    def f(x, g):
+        _, vjp = jax.vjp(lambda y: jax.nn.gelu(y, approximate=True), x)
+        return vjp(g)[0]
+    return _simple("GeluGrad", f, a, grad, ctx=ctx)
+
+
+def sigmoid_op(a, ctx=None):
+    return _simple("Sigmoid", jax.nn.sigmoid, a, ctx=ctx)
+
+
+def tanh_op(a, ctx=None):
+    return _simple("Tanh", jnp.tanh, a, ctx=ctx)
+
+
+def tanh_gradient_op(forward, grad, ctx=None):
+    """grad wrt input given the forward *output* (reference TanhGrad kernel)."""
+    return _simple("TanhGrad", lambda y, g: g * (1.0 - y * y), forward, grad, ctx=ctx)
+
+
+def softmax_func(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax_op(a, ctx=None):
+    return _simple("Softmax", lambda x: jax.nn.softmax(x, axis=-1), a, ctx=ctx)
+
+
+def softmax_gradient_op(forward, grad, ctx=None):
+    def f(y, g):
+        return y * (g - jnp.sum(g * y, axis=-1, keepdims=True))
+    return _simple("SoftmaxGrad", f, forward, grad, ctx=ctx)
+
+
+def log_softmax_op(a, ctx=None):
+    return _simple("LogSoftmax", lambda x: jax.nn.log_softmax(x, axis=-1), a, ctx=ctx)

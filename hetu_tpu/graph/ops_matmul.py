@@ -1,0 +1,94 @@
+"""Matmul-family op factories — the MXU workhorses.
+
+Reference: gpu_ops/MatrixMult.py (cublasSgemm via src/ops/MatrixMult.cu),
+Linear.py, BatchMatrixMult.py, Baddbmm.py, Addmm.py, MatrixDot.py, Outer.py,
+CuSparse.py (csrmm/csrmv).  All lower to ``jax.lax.dot_general`` which XLA
+tiles onto the 128x128 systolic array; ``preferred_element_type`` keeps
+accumulation in fp32 when activations are bf16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops_math import _simple
+
+
+def _mm(x, y, ta, tb):
+    if ta:
+        x = x.T
+    if tb:
+        y = y.T
+    return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def matmul_op(a, b, trans_A=False, trans_B=False, ctx=None):
+    return _simple("Matmul", lambda x, y: _mm(x, y, trans_A, trans_B), a, b,
+                   ctx=ctx)
+
+
+def linear_op(a, w, bias, trans_A=False, trans_B=False, ctx=None):
+    """x @ w + bias fused (reference gpu_ops/Linear.py)."""
+    return _simple("Linear",
+                   lambda x, y, b: _mm(x, y, trans_A, trans_B) + b,
+                   a, w, bias, ctx=ctx)
+
+
+def batch_matmul_op(a, b, trans_A=False, trans_B=False, ctx=None):
+    def f(x, y):
+        if trans_A:
+            x = jnp.swapaxes(x, -1, -2)
+        if trans_B:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    return _simple("BatchMatmul", f, a, b, ctx=ctx)
+
+
+def baddbmm_op(inp, a, b, alpha=1.0, beta=1.0, ctx=None):
+    return _simple("Baddbmm",
+                   lambda i, x, y: beta * i + alpha * jnp.matmul(x, y),
+                   inp, a, b, ctx=ctx)
+
+
+def addmm_op(inp, a, b, alpha=1.0, beta=1.0, ctx=None):
+    return _simple("Addmm",
+                   lambda i, x, y: beta * i + alpha * jnp.matmul(x, y),
+                   inp, a, b, ctx=ctx)
+
+
+def addmm_gradient_op(grad, axis=0, ctx=None):
+    """Sum the bias adjoint over rows if bias was broadcast."""
+    return _simple("AddmmGrad", lambda g: jnp.sum(g, axis=axis), grad, ctx=ctx)
+
+
+def matrix_dot_op(a, b, ctx=None):
+    """Elementwise product summed over rows? Reference MatrixDot = elementwise
+    multiply (per gpu_ops/MatrixDot.py kernel semantics)."""
+    return _simple("MatrixDot", lambda x, y: x * y, a, b, ctx=ctx)
+
+
+def outer_op(a, b, ctx=None):
+    return _simple("Outer", lambda x, y: jnp.outer(x, y), a, b, ctx=ctx)
+
+
+# sparse @ dense — TPU has no cuSPARSE; CSR inputs are densified via
+# segment-sum, which XLA handles well for the moderate sparsities the
+# reference targets (CTR feature matrices).
+
+def csrmv_op(data, row, col, mat_shape, vec, trans=False, ctx=None):
+    def f(d, r, c, v):
+        dense = jnp.zeros(mat_shape, v.dtype).at[r.astype(jnp.int32),
+                                                 c.astype(jnp.int32)].add(d)
+        m = dense.T if trans else dense
+        return m @ v
+    return _simple("CsrMV", f, data, row, col, vec, ctx=ctx)
+
+
+def csrmm_op(data, row, col, mat_shape, mat, trans=False, ctx=None):
+    def f(d, r, c, m2):
+        dense = jnp.zeros(mat_shape, m2.dtype).at[r.astype(jnp.int32),
+                                                  c.astype(jnp.int32)].add(d)
+        m = dense.T if trans else dense
+        return m @ m2
+    return _simple("CsrMM", f, data, row, col, mat, ctx=ctx)

@@ -1,0 +1,206 @@
+"""Variables, placeholders, constants, and the generic VJP gradient op.
+
+Reference counterparts: gpu_ops/Variable.py (PlaceholderOp at Variable.py:19),
+gpu_ops/OnesLike.py / ZerosLike.py, gpu_ops/Arange.py, gpu_ops/Full.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .node import Op, SimpleOp, TraceContext
+
+
+class PlaceholderOp(Op):
+    """A leaf node: either a fed input (shape unknown until feed) or a
+    variable (trainable parameter / non-trainable state) with a value or an
+    initializer.  Reference: Variable.py:19-63.
+
+    ``is_embed`` marks embedding tables routed to the parameter-server path
+    in Hybrid mode (Variable.py:57-63).  ``reshape_in_mp`` model-parallel
+    repartition (Variable.py:83-120) is unnecessary here — sharding specs
+    partition parameters without touching their logical shape.
+    """
+
+    def __init__(self, name, value=None, initializer=None, trainable=True,
+                 dtype=jnp.float32, ctx=None, is_embed=False):
+        super().__init__(name=name, ctx=ctx)
+        self.name = name  # placeholders keep their exact user name
+        if dtype is np.float32:
+            dtype = jnp.float32
+        self.dtype = dtype
+        self.is_embed = is_embed
+        # sharding hint: optional PartitionSpec-like tuple set by strategies
+        self.sharding_spec = None
+        if value is None and initializer is None:
+            trainable = False
+            self.shape = None
+        elif value is not None:
+            assert initializer is None, "value given; initializer must be None"
+            value = np.asarray(value, dtype=np.dtype(dtype) if dtype != jnp.bfloat16 else np.float32)
+            self.shape = tuple(value.shape)
+        else:
+            self.shape = tuple(initializer.shape)
+        self.tensor_value = value
+        self.initializer = initializer
+        self.trainable = trainable
+
+    @property
+    def is_variable(self):
+        return self.tensor_value is not None or self.initializer is not None
+
+    def init_value(self, seed: int) -> jnp.ndarray:
+        """Materialize the initial value (host side, before jit)."""
+        if self.tensor_value is not None:
+            return jnp.asarray(self.tensor_value, dtype=self.dtype)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), self.id)
+        return self.initializer.generate(key, self.dtype)
+
+    def compute(self, input_vals, tc: TraceContext):
+        raise AssertionError(
+            f"placeholder {self.name} must be fed or bound by the executor")
+
+    def gradient(self, output_grad):
+        return None
+
+    def infer_shape(self, input_shapes, input_dtypes=None):
+        assert self.shape is not None, f"feed shape needed for {self.name}"
+        return self.shape
+
+
+def Variable(name, value=None, initializer=None, trainable=True,
+             dtype=jnp.float32, ctx=None):
+    """Reference Variable.py:8-16."""
+    return PlaceholderOp(name, value, initializer, trainable, dtype, ctx)
+
+
+def placeholder_op(name, value=None, initializer=None, trainable=True,
+                   dtype=jnp.float32, ctx=None):
+    return PlaceholderOp(name, value, initializer, trainable, dtype, ctx)
+
+
+class VJPOp(Op):
+    """Generic cotangent node: grad of ``orig``'s ``input_index``-th input.
+
+    The forward is recomputed inside ``jax.vjp`` at trace time; XLA CSE
+    merges it with the original forward computation, so the compiled program
+    contains each forward op once.  This one node replaces the majority of
+    hand-written backward kernels in the reference (src/ops/*.cu)."""
+
+    def __init__(self, orig: Op, output_grad: Op, input_index: int):
+        super().__init__(*orig.inputs, output_grad,
+                         name=f"grad_{orig.name}_in{input_index}")
+        self._orig = orig
+        self._idx = input_index
+
+    def compute(self, input_vals, tc: TraceContext):
+        *xs, g = input_vals
+        # sandbox the recomputed forward: stateful ops (e.g. BatchNorm
+        # running stats) write to tc.extra_outputs, and writes from inside
+        # the vjp trace would leak inner tracers into the outer jit trace.
+        inner_tc = TraceContext(
+            params=tc.params, rng=tc._rng, training=tc.training,
+            mesh=tc.mesh, axis_env=tc.axis_env, config=tc.config,
+            step=tc.step)
+
+        def primal(*a):
+            return self._orig.compute(list(a), inner_tc)
+
+        primal_out, vjp = jax.vjp(primal, *xs)
+        cot = vjp(jnp.asarray(g, dtype=primal_out.dtype))
+        return cot[self._idx]
+
+    def gradient(self, output_grad):
+        raise NotImplementedError("second-order autodiff not supported")
+
+
+class SumOp(Op):
+    """Merge partial adjoints (reference executor.py:1393 sum_node_list via
+    gpu_ops/Sum.py). Dense inputs sum elementwise; IndexedSlices-style
+    sparse adjoints are densified first (sparse path: ops_embed)."""
+
+    def __init__(self, nodes, ctx=None):
+        super().__init__(*nodes, name="Sum", ctx=ctx)
+
+    def jax_fn(self, *vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+
+    def gradient(self, output_grad):
+        return [output_grad for _ in self.inputs]
+
+
+def sum_op(nodes, ctx=None):
+    return SumOp(nodes, ctx=ctx)
+
+
+class OnesLikeOp(Op):
+    def __init__(self, node, ctx=None):
+        super().__init__(node, name="OnesLike", ctx=ctx)
+
+    def jax_fn(self, x):
+        return jnp.ones_like(x)
+
+    def gradient(self, output_grad):
+        return [None]
+
+
+class ZerosLikeOp(Op):
+    def __init__(self, node, ctx=None):
+        super().__init__(node, name="ZerosLike", ctx=ctx)
+
+    def jax_fn(self, x):
+        return jnp.zeros_like(x)
+
+    def gradient(self, output_grad):
+        return [None]
+
+
+def oneslike_op(node, ctx=None):
+    return OnesLikeOp(node, ctx=ctx)
+
+
+def zeroslike_op(node, ctx=None):
+    return ZerosLikeOp(node, ctx=ctx)
+
+
+def full_op(shape, fill_value, ctx=None):
+    op = SimpleOp(lambda: jnp.full(shape, fill_value), name="Full", ctx=ctx)
+    op.gradient = lambda output_grad: []
+    return op
+
+
+def full_like_op(node, fill_value, ctx=None):
+    op = SimpleOp(lambda x: jnp.full_like(x, fill_value), node,
+                  name="FullLike", ctx=ctx)
+    op.gradient = lambda output_grad: [None]
+    return op
+
+
+def arange_op(start, end, step=1, ctx=None):
+    op = SimpleOp(lambda: jnp.arange(start, end, step, dtype=jnp.float32),
+                  name="Arange", ctx=ctx)
+    op.gradient = lambda output_grad: []
+    return op
+
+
+class RandOp(Op):
+    """Uniform [0,1) random tensor, fresh each step (reference gpu_ops/Rand.py)."""
+
+    def __init__(self, shape, ctx=None):
+        super().__init__(name="Rand", ctx=ctx)
+        self.shape = tuple(shape)
+
+    def compute(self, input_vals, tc: TraceContext):
+        return jax.random.uniform(tc.rng_for(self), self.shape)
+
+    def gradient(self, output_grad):
+        return []
+
+
+def rand_op(shape, ctx=None):
+    return RandOp(shape, ctx=ctx)

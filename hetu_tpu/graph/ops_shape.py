@@ -1,0 +1,363 @@
+"""Shape / indexing / reduction op factories.
+
+Reference counterparts: gpu_ops/{Reshape,Transpose,Broadcast,BroadcastShape,
+ReduceSum,ReduceMean,Slice,SliceAssign,Split,Concat,Concatenate,Pad,Gather,
+Scatter,Roll,Repeat,Interpolate,OneHot,Argmax,Argsort,TopK*,CumSum,Norm,
+Tile,...}.py — each here is a jnp composition; XLA handles layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .node import Op, SimpleOp
+from .ops_math import _simple
+
+
+# ----------------------------------------------------------------------- #
+# broadcast / reduce
+# ----------------------------------------------------------------------- #
+
+class BroadcastReduceOp(Op):
+    """Sum a (possibly broadcasted) adjoint back down to the shape of a
+    target node — used by binary-op gradients for numpy broadcasting.
+    Shape resolution happens at trace time from the concrete values."""
+
+    def __init__(self, grad, target, ctx=None):
+        super().__init__(grad, target, name="BroadcastReduce", ctx=ctx)
+
+    def jax_fn(self, g, x):
+        if g.shape == x.shape:
+            return g
+        # sum leading extra dims, then keepdims-sum broadcasted dims
+        extra = g.ndim - x.ndim
+        if extra > 0:
+            g = jnp.sum(g, axis=tuple(range(extra)))
+        axes = tuple(i for i, (gs, xs) in enumerate(zip(g.shape, x.shape))
+                     if gs != xs)
+        if axes:
+            g = jnp.sum(g, axis=axes, keepdims=True)
+        return g.astype(x.dtype)
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+
+def broadcast_reduce_op(grad, target, ctx=None):
+    return BroadcastReduceOp(grad, target, ctx=ctx)
+
+
+def broadcastto_op(a, target, ctx=None):
+    """Broadcast a to target's shape (reference gpu_ops/Broadcast.py;
+    adds trailing-dim alignment like the kernel: bias (C,) -> (N,C))."""
+    def f(x, t):
+        return jnp.broadcast_to(x, t.shape).astype(x.dtype)
+    return _simple("BroadcastTo", f, a, target,
+                   grad_rule=lambda n, g: [broadcast_reduce_op(g, n.inputs[0]), None],
+                   ctx=ctx)
+
+
+def broadcast_shape_op(a, shape, add_axes=None, ctx=None):
+    """Broadcast to an explicit shape (reference gpu_ops/BroadcastShape.py).
+    ``add_axes`` lists axes of the *output* that are new (reference semantics:
+    input dims map to the non-added axes in order)."""
+    shape = tuple(shape)
+    if add_axes:
+        add_axes = tuple(sorted(add_axes))
+
+        def f(x):
+            for ax in add_axes:
+                x = jnp.expand_dims(x, ax)
+            return jnp.broadcast_to(x, shape)
+    else:
+        def f(x):
+            return jnp.broadcast_to(x, shape)
+    return _simple("BroadcastShape", f, a, ctx=ctx)
+
+
+def reduce_sum_op(a, axes=None, keepdims=False, ctx=None):
+    if axes is not None and not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    axes = tuple(axes) if axes is not None else None
+    return _simple("ReduceSum",
+                   lambda x: jnp.sum(x, axis=axes, keepdims=bool(keepdims)), a,
+                   ctx=ctx)
+
+
+def reduce_mean_op(a, axes=None, keepdims=False, ctx=None):
+    if axes is not None and not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    axes = tuple(axes) if axes is not None else None
+    return _simple("ReduceMean",
+                   lambda x: jnp.mean(x, axis=axes, keepdims=bool(keepdims)), a,
+                   ctx=ctx)
+
+
+def reducesumaxiszero_op(a, ctx=None):
+    return _simple("ReduceSumAxisZero", lambda x: jnp.sum(x, axis=0), a, ctx=ctx)
+
+
+def reduce_min_op(a, axes=None, keepdims=False, ctx=None):
+    if axes is not None and not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    axes = tuple(axes) if axes is not None else None
+    return _simple("ReduceMin",
+                   lambda x: jnp.min(x, axis=axes, keepdims=bool(keepdims)), a,
+                   ctx=ctx)
+
+
+def reduce_norm1_op(a, axes=None, keepdims=False, ctx=None):
+    if axes is not None and not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    axes = tuple(axes) if axes is not None else None
+    return _simple("ReduceNorm1",
+                   lambda x: jnp.sum(jnp.abs(x), axis=axes, keepdims=bool(keepdims)),
+                   a, ctx=ctx)
+
+
+def reduce_norm2_op(a, axes=None, keepdims=False, ctx=None):
+    if axes is not None and not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    axes = tuple(axes) if axes is not None else None
+    return _simple("ReduceNorm2",
+                   lambda x: jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=bool(keepdims))),
+                   a, ctx=ctx)
+
+
+def norm_op(a, axis=None, p=2, ctx=None):
+    return _simple("Norm",
+                   lambda x: jnp.linalg.norm(x.reshape(-1) if axis is None else x,
+                                             ord=p, axis=axis),
+                   a, ctx=ctx)
+
+
+# ----------------------------------------------------------------------- #
+# reshape / transpose / slice / concat / split / pad
+# ----------------------------------------------------------------------- #
+
+def array_reshape_op(a, shape, ctx=None):
+    shape = tuple(int(s) for s in shape)
+    return _simple("Reshape", lambda x: jnp.reshape(x, shape), a, ctx=ctx)
+
+
+def transpose_op(a, perm=None, ctx=None):
+    perm = tuple(perm) if perm is not None else None
+    return _simple("Transpose", lambda x: jnp.transpose(x, perm), a, ctx=ctx)
+
+
+def slice_op(a, begin, size, ctx=None):
+    begin = tuple(int(b) for b in begin)
+    size = tuple(int(s) for s in size)
+
+    def f(x):
+        idx = tuple(slice(b, b + s) for b, s in zip(begin, size))
+        return x[idx]
+    return _simple("Slice", f, a, ctx=ctx)
+
+
+def slice_assign_op(a, val_const, begin, size, ctx=None):
+    begin = tuple(int(b) for b in begin)
+    size = tuple(int(s) for s in size)
+    idx = tuple(slice(b, b + s) for b, s in zip(begin, size))
+    return _simple("SliceAssign", lambda x: x.at[idx].set(val_const), a, ctx=ctx)
+
+
+def slice_assign_matrix_op(a, b, begin_a, size, begin_b, ctx=None):
+    idx_a = tuple(slice(s, s + z) for s, z in zip(begin_a, size))
+    idx_b = tuple(slice(s, s + z) for s, z in zip(begin_b, size))
+    return _simple("SliceAssignMatrix",
+                   lambda x, y: x.at[idx_a].set(y[idx_b]), a, b, ctx=ctx)
+
+
+def slice_by_matrix_op(a, idx0, idx1, ctx=None):
+    """a[idx0, idx1] advanced indexing (reference gpu_ops/SliceByMatrix.py)."""
+    return _simple("SliceByMatrix",
+                   lambda x, i, j: x[i.astype(jnp.int32), j.astype(jnp.int32)],
+                   a, idx0, idx1, ctx=ctx)
+
+
+def split_op(a, axes, indices, splits, ctx=None):
+    """Take one piece of an even split (reference gpu_ops/Split.py:
+    per-axis number of splits and which index to keep)."""
+    if not isinstance(axes, (list, tuple)):
+        axes, indices, splits = [axes], [indices], [splits]
+
+    def f(x):
+        for ax, ind, spl in zip(axes, indices, splits):
+            part = x.shape[ax] // spl
+            x = jax.lax.slice_in_dim(x, ind * part, (ind + 1) * part, axis=ax)
+        return x
+    return _simple("Split", f, a, ctx=ctx)
+
+
+def concat_op(a, b, axis=0, ctx=None):
+    return _simple("Concat", lambda x, y: jnp.concatenate([x, y], axis=axis),
+                   a, b, ctx=ctx)
+
+
+def concatenate_op(nodes, axis=0, ctx=None):
+    return _simple("Concatenate",
+                   lambda *xs: jnp.concatenate(list(xs), axis=axis), *nodes,
+                   ctx=ctx)
+
+
+def pad_op(a, paddings, mode="CONSTANT", constant_values=0.0, ctx=None):
+    pads = tuple((int(p[0]), int(p[1])) for p in paddings)
+    jmode = {"CONSTANT": "constant", "REFLECT": "reflect", "SYMMETRIC": "symmetric"}[mode.upper()]
+
+    def f(x):
+        if jmode == "constant":
+            return jnp.pad(x, pads, mode=jmode, constant_values=constant_values)
+        return jnp.pad(x, pads, mode=jmode)
+    return _simple("Pad", f, a, ctx=ctx)
+
+
+def flatten_op(a, ctx=None):
+    return _simple("Flatten", lambda x: x.reshape(x.shape[0], -1), a, ctx=ctx)
+
+
+def tile_op(a, reps, ctx=None):
+    return _simple("Tile", lambda x: jnp.tile(x, reps), a, ctx=ctx)
+
+
+def repeat_op(a, repeats, axis=None, ctx=None):
+    return _simple("Repeat", lambda x: jnp.repeat(x, repeats, axis=axis), a, ctx=ctx)
+
+
+def roll_op(a, shift, axis=None, ctx=None):
+    return _simple("Roll", lambda x: jnp.roll(x, shift, axis=axis), a, ctx=ctx)
+
+
+def interpolate_op(a, scale_factor=None, size=None, mode="bilinear",
+                   align_corners=False, ctx=None):
+    """NCHW spatial resize (reference gpu_ops/Interpolate.py)."""
+    def f(x):
+        n, c, h, w = x.shape
+        if size is not None:
+            oh, ow = size
+        else:
+            oh, ow = int(h * scale_factor), int(w * scale_factor)
+        method = {"bilinear": "bilinear", "nearest": "nearest"}[mode]
+        return jax.image.resize(x, (n, c, oh, ow), method=method)
+    return _simple("Interpolate", f, a, ctx=ctx)
+
+
+# ----------------------------------------------------------------------- #
+# gather / scatter / indexing
+# ----------------------------------------------------------------------- #
+
+def gather_op(a, axis, index, ctx=None):
+    """torch.gather semantics (reference gpu_ops/Gather.py)."""
+    return _simple("Gather",
+                   lambda x, i: jnp.take_along_axis(x, i.astype(jnp.int32), axis=axis),
+                   a, index,
+                   grad_rule=lambda n, g: _gather_grad(n, g, axis),
+                   ctx=ctx)
+
+
+def _gather_grad(node, g, axis):
+    x, index = node.inputs
+
+    def f(gr, xx, ii):
+        z = jnp.zeros_like(xx)
+        ii = ii.astype(jnp.int32)
+        return _scatter_add_along_axis(z, ii, gr, axis)
+    return [_simple("GatherGrad", f, g, x, index), None]
+
+
+def _scatter_add_along_axis(z, idx, src, axis):
+    # build open mesh of indices, replace `axis` with idx
+    ind = list(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij"))
+    ind[axis] = idx
+    return z.at[tuple(ind)].add(src)
+
+
+def scatter_op(a, axis, index, src, ctx=None):
+    """torch.scatter: write src rows into a at index along axis."""
+    def f(x, i, s):
+        i = i.astype(jnp.int32)
+        ind = list(jnp.meshgrid(*[jnp.arange(d) for d in i.shape], indexing="ij"))
+        ind[axis] = i
+        return x.at[tuple(ind)].set(s)
+    return _simple("Scatter", f, a, index, src, ctx=ctx)
+
+
+def scatter1d_op(a, index, src, ctx=None):
+    return _simple("Scatter1D",
+                   lambda x, i, s: x.at[i.astype(jnp.int32)].set(s),
+                   a, index, src, ctx=ctx)
+
+
+def indexing_op(a, index, ctx=None):
+    return _simple("Indexing",
+                   lambda x, i: x[i.astype(jnp.int32)], a, index, ctx=ctx)
+
+
+def one_hot_op(indices, num_classes, ctx=None):
+    return _simple("OneHot",
+                   lambda i: jax.nn.one_hot(i.astype(jnp.int32), num_classes),
+                   indices, nondiff=True, ctx=ctx)
+
+
+def argmax_op(a, dim=-1, ctx=None):
+    return _simple("Argmax",
+                   lambda x: jnp.argmax(x, axis=dim).astype(jnp.float32), a,
+                   nondiff=True, ctx=ctx)
+
+
+def argsort_op(a, dim=-1, descending=False, ctx=None):
+    def f(x):
+        s = jnp.argsort(-x if descending else x, axis=dim)
+        return s.astype(jnp.float32)
+    return _simple("Argsort", f, a, nondiff=True, ctx=ctx)
+
+
+def argmax_partial_op(a, mask, dim=-1, ctx=None):
+    def f(x, m):
+        neg = jnp.finfo(x.dtype).min
+        return jnp.argmax(jnp.where(m.astype(bool), x, neg), axis=dim).astype(jnp.float32)
+    return _simple("ArgmaxPartial", f, a, mask, nondiff=True, ctx=ctx)
+
+
+def cumsum_with_bias_op(a, bias=0.0, dim=0, ctx=None):
+    """cumsum(x + bias) along dim (reference gpu_ops/CumSum.py; used by MoE
+    position computation, TopGate.py)."""
+    return _simple("CumsumWithBias",
+                   lambda x: jnp.cumsum(x + bias, axis=dim), a, ctx=ctx)
+
+
+def cumsum_op(a, dim=0, ctx=None):
+    return _simple("Cumsum", lambda x: jnp.cumsum(x, axis=dim), a, ctx=ctx)
+
+
+def topk_idx_op(a, topk=None, dim=-1, ctx=None, k=None):
+    """Indices of top-k along last dim, as float (reference
+    gpu_ops/TopKIdx.py; keyword is ``topk`` there, ``k`` also accepted)."""
+    k = topk if topk is not None else k
+    assert k is not None, "topk_idx_op needs topk="
+    assert dim in (-1, None), "top-k over non-last dims: transpose first"
+
+    def f(x):
+        _, idx = jax.lax.top_k(x, k)
+        return idx.astype(jnp.float32)
+    return _simple("TopKIdx", f, a, nondiff=True, ctx=ctx)
+
+
+def topk_val_op(a, topk=None, dim=-1, ctx=None, k=None):
+    k = topk if topk is not None else k
+    assert k is not None, "topk_val_op needs topk="
+
+    def f(x):
+        val, _ = jax.lax.top_k(x, k)
+        return val
+    return _simple("TopKVal", f, a, ctx=ctx)
+
+
+def min_dist_op(lookup, key, indices, ctx=None):
+    """Nearest-codebook-entry lookup used by quantized embeddings."""
+    def f(table, q, idx):
+        d = jnp.abs(table[None, :] - q[:, None])
+        return jnp.argmin(d, axis=-1).astype(jnp.float32)
+    return _simple("MinDist", f, lookup, key, indices, nondiff=True, ctx=ctx)

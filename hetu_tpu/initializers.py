@@ -1,0 +1,283 @@
+"""Initializers: constant/uniform/normal/truncated-normal + Xavier/He/Lecun.
+
+Reference: python/hetu/initializers.py (BaseInit:9, ConstantInit:42, ...,
+factory helpers at bottom; `init_on_ps` variant at :28-38 initializes on the
+parameter server — here PS-resident embedding tables reuse the same
+generator seeded identically on the server process).
+
+Each initializer is a value *spec*; generation happens once on host via
+jax.random with a key folded with the variable's node id, so multi-process
+replicas initialize identically (replacing the reference's seed + node.id
+scheme, initializers.py:14).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .graph.ops_misc import PlaceholderOp
+
+
+class BaseInit:
+    def __init__(self, shape):
+        self.shape = tuple(int(s) for s in shape)
+
+    def generate(self, key, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class ConstantInit(BaseInit):
+    def __init__(self, constant, shape):
+        super().__init__(shape)
+        self.constant = constant
+
+    def generate(self, key, dtype=jnp.float32):
+        return jnp.full(self.shape, self.constant, dtype=dtype)
+
+
+class ZerosInit(ConstantInit):
+    def __init__(self, shape):
+        super().__init__(0.0, shape)
+
+
+class OnesInit(ConstantInit):
+    def __init__(self, shape):
+        super().__init__(1.0, shape)
+
+
+class UniformInit(BaseInit):
+    def __init__(self, low, high, shape):
+        super().__init__(shape)
+        self.low, self.high = low, high
+
+    def generate(self, key, dtype=jnp.float32):
+        return jax.random.uniform(key, self.shape, dtype=jnp.float32,
+                                  minval=self.low, maxval=self.high).astype(dtype)
+
+
+class NormalInit(BaseInit):
+    def __init__(self, mean, stddev, shape):
+        super().__init__(shape)
+        self.mean, self.stddev = mean, stddev
+
+    def generate(self, key, dtype=jnp.float32):
+        return (self.mean + self.stddev *
+                jax.random.normal(key, self.shape, dtype=jnp.float32)).astype(dtype)
+
+
+class TruncatedNormalInit(BaseInit):
+    def __init__(self, mean, stddev, shape):
+        super().__init__(shape)
+        self.mean, self.stddev = mean, stddev
+
+    def generate(self, key, dtype=jnp.float32):
+        x = jax.random.truncated_normal(key, -2.0, 2.0, self.shape,
+                                        dtype=jnp.float32)
+        return (self.mean + self.stddev * x).astype(dtype)
+
+
+class ReversedTruncatedNormalInit(TruncatedNormalInit):
+    pass
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv OIHW
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormalInit(BaseInit):
+    def __init__(self, shape, gain=1.0):
+        super().__init__(shape)
+        self.gain = gain
+
+    def generate(self, key, dtype=jnp.float32):
+        fan_in, fan_out = _fans(self.shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return (std * jax.random.normal(key, self.shape, jnp.float32)).astype(dtype)
+
+
+class XavierUniformInit(BaseInit):
+    def __init__(self, shape, gain=1.0):
+        super().__init__(shape)
+        self.gain = gain
+
+    def generate(self, key, dtype=jnp.float32):
+        fan_in, fan_out = _fans(self.shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, self.shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class HeNormalInit(BaseInit):
+    def generate(self, key, dtype=jnp.float32):
+        fan_in, _ = _fans(self.shape)
+        std = math.sqrt(2.0 / fan_in)
+        return (std * jax.random.normal(key, self.shape, jnp.float32)).astype(dtype)
+
+
+class HeUniformInit(BaseInit):
+    def generate(self, key, dtype=jnp.float32):
+        fan_in, _ = _fans(self.shape)
+        limit = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, self.shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class LecunNormalInit(BaseInit):
+    def generate(self, key, dtype=jnp.float32):
+        fan_in, _ = _fans(self.shape)
+        std = math.sqrt(1.0 / fan_in)
+        return (std * jax.random.normal(key, self.shape, jnp.float32)).astype(dtype)
+
+
+class LecunUniformInit(BaseInit):
+    def generate(self, key, dtype=jnp.float32):
+        fan_in, _ = _fans(self.shape)
+        limit = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, self.shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+# --------------------------------------------------------------------- #
+# factory functions returning variable nodes (reference initializers.py
+# bottom half; usage e.g. examples/cnn/models/ResNet.py:15)
+# --------------------------------------------------------------------- #
+
+def _var(init, name, trainable=True, ctx=None, dtype=jnp.float32):
+    return PlaceholderOp(name, initializer=init, trainable=trainable,
+                         ctx=ctx, dtype=dtype)
+
+
+def constant(shape, fill_value=0.0, name="constant_init", trainable=True,
+             ctx=None, dtype=jnp.float32):
+    return _var(ConstantInit(fill_value, shape), name, trainable, ctx, dtype)
+
+
+def zeros(shape, name="zeros_init", trainable=True, ctx=None, dtype=jnp.float32):
+    return _var(ZerosInit(shape), name, trainable, ctx, dtype)
+
+
+def ones(shape, name="ones_init", trainable=True, ctx=None, dtype=jnp.float32):
+    return _var(OnesInit(shape), name, trainable, ctx, dtype)
+
+
+def random_uniform(shape, minval=-0.05, maxval=0.05, name="uniform_init",
+                   trainable=True, ctx=None, dtype=jnp.float32):
+    return _var(UniformInit(minval, maxval, shape), name, trainable, ctx, dtype)
+
+
+def random_normal(shape, mean=0.0, stddev=0.05, name="normal_init",
+                  trainable=True, ctx=None, dtype=jnp.float32):
+    return _var(NormalInit(mean, stddev, shape), name, trainable, ctx, dtype)
+
+
+def truncated_normal(shape, mean=0.0, stddev=0.05, name="truncated_normal_init",
+                     trainable=True, ctx=None, dtype=jnp.float32):
+    return _var(TruncatedNormalInit(mean, stddev, shape), name, trainable, ctx, dtype)
+
+
+def xavier_normal(shape, gain=1.0, name="xavier_normal_init", trainable=True,
+                  ctx=None, dtype=jnp.float32):
+    return _var(XavierNormalInit(shape, gain), name, trainable, ctx, dtype)
+
+
+def xavier_uniform(shape, gain=1.0, name="xavier_uniform_init", trainable=True,
+                   ctx=None, dtype=jnp.float32):
+    return _var(XavierUniformInit(shape, gain), name, trainable, ctx, dtype)
+
+
+def he_normal(shape, name="he_normal_init", trainable=True, ctx=None,
+              dtype=jnp.float32):
+    return _var(HeNormalInit(shape), name, trainable, ctx, dtype)
+
+
+def he_uniform(shape, name="he_uniform_init", trainable=True, ctx=None,
+               dtype=jnp.float32):
+    return _var(HeUniformInit(shape), name, trainable, ctx, dtype)
+
+
+def lecun_normal(shape, name="lecun_normal_init", trainable=True, ctx=None,
+                 dtype=jnp.float32):
+    return _var(LecunNormalInit(shape), name, trainable, ctx, dtype)
+
+
+def lecun_uniform(shape, name="lecun_uniform_init", trainable=True, ctx=None,
+                  dtype=jnp.float32):
+    return _var(LecunUniformInit(shape), name, trainable, ctx, dtype)
+
+
+# --------------------------------------------------------------------- #
+# Gen* generator factories (reference initializers.py:320-372): return a
+# callable(shape=..., name=...) -> variable node, used by layer classes.
+# --------------------------------------------------------------------- #
+
+def _gen(make_init):
+    def generator(shape=None, name="init", trainable=True, ctx=None,
+                  dtype=jnp.float32):
+        return _var(make_init(shape), name, trainable, ctx, dtype)
+    return generator
+
+
+def GenZeros():
+    return _gen(lambda s: ZerosInit(s))
+
+
+def GenOnes():
+    return _gen(lambda s: OnesInit(s))
+
+
+def GenConstant(fill_value=0.0):
+    return _gen(lambda s: ConstantInit(fill_value, s))
+
+
+def GenTruncatedNormal(mean=0.0, stddev=1.0):
+    return _gen(lambda s: TruncatedNormalInit(mean, stddev, s))
+
+
+def GenNormal(mean=0.0, stddev=1.0):
+    return _gen(lambda s: NormalInit(mean, stddev, s))
+
+
+def GenUniform(minval=-1.0, maxval=1.0):
+    return _gen(lambda s: UniformInit(minval, maxval, s))
+
+
+def GenXavierNormal(gain=1.0):
+    return _gen(lambda s: XavierNormalInit(s, gain))
+
+
+def GenXavierUniform(gain=1.0):
+    return _gen(lambda s: XavierUniformInit(s, gain))
+
+
+GenGeneralXavierNormal = GenXavierNormal
+GenGeneralXavierUniform = GenXavierUniform
+
+
+def GenHeNormal():
+    return _gen(lambda s: HeNormalInit(s))
+
+
+def GenHeUniform():
+    return _gen(lambda s: HeUniformInit(s))
+
+
+def GenLecunNormal():
+    return _gen(lambda s: LecunNormalInit(s))
+
+
+def GenLecunUniform():
+    return _gen(lambda s: LecunUniformInit(s))
+
+
+# GenEmpty / GenReversedTruncatedNormal parity aliases
+nulls = zeros

@@ -1,0 +1,15 @@
+"""Pallas TPU kernels for the hot ops.
+
+Planned contents (SURVEY.md §2.1 'TPU equivalent'): fused flash attention,
+MoE capacity dispatch, top-k gating helpers.  Modules register themselves
+here as they land; import errors mean the kernel is not built yet — all
+call sites fall back to the jnp compositions in hetu_tpu.graph.
+"""
+
+__all__ = []
+
+try:
+    from . import flash_attention  # noqa: F401
+    __all__.append("flash_attention")
+except ImportError:
+    pass

@@ -1,0 +1,14 @@
+"""Layer toolkit over the op factories (reference python/hetu/layers/)."""
+
+from .base import BaseLayer, Sequence
+from .linear import Linear
+from .conv import Conv2d
+from .norm import BatchNorm, LayerNorm
+from .dropout import DropOut
+from .activations import Relu, Gelu, Tanh, Sigmoid
+from .embedding import Embedding
+from .pooling import MaxPool2d, AvgPool2d
+from .reshape import Reshape
+from .moe import Expert, MoELayer, TopKGate, HashGate, KTop1Gate, SAMGate, \
+    BalanceGate
+from .attention import MultiHeadAttention

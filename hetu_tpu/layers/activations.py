@@ -1,0 +1,24 @@
+"""Activation layers (reference layers/activations.py)."""
+
+from .base import BaseLayer
+from ..graph import relu_op, gelu_op, tanh_op, sigmoid_op
+
+
+class Relu(BaseLayer):
+    def __call__(self, x):
+        return relu_op(x)
+
+
+class Gelu(BaseLayer):
+    def __call__(self, x):
+        return gelu_op(x)
+
+
+class Tanh(BaseLayer):
+    def __call__(self, x):
+        return tanh_op(x)
+
+
+class Sigmoid(BaseLayer):
+    def __call__(self, x):
+        return sigmoid_op(x)

@@ -1,0 +1,62 @@
+"""Multi-head attention layer composed from the op surface.
+
+The reference has no attention layer class (BERT builds attention inline in
+examples/nlp/bert/hetu_bert.py); we provide one because transformer models
+are first-class here.  This graph-level layer stays op-compositional so it
+works under every executor mode; a fused flash-attention Pallas kernel
+(hetu_tpu.kernels) replaces the softmax(QK^T)V chain where available.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .base import BaseLayer
+from .. import initializers as init
+from ..graph import (
+    matmul_op, batch_matmul_op, array_reshape_op, transpose_op, softmax_op,
+    mul_byconst_op, broadcastto_op, dropout_op, linear_op,
+)
+
+
+class MultiHeadAttention(BaseLayer):
+    def __init__(self, hidden_size, num_heads, seq_len, batch_size,
+                 dropout_rate=0.0, initializer=None, name="attn"):
+        assert hidden_size % num_heads == 0
+        self.h = hidden_size
+        self.nh = num_heads
+        self.hd = hidden_size // num_heads
+        self.seq = seq_len
+        self.bs = batch_size
+        self.keep_prob = 1.0 - dropout_rate
+        ini = initializer or init.GenXavierUniform()
+        self.wq = ini(shape=(self.h, self.h), name=name + "_q_weight")
+        self.wk = ini(shape=(self.h, self.h), name=name + "_k_weight")
+        self.wv = ini(shape=(self.h, self.h), name=name + "_v_weight")
+        self.wo = ini(shape=(self.h, self.h), name=name + "_proj_weight")
+        self.bq = init.zeros((self.h,), name=name + "_q_bias")
+        self.bk = init.zeros((self.h,), name=name + "_k_bias")
+        self.bv = init.zeros((self.h,), name=name + "_v_bias")
+        self.bo = init.zeros((self.h,), name=name + "_proj_bias")
+
+    def _split_heads(self, x):
+        # (B*S, H) -> (B, nh, S, hd)
+        x = array_reshape_op(x, [self.bs, self.seq, self.nh, self.hd])
+        return transpose_op(x, [0, 2, 1, 3])
+
+    def __call__(self, x, attention_mask=None):
+        """x: (B*S, H) flattened hidden states; mask: additive (B,1,1,S)."""
+        q = self._split_heads(linear_op(x, self.wq, self.bq))
+        k = self._split_heads(linear_op(x, self.wk, self.bk))
+        v = self._split_heads(linear_op(x, self.wv, self.bv))
+        scores = batch_matmul_op(q, k, trans_B=True)
+        scores = mul_byconst_op(scores, 1.0 / math.sqrt(self.hd))
+        if attention_mask is not None:
+            scores = scores + broadcastto_op(attention_mask, scores)
+        probs = softmax_op(scores)
+        if self.keep_prob < 1.0:
+            probs = dropout_op(probs, self.keep_prob)
+        ctxv = batch_matmul_op(probs, v)  # (B, nh, S, hd)
+        ctxv = transpose_op(ctxv, [0, 2, 1, 3])
+        ctxv = array_reshape_op(ctxv, [self.bs * self.seq, self.h])
+        return linear_op(ctxv, self.wo, self.bo)

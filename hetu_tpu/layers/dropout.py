@@ -1,0 +1,12 @@
+"""Dropout layer (reference layers/dropout.py)."""
+
+from .base import BaseLayer
+from ..graph import dropout_op
+
+
+class DropOut(BaseLayer):
+    def __init__(self, p=0.5):
+        self.keep_prob = 1.0 - p
+
+    def __call__(self, x):
+        return dropout_op(x, self.keep_prob)

@@ -1,0 +1,20 @@
+"""Embedding layer (reference layers/embedding.py)."""
+
+from .base import BaseLayer
+from .. import initializers as init
+from ..graph import embedding_lookup_op
+from ..graph.ops_misc import PlaceholderOp
+
+
+class Embedding(BaseLayer):
+    def __init__(self, num_embeddings, embedding_dim, initializer=None,
+                 name="embedding", ctx=None):
+        self.embedding_table = PlaceholderOp(
+            name + "_table",
+            initializer=initializer or init.XavierNormalInit(
+                (num_embeddings, embedding_dim)),
+            trainable=True, ctx=ctx)
+        self.embedding_table.is_embed = True
+
+    def __call__(self, x):
+        return embedding_lookup_op(self.embedding_table, x)

@@ -1,0 +1,320 @@
+"""MoE layers: Expert, MoELayer, and the gate family.
+
+Reference: layers/moe_layer.py (Expert :6-44, MoELayer :45-133) and
+layers/TopGate.py (topkgating :14-54, TopKGate :56-78, HashGate,
+KTop1Gate, SAMGate, BalanceGate).  Graph structure preserved: gate ->
+layout_transform capacity dispatch -> alltoall over 'ep' -> per-local-expert
+FFN -> alltoall back -> reverse_layout_transform weighted combine.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .base import BaseLayer
+from .. import initializers as init
+from ..graph import (
+    softmax_op, topk_idx_op, split_op, one_hot_op, array_reshape_op,
+    cumsum_with_bias_op, reduce_sum_op, reduce_mean_op, reducesumaxiszero_op,
+    mul_op, matmul_op, broadcastto_op, concatenate_op, relu_op, mul_byconst_op,
+    indexing_op, scatter1d_op, addbyconst_op, add_op,
+)
+from ..graph.ops_misc import Variable
+from ..graph.ops_moe import (
+    layout_transform_op, reverse_layout_transform_op, alltoall_op,
+    halltoall_op, balance_assignment_op, group_topk_idx_op, sam_group_sum_op,
+    sam_max_op,
+)
+
+
+def balance_loss(gates, mask, num_experts):
+    """Aux load-balance loss (reference TopGate.py:6-12)."""
+    me = reduce_mean_op(gates, axes=0)
+    ce = reduce_mean_op(mask, axes=0)
+    return mul_byconst_op(reducesumaxiszero_op(me * ce), float(num_experts))
+
+
+def topkgating(logits, k, capacity_factor, num_tokens, num_experts, embed_dim):
+    """Top-k gating with static capacity (reference TopGate.py:14-54).
+    Returns (l_aux, indices_s, location_s, gates_s, capacity)."""
+    gates = softmax_op(logits)
+    capacity = k * math.ceil((num_tokens / num_experts) * capacity_factor)
+    topk_indices = topk_idx_op(gates, topk=k)
+    indices_s = [split_op(topk_indices, axes=[1], indices=[i], splits=[k])
+                 for i in range(k)]
+    mask_topk = [array_reshape_op(
+        one_hot_op(indices_s[i], num_classes=num_experts), [-1, num_experts])
+        for i in range(k)]
+
+    l_aux = balance_loss(gates, mask_topk[0], num_experts)
+
+    locations1 = cumsum_with_bias_op(mask_topk[0], bias=-1, dim=0)
+    location_s = [reduce_sum_op(locations1 * mask_topk[0], axes=1)]
+
+    acc_base = None
+    for i in range(1, k):
+        inc = reduce_sum_op(mask_topk[i - 1], axes=0, keepdims=True)
+        acc_base = inc if acc_base is None else acc_base + inc
+        locations2 = cumsum_with_bias_op(mask_topk[i], bias=-1, dim=0)
+        locations2 = locations2 + broadcastto_op(acc_base, locations2)
+        location_s.append(reduce_sum_op(locations2 * mask_topk[i], axes=1))
+        l_aux = l_aux + balance_loss(gates, mask_topk[i], num_experts)
+
+    gates_s = [reduce_sum_op(mul_op(gates, m), axes=1) for m in mask_topk]
+    return l_aux, indices_s, location_s, gates_s, capacity
+
+
+class TopKGate(BaseLayer):
+    """reference TopGate.py:56-78."""
+
+    def __init__(self, embed_dim, num_tokens, num_experts, k=1,
+                 capacity_factor=1.0, eval_capacity_factor=1.0,
+                 initializer=None, name="TopK_Gate"):
+        self.embed_dim = embed_dim
+        self.num_experts = num_experts
+        self.top_k = k
+        self.num_tokens = num_tokens
+        self.capacity_factor = capacity_factor
+        self.initializer = initializer or init.GenXavierUniform()
+        self.name = name
+        # params created once here (not per __call__) so the gate is
+        # shared across train/eval subgraphs
+        self.weight = self.initializer(
+            shape=(self.embed_dim, self.num_experts),
+            name=self.name + "_linear_weight")
+        self.bias = init.zeros(shape=(1, self.num_experts),
+                               name=self.name + "_linear_bias")
+
+    def __call__(self, x):
+        logits = matmul_op(x, self.weight)
+        logits = logits + broadcastto_op(self.bias, logits)
+        return topkgating(logits, self.top_k, self.capacity_factor,
+                          self.num_tokens, self.num_experts, self.embed_dim)
+
+
+class HashGate(BaseLayer):
+    """Deterministic hash routing (reference TopGate.py HashGate): expert =
+    token_id mod num_experts; gates are 1."""
+
+    def __init__(self, embed_dim, num_tokens, num_experts,
+                 capacity_factor=1.0, name="Hash_Gate"):
+        self.num_tokens = num_tokens
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.name = name
+        idx_val = (np.arange(num_tokens) % num_experts).astype(
+            np.float32).reshape(-1, 1)
+        self.indices = Variable(name + "_hash_idx", value=idx_val,
+                                trainable=False)
+        self.ones = Variable(name + "_ones",
+                             value=np.ones((num_tokens,), np.float32),
+                             trainable=False)
+
+    def __call__(self, x):
+        n, e = self.num_tokens, self.num_experts
+        capacity = math.ceil((n / e) * self.capacity_factor)
+        mask = array_reshape_op(one_hot_op(self.indices, num_classes=e),
+                                [-1, e])
+        locations = cumsum_with_bias_op(mask, bias=-1, dim=0)
+        location_s = [reduce_sum_op(locations * mask, axes=1)]
+        return None, [self.indices], location_s, [self.ones], capacity
+
+
+class KTop1Gate(BaseLayer):
+    """Grouped top-1 gating (reference TopGate.py KTop1Gate): pick the top
+    group by aggregate mass, then top-1 expert inside the group."""
+
+    def __init__(self, embed_dim, num_tokens, num_experts, num_local_gpus=8,
+                 capacity_factor=1.0, initializer=None, name="KTop1_Gate"):
+        self.embed_dim = embed_dim
+        self.num_tokens = num_tokens
+        self.num_experts = num_experts
+        self.num_local_gpus = num_local_gpus
+        self.capacity_factor = capacity_factor
+        self.initializer = initializer or init.GenXavierUniform()
+        self.name = name
+        self.weight = self.initializer(shape=(embed_dim, num_experts),
+                                       name=name + "_linear_weight")
+
+    def __call__(self, x):
+        e = self.num_experts
+        logits = matmul_op(x, self.weight)
+        gates = softmax_op(logits)
+        group_mass = sam_group_sum_op(gates, self.num_local_gpus)
+        top1_group = topk_idx_op(group_mass, topk=1)
+        group_size = e // self.num_local_gpus
+        idx = group_topk_idx_op(gates, top1_group, topk=1,
+                                num_local_gpus=group_size)
+        capacity = math.ceil(
+            (self.num_tokens / e) * self.capacity_factor)
+        mask = array_reshape_op(one_hot_op(idx, num_classes=e), [-1, e])
+        l_aux = balance_loss(gates, mask, e)
+        locations = cumsum_with_bias_op(mask, bias=-1, dim=0)
+        location_s = [reduce_sum_op(locations * mask, axes=1)]
+        gates_s = [reduce_sum_op(mul_op(gates, mask), axes=1)]
+        return l_aux, [idx], location_s, gates_s, capacity
+
+
+class SAMGate(KTop1Gate):
+    """SAM gate (reference TopGate.py SAMGate + SamMax kernels): grouped
+    top-1 with margin-based re-weighting of out-of-group experts."""
+
+    def __call__(self, x):
+        e = self.num_experts
+        logits = matmul_op(x, self.weight)
+        gates = softmax_op(logits)
+        group_mass = sam_group_sum_op(gates, self.num_local_gpus)
+        top1_group = topk_idx_op(group_mass, topk=1)
+        group_size = e // self.num_local_gpus
+        idx = group_topk_idx_op(gates, top1_group, topk=1,
+                                num_local_gpus=group_size)
+        margin = sam_max_op(gates, top1_group, idx, group_size)
+        capacity = math.ceil((self.num_tokens / e) * self.capacity_factor)
+        mask = array_reshape_op(one_hot_op(idx, num_classes=e), [-1, e])
+        l_aux = balance_loss(gates, mask, e) + reduce_mean_op(
+            reduce_sum_op(margin, axes=1), axes=0)
+        locations = cumsum_with_bias_op(mask, bias=-1, dim=0)
+        location_s = [reduce_sum_op(locations * mask, axes=1)]
+        gates_s = [reduce_sum_op(mul_op(gates, mask), axes=1)]
+        return l_aux, [idx], location_s, gates_s, capacity
+
+
+class BalanceGate(BaseLayer):
+    """Optimal balanced assignment gate (reference moe_layer.py:95-133):
+    auction-solve a token->expert assignment with perfectly even load."""
+
+    def __init__(self, embed_dim, num_tokens, num_experts, initializer=None,
+                 name="Balance_Gate"):
+        self.embed_dim = embed_dim
+        self.num_tokens = num_tokens
+        self.num_experts = num_experts
+        self.initializer = initializer or init.GenXavierUniform()
+        self.name = name
+        self.centroid = self.initializer(
+            shape=(embed_dim, num_experts), name=name + "_centroid")
+
+    def __call__(self, x):
+        scores = matmul_op(x, self.centroid)
+        indice = balance_assignment_op(scores)
+        return indice, self.centroid
+
+
+class Expert(BaseLayer):
+    """Two-matmul FFN expert (reference moe_layer.py:6-44)."""
+
+    def __init__(self, embed_dim, ffn_dim, dropout_rate=0.0, initializer=None,
+                 bias=False, activation=None, name="expert"):
+        self.embed_dim = embed_dim
+        self.ffn_dim = ffn_dim
+        self.keep_prob = 1 - dropout_rate
+        self.bias = bias
+        if isinstance(activation, str):
+            assert activation == "relu"
+            activation = relu_op
+        self.activation = activation
+        self.initializer = initializer or init.GenXavierUniform()
+        self.name = name
+        self.w1 = self.initializer(shape=(embed_dim, ffn_dim),
+                                   name=name + "_weight_1")
+        self.w2 = self.initializer(shape=(ffn_dim, embed_dim),
+                                   name=name + "_weight_2")
+
+    def __call__(self, x):
+        x = array_reshape_op(x, [-1, self.embed_dim])
+        x = matmul_op(x, self.w1)
+        if self.activation is not None:
+            x = self.activation(x)
+        x = matmul_op(x, self.w2)
+        return x
+
+
+class MoELayer(BaseLayer):
+    """reference moe_layer.py:45-133 (both 'MoELayer' and
+    'BalanceAssignmentLayer' modes)."""
+
+    def __init__(self, gate=None, experts=None, num_tokens=None,
+                 embed_dim=None, all2all_size=None, name="MoELayer",
+                 device_id=None, top=None, hierarchical=False):
+        self.name = name
+        self.gate = gate
+        self.experts = experts
+        self.num_local_experts = len(experts)
+        self.num_tokens = num_tokens
+        self.embed_dim = embed_dim
+        self.all2all_size = all2all_size or 1
+        self.top = top
+        self.hierarchical = hierarchical
+        if name == "BalanceAssignmentLayer":
+            self.arange_array = Variable(
+                "arange_array",
+                value=np.arange(num_tokens).astype(np.float32),
+                trainable=False)
+
+    def _a2a(self, x):
+        if self.hierarchical:
+            return halltoall_op(x)
+        return alltoall_op(x)
+
+    def __call__(self, x):
+        if self.name == "BalanceAssignmentLayer":
+            return self._balance_forward(x)
+        reshaped = array_reshape_op(x, [-1, self.embed_dim])
+        l_aux, indices_s, location_s, gates_s, capacity = self.gate(reshaped)
+        total_experts = self.num_local_experts * self.all2all_size
+        dispatched = layout_transform_op(
+            reshaped, indices_s, location_s, capacity, total_experts)
+        dispatched = self._a2a(dispatched)
+        dispatched = array_reshape_op(
+            dispatched,
+            [self.all2all_size, self.num_local_experts, -1, self.embed_dim])
+        outputs = []
+        for i in range(self.num_local_experts):
+            token_i = split_op(dispatched, axes=[1], indices=[i],
+                               splits=[self.num_local_experts])
+            outputs.append(self.experts[i](token_i))
+        expert_output = concatenate_op(outputs, axis=0)
+        expert_output = self._a2a(expert_output)
+        expert_output = array_reshape_op(expert_output, [-1, self.embed_dim])
+        combined = reverse_layout_transform_op(
+            expert_output, indices_s, location_s, gates_s, capacity,
+            total_experts)
+        return combined, l_aux
+
+    def _balance_forward(self, x):
+        reshaped = array_reshape_op(x, [-1, self.embed_dim])
+        # indice is a permutation of token ids: per-expert contiguous blocks
+        # of N/E tokens each (balance_assignment_op output parity)
+        indice, centroid = self.gate(reshaped)
+        reverse_indice = scatter1d_op(self.arange_array, indice,
+                                      self.arange_array)
+        routed_input = indexing_op(reshaped, indice)
+        routed_input = self._a2a(routed_input)
+        reshaped_routed = array_reshape_op(
+            routed_input,
+            [self.all2all_size, self.num_local_experts, -1, self.embed_dim])
+        outputs = []
+        for i in range(self.num_local_experts):
+            token_i = split_op(reshaped_routed, axes=[1], indices=[i],
+                               splits=[self.num_local_experts])
+            outputs.append(self.experts[i](token_i))
+        expert_output = concatenate_op(outputs, axis=0)
+        # routed position j belongs to expert j // capacity
+        e_total = self.num_experts_total()
+        cap = self.num_tokens // e_total
+        expert_of_pos = Variable(
+            f"{self.name}_expert_of_pos",
+            value=np.eye(e_total, dtype=np.float32)[
+                np.repeat(np.arange(e_total), cap)],
+            trainable=False)
+        alpha = softmax_op(matmul_op(routed_input, centroid))
+        alpha_sel = reduce_sum_op(mul_op(alpha, expert_of_pos), axes=1)
+        w = broadcastto_op(array_reshape_op(alpha_sel, [-1, 1]), expert_output)
+        final = w * expert_output + (1.0 - w) * routed_input
+        final = indexing_op(final, reverse_indice)
+        final = self._a2a(final)
+        return final
+
+    def num_experts_total(self):
+        return self.num_local_experts * self.all2all_size

@@ -1,0 +1,12 @@
+"""Reshape layer (reference layers/reshape.py)."""
+
+from .base import BaseLayer
+from ..graph import array_reshape_op
+
+
+class Reshape(BaseLayer):
+    def __init__(self, shape):
+        self.shape = shape
+
+    def __call__(self, x):
+        return array_reshape_op(x, self.shape)

@@ -1,0 +1,138 @@
+"""LR schedulers: Fixed/Step/MultiStep/Exponential/ReduceOnPlateau + warmup.
+
+Reference: python/hetu/lr_scheduler.py (142 LoC).  Schedules here are pure
+functions of the jitted step counter so they trace into the step program
+(the reference recomputes lr host-side each step).  ReduceOnPlateau is
+inherently host-driven (depends on observed loss) and keeps a host API.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def value(self, step):
+        raise NotImplementedError
+
+    def get(self, step=0):
+        return float(self.value(jnp.asarray(step)))
+
+
+class FixedScheduler(LRScheduler):
+    def __init__(self, learning_rate):
+        self.learning_rate = learning_rate
+
+    def value(self, step):
+        return jnp.asarray(self.learning_rate, jnp.float32)
+
+
+class StepScheduler(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1):
+        self.learning_rate = learning_rate
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def value(self, step):
+        k = (step // self.step_size).astype(jnp.float32)
+        return self.learning_rate * (self.gamma ** k)
+
+
+class MultiStepScheduler(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1):
+        self.learning_rate = learning_rate
+        self.milestones = sorted(milestones)
+        self.gamma = gamma
+
+    def value(self, step):
+        k = jnp.zeros((), jnp.float32)
+        for m in self.milestones:
+            k = k + (step >= m).astype(jnp.float32)
+        return self.learning_rate * (self.gamma ** k)
+
+
+class ExponentialScheduler(LRScheduler):
+    def __init__(self, learning_rate, gamma=0.99, step_size=1):
+        self.learning_rate = learning_rate
+        self.gamma = gamma
+        self.step_size = step_size
+
+    def value(self, step):
+        k = (step // self.step_size).astype(jnp.float32)
+        return self.learning_rate * (self.gamma ** k)
+
+
+class LinearWarmupScheduler(LRScheduler):
+    """Linear warmup then linear/constant decay — used by BERT pretraining
+    (reference examples/nlp/bert uses torch-style schedules)."""
+
+    def __init__(self, learning_rate, warmup_steps, total_steps=None,
+                 end_lr=0.0):
+        self.learning_rate = learning_rate
+        self.warmup_steps = max(1, warmup_steps)
+        self.total_steps = total_steps
+        self.end_lr = end_lr
+
+    def value(self, step):
+        step = step.astype(jnp.float32)
+        warm = self.learning_rate * step / self.warmup_steps
+        if self.total_steps is None:
+            after = jnp.asarray(self.learning_rate, jnp.float32)
+        else:
+            frac = jnp.clip((step - self.warmup_steps)
+                            / max(1, self.total_steps - self.warmup_steps), 0, 1)
+            after = self.learning_rate + frac * (self.end_lr - self.learning_rate)
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class CosineScheduler(LRScheduler):
+    def __init__(self, learning_rate, total_steps, warmup_steps=0, end_lr=0.0):
+        self.learning_rate = learning_rate
+        self.total_steps = total_steps
+        self.warmup_steps = warmup_steps
+        self.end_lr = end_lr
+
+    def value(self, step):
+        step = step.astype(jnp.float32)
+        warm = self.learning_rate * step / max(1, self.warmup_steps)
+        frac = jnp.clip((step - self.warmup_steps)
+                        / max(1, self.total_steps - self.warmup_steps), 0, 1)
+        cos = self.end_lr + 0.5 * (self.learning_rate - self.end_lr) \
+            * (1 + jnp.cos(jnp.pi * frac))
+        if self.warmup_steps == 0:
+            return cos
+        return jnp.where(step < self.warmup_steps, warm, cos)
+
+
+class ReduceOnPlateauScheduler(LRScheduler):
+    """Host-driven: call ``step_metric(value)`` each eval; ``value`` reads
+    the current lr (reference lr_scheduler.py ReduceOnPlateau)."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, min_lr=0.0):
+        self.lr = learning_rate
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.min_lr = min_lr
+        self.best = None
+        self.bad = 0
+
+    def step_metric(self, metric):
+        metric = float(metric)
+        better = (self.best is None
+                  or (self.mode == "min" and metric < self.best - self.threshold)
+                  or (self.mode == "max" and metric > self.best + self.threshold))
+        if better:
+            self.best = metric
+            self.bad = 0
+        else:
+            self.bad += 1
+            if self.bad > self.patience:
+                self.lr = max(self.min_lr, self.lr * self.factor)
+                self.bad = 0
+        return self.lr
+
+    def value(self, step):
+        return jnp.asarray(self.lr, jnp.float32)

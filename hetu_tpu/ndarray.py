@@ -1,0 +1,107 @@
+"""Array facade for API parity with the reference's ndarray module.
+
+Reference: python/hetu/ndarray.py (NDArray:140, ND_Sparse_Array:460,
+IndexedSlices:507, array/empty/sparse_array:405-504).  On TPU, jax.Array
+already provides device arrays, lazy views, and dlpack interop; this module
+keeps the reference's construction helpers so example scripts and tests run
+unchanged.  ``NDArray`` IS ``jax.Array`` (alias), and ``array()`` accepts a
+DLContext placement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .context import (  # re-export placement helpers (reference parity)
+    DLContext, cpu, gpu, tpu, rcpu, rgpu, rtpu, is_gpu_ctx,
+)
+
+NDArray = jax.Array
+
+
+def _device_for(ctx):
+    if ctx is None:
+        return None
+    if isinstance(ctx, DLContext):
+        if ctx.device_type == "cpu":
+            cpus = jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+            return cpus[min(ctx.device_id, len(cpus) - 1)]
+        devs = jax.devices()
+        return devs[min(ctx.device_id, len(devs) - 1)]
+    return ctx
+
+
+def array(arr, ctx=None, dtype=jnp.float32):
+    """reference ndarray.array(arr, ctx)"""
+    a = jnp.asarray(np.asarray(arr), dtype=dtype)
+    dev = _device_for(ctx)
+    return jax.device_put(a, dev) if dev is not None else a
+
+
+def empty(shape, ctx=None, dtype=jnp.float32):
+    a = jnp.zeros(tuple(shape), dtype=dtype)
+    dev = _device_for(ctx)
+    return jax.device_put(a, dev) if dev is not None else a
+
+
+def numpyasdlarrayhandle(arr):  # reference parity (ndarray.py)
+    return jnp.asarray(arr)
+
+
+class IndexedSlices:
+    """Host-side sparse pair (indices, values) — reference ndarray.py:507.
+
+    Graph-level sparse adjoints use graph.ops_embed.IndexedSlicesOp; this
+    class serves the PS/dataloader paths that pass sparse host data.
+    """
+
+    def __init__(self, indices=None, values=None, dense_shape=None):
+        self.indices = indices
+        self.values = values
+        self.dense_shape = dense_shape
+
+    def get_dense_shape(self):
+        assert self.dense_shape is not None
+        return self.dense_shape
+
+    def deduplicate(self):
+        """Merge duplicate indices (reference ndarray.py:deduplicate)."""
+        idx = np.asarray(self.indices).reshape(-1)
+        vals = np.asarray(self.values).reshape(idx.shape[0], -1)
+        uniq, inv = np.unique(idx, return_inverse=True)
+        merged = np.zeros((uniq.shape[0], vals.shape[1]), vals.dtype)
+        np.add.at(merged, inv, vals)
+        self.indices, self.values = uniq, merged
+        return self
+
+    def to_dense(self):
+        self.deduplicate()
+        assert self.dense_shape is not None
+        dense = np.zeros(self.dense_shape, np.float32)
+        dense[np.asarray(self.indices)] = np.asarray(self.values)
+        return jnp.asarray(dense)
+
+
+class ND_Sparse_Array:
+    """CSR sparse array (reference ndarray.py:460) kept as host-side COO/CSR
+    triplets; consumed by csrmm/csrmv ops which densify on device."""
+
+    def __init__(self, data, row, col, nrow, ncol):
+        self.data = data
+        self.row = row
+        self.col = col
+        self.nrow = nrow
+        self.ncol = ncol
+
+    @property
+    def shape(self):
+        return (self.nrow, self.ncol)
+
+
+def sparse_array(values, indices, shape, ctx=None):
+    """COO constructor (reference ndarray.sparse_array)."""
+    row, col = indices
+    return ND_Sparse_Array(jnp.asarray(values), jnp.asarray(row),
+                           jnp.asarray(col), shape[0], shape[1])

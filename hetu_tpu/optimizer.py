@@ -1,0 +1,331 @@
+"""Optimizers: SGD / Momentum / AdaGrad / Adam / AdamW / Lamb.
+
+Reference: python/hetu/optimizer.py (SGDOptimizer:171 ... LambOptimizer:493,
+OptimizerOp:103, minimize:69-89) with fused CUDA kernels in
+src/ops/Optimizers.cu and row-sparse variants in OptimizersSparse.cu.
+
+TPU-native design: each optimizer is a *pure* update function applied inside
+the jitted step (XLA fuses the whole update chain); the reference's
+backward_hook graph-splicing of AllReduce/PS comm ops (optimizer.py:145-164)
+is unnecessary — gradient reduction comes from sharding annotations, and
+embedding-table updates take the row-sparse path when the adjoint is an
+IndexedSlicesOp.
+
+Optimizer slot state (momentum/m/v buffers) is checkpointable — strictly
+better than the reference, which loses it on save (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .graph.node import Op, TraceContext
+from .graph.autodiff import gradients, find_topo_sort
+from .graph.ops_misc import PlaceholderOp
+from .graph.ops_embed import IndexedSlicesOp
+
+
+def merge_duplicate_rows(ids, rows):
+    """Sum rows sharing an id so every duplicate carries the identical
+    total (reference: IndexedSlices.deduplicate, ndarray.py:507-606 /
+    src/ops/IndexedSlices.cu — but jit-compatible: static shapes, no
+    compaction; duplicate positions stay, carrying equal merged values)."""
+    order = jnp.argsort(ids)
+    sid = ids[order]
+    srows = rows[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    group = jnp.cumsum(first) - 1
+    totals = jnp.zeros_like(srows).at[group].add(srows)
+    trows = totals[group]
+    inv = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    return ids, trows[inv]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, l2reg=0.0):
+        self.learning_rate = learning_rate
+        self.l2reg = l2reg
+        self.name = type(self).__name__
+
+    # ------------------------------------------------------------------ #
+    # graph-side API (reference optimizer.py:36-101)
+    # ------------------------------------------------------------------ #
+
+    def get_var_list(self, loss):
+        if isinstance(loss, list):
+            topo = find_topo_sort(loss)
+        else:
+            topo = find_topo_sort([loss])
+        return [n for n in topo
+                if isinstance(n, PlaceholderOp) and n.trainable]
+
+    def minimize(self, loss, var_list=None):
+        if var_list is None:
+            var_list = self.get_var_list(loss)
+        grads = gradients(loss, var_list)
+        return OptimizerOp(grads, var_list, self)
+
+    # ------------------------------------------------------------------ #
+    # pure update functions (jit-traced)
+    # ------------------------------------------------------------------ #
+
+    def lr_value(self, step):
+        lr = self.learning_rate
+        if hasattr(lr, "value"):
+            return lr.value(step)
+        return jnp.asarray(lr, jnp.float32)
+
+    def init_state_one(self, p):
+        """Slot state pytree for one parameter (None = stateless)."""
+        return None
+
+    def update_one(self, p, g, s, lr, step):
+        raise NotImplementedError
+
+    def sparse_update_one(self, p, ids, rows, s, lr, step):
+        """Row-sparse update: default densifies; subclasses override with a
+        gather-update-scatter on touched rows only (lazy update, matching
+        src/ops/OptimizersSparse.cu semantics).
+
+        Contract: ``rows`` are pre-merged per id (duplicate ids carry the
+        identical summed row — see ``merge_duplicate_rows``), so overrides
+        may use set-style scatters; duplicate writes are identical."""
+        dense = jnp.zeros_like(p).at[ids].set(rows)
+        return self.update_one(p, dense, s, lr, step)
+
+    def _apply_l2(self, p, g):
+        if self.l2reg > 0:
+            return g + self.l2reg * p
+        return g
+
+
+class SGDOptimizer(Optimizer):
+    """reference optimizer.py:171."""
+
+    def update_one(self, p, g, s, lr, step):
+        g = self._apply_l2(p, g)
+        return p - lr * g, s
+
+    def sparse_update_one(self, p, ids, rows, s, lr, step):
+        # rows are merged per id; set-style write is duplicate-safe
+        if self.l2reg > 0:
+            rows = rows + self.l2reg * p[ids]
+        return p.at[ids].set(p[ids] - lr * rows), s
+
+
+class MomentumOptimizer(Optimizer):
+    """reference optimizer.py:229 (momentum + nesterov flag)."""
+
+    def __init__(self, learning_rate, momentum=0.9, nesterov=False, l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def init_state_one(self, p):
+        return {"v": jnp.zeros_like(p)}
+
+    def update_one(self, p, g, s, lr, step):
+        g = self._apply_l2(p, g)
+        v = self.momentum * s["v"] - lr * g
+        if self.nesterov:
+            p = p + self.momentum * v - lr * g
+        else:
+            p = p + v
+        return p, {"v": v}
+
+
+class AdaGradOptimizer(Optimizer):
+    """reference optimizer.py:293."""
+
+    def __init__(self, learning_rate, initial_accumulator_value=0.0,
+                 eps=1e-7, l2reg=0.0):
+        super().__init__(learning_rate, l2reg)
+        self.initial_accumulator_value = initial_accumulator_value
+        self.eps = eps
+
+    def init_state_one(self, p):
+        return {"acc": jnp.full_like(p, self.initial_accumulator_value)}
+
+    def update_one(self, p, g, s, lr, step):
+        g = self._apply_l2(p, g)
+        acc = s["acc"] + g * g
+        return p - lr * g / (jnp.sqrt(acc) + self.eps), {"acc": acc}
+
+    def sparse_update_one(self, p, ids, rows, s, lr, step):
+        if self.l2reg > 0:
+            rows = rows + self.l2reg * p[ids]
+        acc = s["acc"].at[ids].set(s["acc"][ids] + rows * rows)
+        denom = jnp.sqrt(acc[ids]) + self.eps
+        return p.at[ids].set(p[ids] - lr * rows / denom), {"acc": acc}
+
+
+class AdamOptimizer(Optimizer):
+    """reference optimizer.py:356 (beta1/beta2/epsilon; bias-corrected)."""
+
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-7, l2reg=0.0, amsgrad=False):
+        super().__init__(learning_rate, l2reg)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.amsgrad = amsgrad
+
+    def init_state_one(self, p):
+        s = {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+        if self.amsgrad:
+            s["vmax"] = jnp.zeros_like(p)
+        return s
+
+    def update_one(self, p, g, s, lr, step):
+        g = self._apply_l2(p, g)
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        v = self.beta2 * s["v"] + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        ns = {"m": m, "v": v}
+        if self.amsgrad:
+            vmax = jnp.maximum(s["vmax"], v)
+            ns["vmax"] = vmax
+            vhat = vmax / (1 - self.beta2 ** t)
+        else:
+            vhat = v / (1 - self.beta2 ** t)
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), ns
+
+    def sparse_update_one(self, p, ids, rows, s, lr, step):
+        """Lazy Adam: only touched rows update their moments."""
+        if self.l2reg > 0:
+            rows = rows + self.l2reg * p[ids]
+        t = step.astype(jnp.float32) + 1.0
+        m_rows = self.beta1 * s["m"][ids] + (1 - self.beta1) * rows
+        v_rows = self.beta2 * s["v"][ids] + (1 - self.beta2) * rows * rows
+        m = s["m"].at[ids].set(m_rows)
+        v = s["v"].at[ids].set(v_rows)
+        mhat = m_rows / (1 - self.beta1 ** t)
+        ns = {"m": m, "v": v}
+        if self.amsgrad:
+            vmax_rows = jnp.maximum(s["vmax"][ids], v_rows)
+            ns["vmax"] = s["vmax"].at[ids].set(vmax_rows)
+            vhat = vmax_rows / (1 - self.beta2 ** t)
+        else:
+            vhat = v_rows / (1 - self.beta2 ** t)
+        upd = -lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return p.at[ids].set(p[ids] + upd), ns
+
+
+class AdamWOptimizer(AdamOptimizer):
+    """reference optimizer.py:429 — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-7, weight_decay=0.01):
+        super().__init__(learning_rate, beta1, beta2, epsilon, l2reg=0.0)
+        self.weight_decay = weight_decay
+
+    def update_one(self, p, g, s, lr, step):
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        v = self.beta2 * s["v"] + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        p = p - lr * (mhat / (jnp.sqrt(vhat) + self.epsilon)
+                      + self.weight_decay * p)
+        return p, {"m": m, "v": v}
+
+    def sparse_update_one(self, p, ids, rows, s, lr, step):
+        """Lazy AdamW: decoupled decay applied to the touched rows only
+        (matching the reference's row-sparse optimizer semantics,
+        src/ops/OptimizersSparse.cu)."""
+        t = step.astype(jnp.float32) + 1.0
+        m_rows = self.beta1 * s["m"][ids] + (1 - self.beta1) * rows
+        v_rows = self.beta2 * s["v"][ids] + (1 - self.beta2) * rows * rows
+        m = s["m"].at[ids].set(m_rows)
+        v = s["v"].at[ids].set(v_rows)
+        mhat = m_rows / (1 - self.beta1 ** t)
+        vhat = v_rows / (1 - self.beta2 ** t)
+        upd = -lr * (mhat / (jnp.sqrt(vhat) + self.epsilon)
+                     + self.weight_decay * p[ids])
+        return p.at[ids].set(p[ids] + upd), {"m": m, "v": v}
+
+
+class LambOptimizer(AdamOptimizer):
+    """reference optimizer.py:493 — layerwise trust-ratio Adam."""
+
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-7, weight_decay=0.01):
+        super().__init__(learning_rate, beta1, beta2, epsilon, l2reg=0.0)
+        self.weight_decay = weight_decay
+
+    def update_one(self, p, g, s, lr, step):
+        m = self.beta1 * s["m"] + (1 - self.beta1) * g
+        v = self.beta2 * s["v"] + (1 - self.beta2) * g * g
+        update = m / (jnp.sqrt(v) + self.epsilon) + self.weight_decay * p
+        wnorm = jnp.linalg.norm(p.reshape(-1))
+        unorm = jnp.linalg.norm(update.reshape(-1))
+        ratio = jnp.where(wnorm > 0, jnp.where(unorm > 0, wnorm / unorm, 1.0), 1.0)
+        return p - lr * ratio * update, {"m": m, "v": v}
+
+    def sparse_update_one(self, p, ids, rows, s, lr, step):
+        """Row-sparse Lamb: per-row trust ratio over the touched rows."""
+        m_rows = self.beta1 * s["m"][ids] + (1 - self.beta1) * rows
+        v_rows = self.beta2 * s["v"][ids] + (1 - self.beta2) * rows * rows
+        m = s["m"].at[ids].set(m_rows)
+        v = s["v"].at[ids].set(v_rows)
+        p_rows = p[ids]
+        upd = m_rows / (jnp.sqrt(v_rows) + self.epsilon) \
+            + self.weight_decay * p_rows
+        wnorm = jnp.linalg.norm(p_rows, axis=-1, keepdims=True)
+        unorm = jnp.linalg.norm(upd, axis=-1, keepdims=True)
+        ratio = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
+        return p.at[ids].set(p[ids] - lr * ratio * upd), {"m": m, "v": v}
+
+
+class OptimizerOp(Op):
+    """Terminal graph node applying parameter updates.
+
+    Reference OptimizerOp (optimizer.py:103-168) splices comm ops in its
+    backward_hook; here ``compute`` consumes traced gradient values and
+    emits new (param, slot-state) values via tc.extra_outputs — the executor
+    threads them out of the jitted step function (with buffer donation, so
+    updates are in-place in HBM).
+    """
+
+    def __init__(self, grads, var_list, optimizer):
+        super().__init__(*grads, name="Optimizer")
+        self.var_list = var_list
+        self.optimizer = optimizer
+        # sparse adjoints are consumed structurally, not evaluated densely
+        self.sparse_inputs = {i for i, g in enumerate(grads)
+                              if isinstance(g, IndexedSlicesOp)}
+
+    def compute(self, input_vals, tc: TraceContext):
+        raise AssertionError("OptimizerOp is handled by the executor")
+
+    def apply(self, grad_vals, tc: TraceContext, opt_state, grad_scale=None):
+        """grad_vals[i] is either a dense array or (ids, rows) for sparse."""
+        opt = self.optimizer
+        lr = opt.lr_value(tc.step)
+        new_state = dict(opt_state)
+        for i, var in enumerate(self.var_list):
+            p = tc.params[var]
+            s = opt_state.get(var.name)
+            if i in self.sparse_inputs:
+                ids, rows = grad_vals[i]
+                ids = ids.astype(jnp.int32).reshape(-1)
+                rows = rows.reshape(-1, rows.shape[-1])
+                if grad_scale is not None:
+                    rows = rows * grad_scale
+                ids, rows = merge_duplicate_rows(ids, rows)
+                new_p, ns = opt.sparse_update_one(p, ids, rows, s, lr, tc.step)
+            else:
+                g = grad_vals[i]
+                if grad_scale is not None:
+                    g = g * grad_scale
+                new_p, ns = opt.update_one(p, g.astype(p.dtype), s, lr, tc.step)
+            tc.extra_outputs[var] = new_p
+            new_state[var.name] = ns
+        return new_state
+
+    def gradient(self, output_grad):
+        raise NotImplementedError
+
+    def init_state(self, params):
+        return {var.name: self.optimizer.init_state_one(params[var])
+                for var in self.var_list}

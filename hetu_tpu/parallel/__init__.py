@@ -1,0 +1,15 @@
+"""Parallelism: meshes, strategies, pipeline schedules, context parallel.
+
+Replaces the reference's L5 (context.py DeviceGroups, communicator/,
+comm-op graph rewriting, distributed_strategies/) with mesh + sharding
+design (SURVEY.md §2.5 mapping table).
+"""
+
+from .mesh import (
+    make_mesh, default_mesh, MeshAxes, local_device_count,
+)
+from . import distributed_strategies
+from .distributed_strategies import (
+    DataParallel, ModelParallel4LM, ExpertParallel, PipelineParallel4LM,
+    BaseSearchingStrategy,
+)

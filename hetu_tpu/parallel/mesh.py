@@ -1,0 +1,77 @@
+"""Device mesh construction — the spine of every parallelism strategy.
+
+Replaces the reference's NCCL communicator bootstrap
+(communicator/mpi_nccl_comm.py:62-250: MPI init, hashed group ids,
+sub-communicators per DeviceGroup).  On TPU a single `jax.sharding.Mesh`
+with named axes ('dp','tp','pp','ep','cp' over ICI; 'dcn' over multi-slice)
+subsumes all communicator groups: collectives are axis-name-addressed and
+XLA routes them over the right interconnect.
+
+Multi-host bring-up is `jax.distributed.initialize()` (replacing
+`wrapped_mpi_nccl_init`, executor.py:60-71).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+# canonical axis order: dcn-ish outermost, fastest-varying innermost so that
+# tp/cp (highest-bandwidth-need) axes map to adjacent ICI neighbors
+AXIS_ORDER = ("dcn", "pp", "dp", "ep", "cp", "tp")
+
+
+@dataclass
+class MeshAxes:
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    cp: int = 1
+    dcn: int = 1
+
+    def total(self):
+        return self.dp * self.tp * self.pp * self.ep * self.cp * self.dcn
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def make_mesh(axes=None, devices=None, **kwargs):
+    """Build a Mesh from axis sizes.  ``axes`` may be a MeshAxes, a dict
+    {'dp': 4, 'tp': 2}, or kwargs.  Size -1 on one axis means "all remaining
+    devices"."""
+    if axes is None:
+        axes = kwargs
+    if isinstance(axes, MeshAxes):
+        axes = {k: getattr(axes, k) for k in
+                ("dcn", "pp", "dp", "ep", "cp", "tp")}
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    sizes = {k: int(v) for k, v in axes.items()}
+    # resolve a single -1
+    known = math.prod(v for v in sizes.values() if v > 0)
+    for k, v in sizes.items():
+        if v == -1:
+            sizes[k] = n // known
+    names = [a for a in AXIS_ORDER if sizes.get(a, 1) > 1]
+    if not names:
+        names = [next(iter(sizes))] if sizes else ["dp"]
+    dims = [sizes.get(a, 1) for a in names]
+    total = math.prod(dims)
+    assert total <= n, f"mesh {dict(zip(names, dims))} needs {total} devices, have {n}"
+    arr = np.array(devices[:total]).reshape(dims)
+    return Mesh(arr, tuple(names))
+
+
+def default_mesh(dp=None):
+    """All local devices on one 'dp' axis (the AllReduce-DP default,
+    reference DataParallel strategy simple.py:6-39)."""
+    n = dp or jax.device_count()
+    return make_mesh({"dp": n})

@@ -1,0 +1,7 @@
+"""Auto-parallel planner (Galvatron-equivalent, SURVEY.md §2.6).
+
+Searches per-layer (pp, tp, dp, fsdp, cp) strategies with memory/time cost
+models fed by the collective bandwidth probe (profiler.NCCLProfiler) and
+emits mesh + sharding specs.  Modules land incrementally; see
+planner/cost_model.py and planner/search.py once present.
+"""

@@ -1,0 +1,6 @@
+"""Parameter server + HET-style embedding cache (host-side subsystem).
+
+Reference: ps-lite (§2.2 of SURVEY.md) + src/hetu_cache (§2.3).  Built in
+stages: in-process server (this round) -> multi-process ZMQ-free TCP server
+-> C++ hot path.  See server.py / client.py / cache.py.
+"""

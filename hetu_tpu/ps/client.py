@@ -1,0 +1,188 @@
+"""PS client: the ps-lite Worker API surface over in-process or TCP.
+
+Reference: ps-lite Worker (worker/worker.h:19-65: pull/push/dd_pushpull/
+sparse_pull/sparse_push/sd_pushpull/ss_pushpull/parameter_init/save/load/
+wait) and the flat C exports consumed via ctypes (python_binding.cc:8-140:
+Init/Pull/Push/..., ssp_init/ssp_sync/preduce_get_partner/getLoads).
+
+Async semantics parity: push/pull return a ticket; ``wait(ticket)`` blocks
+(reference Worker::wait) — implemented with a small thread pool so PS
+traffic overlaps the jitted device step exactly like the reference overlaps
+PS RPCs with CUDA compute via the d2h stream + PSEvent
+(ParameterServerCommunicate.py:29-36, stream.py:73-87).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+from concurrent.futures import ThreadPoolExecutor, Future
+
+import numpy as np
+
+from .server import PSServer, _send_msg, _recv_msg
+
+
+class _TCPTransport:
+    def __init__(self, host, port):
+        self._local = threading.local()
+        self.host, self.port = host, port
+
+    def _sock(self):
+        if getattr(self._local, "sock", None) is None:
+            s = socket.create_connection((self.host, self.port))
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = s
+        return self._local.sock
+
+    def call(self, method, *args, **kwargs):
+        s = self._sock()
+        _send_msg(s, pickle.dumps((method, args, kwargs)))
+        ok, result = pickle.loads(_recv_msg(s))
+        if not ok:
+            raise RuntimeError(f"PS server error in {method}: {result}")
+        return result
+
+    def close(self):
+        if getattr(self._local, "sock", None) is not None:
+            self._local.sock.close()
+            self._local.sock = None
+
+
+class _LocalTransport:
+    def __init__(self):
+        self.server = PSServer.get()
+
+    def call(self, method, *args, **kwargs):
+        return getattr(self.server, method)(*args, **kwargs)
+
+    def close(self):
+        pass
+
+
+class PSClient:
+    _instance = None
+
+    def __init__(self, transport=None, rank=0, nrank=1):
+        if transport is None:
+            addr = os.environ.get("HETU_PS_ADDR")
+            if addr:
+                host, port = addr.rsplit(":", 1)
+                transport = _TCPTransport(host, int(port))
+            else:
+                transport = _LocalTransport()
+        self.t = transport
+        self.rank = rank
+        self.nrank = nrank
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix="ps-client")
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            cls._instance = PSClient(
+                rank=int(os.environ.get("HETU_PS_RANK", "0")),
+                nrank=int(os.environ.get("HETU_PS_NRANK", "1")))
+        return cls._instance
+
+    def finalize(self):
+        self._pool.shutdown(wait=True)
+        self.t.close()
+        PSClient._instance = None
+
+    # ---------------- Worker API (worker.h:19-65) ---------------- #
+
+    def parameter_init(self, key, shape, init_type="constant", arg1=0.0,
+                       arg2=1.0, seed=0, opt=None, opt_args=None,
+                       param_type=0):
+        return self.t.call("param_init", key, tuple(shape), init_type, arg1,
+                           arg2, seed, opt, opt_args, param_type)
+
+    def pull(self, key, async_=False):
+        if async_:
+            return self._pool.submit(self.t.call, "pull", key)
+        return self.t.call("pull", key)
+
+    def push(self, key, grad, async_=False):
+        grad = np.asarray(grad, np.float32)
+        if async_:
+            return self._pool.submit(self.t.call, "push", key, grad)
+        return self.t.call("push", key, grad)
+
+    def dd_pushpull(self, key, grad, async_=False):
+        grad = np.asarray(grad, np.float32)
+        if async_:
+            return self._pool.submit(self.t.call, "dd_pushpull", key, grad)
+        return self.t.call("dd_pushpull", key, grad)
+
+    def sparse_pull(self, key, ids, async_=False):
+        ids = np.asarray(ids, np.int64)
+        if async_:
+            return self._pool.submit(self.t.call, "sparse_pull", key, ids)
+        return self.t.call("sparse_pull", key, ids)
+
+    def sparse_push(self, key, ids, rows, async_=False):
+        ids = np.asarray(ids, np.int64)
+        rows = np.asarray(rows, np.float32)
+        if async_:
+            return self._pool.submit(self.t.call, "sparse_push", key, ids, rows)
+        return self.t.call("sparse_push", key, ids, rows)
+
+    def sd_pushpull(self, key, ids, rows, pull_ids=None, async_=False):
+        ids = np.asarray(ids, np.int64)
+        rows = np.asarray(rows, np.float32)
+        if async_:
+            return self._pool.submit(self.t.call, "sd_pushpull", key, ids,
+                                     rows, pull_ids)
+        return self.t.call("sd_pushpull", key, ids, rows, pull_ids)
+
+    def ss_pushpull(self, key, ids, rows, pull_ids, async_=False):
+        return self.sd_pushpull(key, ids, rows, pull_ids, async_=async_)
+
+    def wait(self, ticket):
+        if isinstance(ticket, Future):
+            return ticket.result()
+        return ticket
+
+    def save(self, key, path):
+        os.makedirs(path, exist_ok=True)
+        return self.t.call("param_save", key, path)
+
+    def load(self, key, path):
+        return self.t.call("param_load", key, path)
+
+    def clear(self, key):
+        return self.t.call("param_clear", key)
+
+    # ---------------- SSP / BSP / preduce ---------------- #
+
+    def ssp_init(self, group=0, bound=0):
+        return self.t.call("ssp_init", group, self.rank, bound)
+
+    def ssp_sync(self, group=0):
+        return self.t.call("ssp_sync", group, self.rank)
+
+    def BarrierWorker(self, group=0):
+        return self.t.call("barrier", group, self.rank, self.nrank)
+
+    def preduce_get_partner(self, key, max_worker, wait_time):
+        return self.t.call("preduce_get_partner", key, self.rank,
+                           max_worker, wait_time)
+
+    # ---------------- cache sync ---------------- #
+
+    def sync_embedding(self, key, ids, stored_versions, bound):
+        return self.t.call("sync_embedding", key, ids, stored_versions, bound)
+
+    def push_embedding(self, key, ids, rows):
+        return self.t.call("push_embedding", key, ids, rows)
+
+    def push_sync_embedding(self, key, ids, rows, sync_ids, stored_versions,
+                            bound):
+        return self.t.call("push_sync_embedding", key, ids, rows, sync_ids,
+                           stored_versions, bound)
+
+    def getLoads(self):
+        return self.t.call("get_loads")

@@ -1,0 +1,454 @@
+"""Parameter server: keyed tensor store with server-side optimizers.
+
+Reference: ps-lite PSHandler<kParameterServer> (PSFHandle.h:17) with
+server-side optimizers (server/optimizer.h:36-275: SGD/Momentum/Nesterov/
+AdaGrad/Adam), Param/Param2D/CacheTable storage (server/param.h), SSP
+clocks (ssp_handler.h), preduce partner matching (preduce_handler.cc), and
+the PSFunc RPC surface (psf/PSFunc.h:33-57: DensePush/Pull, DDPushPull,
+SparsePush/Pull, SDPushPull, SSPushPull, ParamInit/Clear/Save/Load,
+SyncEmbedding/PushEmbedding, SSPInit/SSPSync, PReduceGetPartner).
+
+TPU-native: the server lives host-side on the TPU-VM (embeddings exceed
+HBM; SURVEY.md §2.2 'TPU equivalent').  Two transports: in-process (zero
+copy, default for single-host) and length-prefixed-pickle TCP for
+multi-process / multi-host.  Numpy is the compute engine server-side — the
+hot sparse rows path is vectorized gather/scatter, the same work the
+reference does in C++ loops.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+
+
+# --------------------------------------------------------------------- #
+# server-side optimizers (reference server/optimizer.h)
+# --------------------------------------------------------------------- #
+
+class ServerOptimizer:
+    def __init__(self, learning_rate=0.1, **kwargs):
+        self.lr = learning_rate
+
+    def init_state(self, shape):
+        return {}
+
+    def apply_dense(self, value, grad, state):
+        raise NotImplementedError
+
+    def apply_sparse(self, value, ids, rows, state):
+        """ids unique-merged client-side or here; default: dense emulation
+        over touched rows."""
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), rows.shape[-1]), rows.dtype)
+        np.add.at(merged, inv, rows)
+        self._sparse_rows(value, uniq, merged, state)
+
+    def _sparse_rows(self, value, uniq, merged, state):
+        value[uniq] -= self.lr * merged
+
+
+class ServerSGD(ServerOptimizer):
+    def apply_dense(self, value, grad, state):
+        value -= self.lr * grad
+
+
+class ServerMomentum(ServerOptimizer):
+    def __init__(self, learning_rate=0.1, momentum=0.9, nesterov=False):
+        super().__init__(learning_rate)
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def init_state(self, shape):
+        return {"v": np.zeros(shape, np.float32)}
+
+    def apply_dense(self, value, grad, state):
+        v = state["v"]
+        v *= self.momentum
+        v -= self.lr * grad
+        if self.nesterov:
+            value += self.momentum * v - self.lr * grad
+        else:
+            value += v
+
+    def _sparse_rows(self, value, uniq, merged, state):
+        v = state["v"]
+        v[uniq] = self.momentum * v[uniq] - self.lr * merged
+        value[uniq] += v[uniq]
+
+
+class ServerNesterov(ServerMomentum):
+    def __init__(self, learning_rate=0.1, momentum=0.9):
+        super().__init__(learning_rate, momentum, nesterov=True)
+
+
+class ServerAdaGrad(ServerOptimizer):
+    def __init__(self, learning_rate=0.1, initial_accumulator_value=0.0,
+                 eps=1e-7):
+        super().__init__(learning_rate)
+        self.init_acc = initial_accumulator_value
+        self.eps = eps
+
+    def init_state(self, shape):
+        return {"acc": np.full(shape, self.init_acc, np.float32)}
+
+    def apply_dense(self, value, grad, state):
+        state["acc"] += grad * grad
+        value -= self.lr * grad / (np.sqrt(state["acc"]) + self.eps)
+
+    def _sparse_rows(self, value, uniq, merged, state):
+        acc = state["acc"]
+        acc[uniq] += merged * merged
+        value[uniq] -= self.lr * merged / (np.sqrt(acc[uniq]) + self.eps)
+
+
+class ServerAdam(ServerOptimizer):
+    def __init__(self, learning_rate=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-7):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.eps = beta1, beta2, epsilon
+
+    def init_state(self, shape):
+        return {"m": np.zeros(shape, np.float32),
+                "v": np.zeros(shape, np.float32), "t": np.zeros((), np.int64)}
+
+    def apply_dense(self, value, grad, state):
+        state["t"] += 1
+        t = float(state["t"])
+        m, v = state["m"], state["v"]
+        m *= self.beta1
+        m += (1 - self.beta1) * grad
+        v *= self.beta2
+        v += (1 - self.beta2) * grad * grad
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        value -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def _sparse_rows(self, value, uniq, merged, state):
+        state["t"] += 1
+        t = float(state["t"])
+        m, v = state["m"], state["v"]
+        m[uniq] = self.beta1 * m[uniq] + (1 - self.beta1) * merged
+        v[uniq] = self.beta2 * v[uniq] + (1 - self.beta2) * merged * merged
+        mhat = m[uniq] / (1 - self.beta1 ** t)
+        vhat = v[uniq] / (1 - self.beta2 ** t)
+        value[uniq] -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+
+SERVER_OPTIMIZERS = {
+    "sgd": ServerSGD, "SGD": ServerSGD,
+    "momentum": ServerMomentum, "Momentum": ServerMomentum,
+    "nesterov": ServerNesterov, "Nesterov": ServerNesterov,
+    "adagrad": ServerAdaGrad, "AdaGrad": ServerAdaGrad,
+    "adam": ServerAdam, "Adam": ServerAdam,
+}
+
+
+class _Param:
+    """One stored tensor + optimizer slot state + per-row versions for the
+    cache-sync protocol (reference server/param.h Param2D/CacheTable)."""
+
+    def __init__(self, value, optimizer):
+        self.value = value
+        self.optimizer = optimizer
+        self.state = optimizer.init_state(value.shape) if optimizer else {}
+        # per-row version counters (only meaningful for 2D tables)
+        self.versions = np.zeros(value.shape[0], np.int64) \
+            if value.ndim == 2 else None
+        self.lock = threading.Lock()
+
+
+class PSServer:
+    """The parameter server.  All public methods are the PSFunc surface."""
+
+    _instance = None
+
+    def __init__(self):
+        self.params = {}
+        self.lock = threading.Lock()
+        # SSP: per-key worker clocks (reference ssp_handler.h)
+        self.ssp_clocks = {}
+        self.ssp_bound = {}
+        self.ssp_cv = threading.Condition()
+        # preduce matchmaking (reference preduce_handler.cc)
+        self._preduce_groups = {}
+        self._preduce_cv = threading.Condition()
+        # barrier for BSP (reference PSFHandle BarrierWorker)
+        self._barrier_count = {}
+        self._barrier_cv = threading.Condition()
+
+    # ---------------- lifecycle ---------------- #
+
+    @classmethod
+    def get(cls):
+        if cls._instance is None:
+            cls._instance = PSServer()
+        return cls._instance
+
+    @classmethod
+    def serve_from_env(cls):
+        port = int(os.environ.get("HETU_PS_PORT", "23455"))
+        server = cls.get()
+        server.serve_tcp(port)
+
+    def serve_tcp(self, port, block=True):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        raw = _recv_msg(self.request)
+                        if raw is None:
+                            return
+                        method, args, kwargs = pickle.loads(raw)
+                        try:
+                            result = getattr(outer, method)(*args, **kwargs)
+                            payload = pickle.dumps((True, result))
+                        except Exception as e:  # noqa: BLE001
+                            payload = pickle.dumps((False, repr(e)))
+                        _send_msg(self.request, payload)
+                except (ConnectionResetError, BrokenPipeError):
+                    return
+
+        class Threaded(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = Threaded(("0.0.0.0", port), Handler)
+        if block:
+            self._tcp.serve_forever()
+        else:
+            t = threading.Thread(target=self._tcp.serve_forever, daemon=True)
+            t.start()
+        return self._tcp
+
+    def shutdown(self):
+        if getattr(self, "_tcp", None) is not None:
+            self._tcp.shutdown()
+            self._tcp = None
+
+    # ---------------- PSFunc surface ---------------- #
+
+    def param_init(self, key, shape, init_type="constant", arg1=0.0,
+                   arg2=1.0, seed=0, opt=None, opt_args=None,
+                   param_type=0):
+        """ParamInit (PSFunc.h kParamInit; initializers.py init_on_ps)."""
+        with self.lock:
+            if key in self.params:
+                return False
+            rng = np.random.RandomState(seed)
+            shape = tuple(shape)
+            if init_type in ("constant", 0):
+                value = np.full(shape, arg1, np.float32)
+            elif init_type in ("uniform", 1):
+                value = rng.uniform(arg1, arg2, shape).astype(np.float32)
+            elif init_type in ("normal", "gaussian", 2):
+                value = (arg1 + arg2 * rng.randn(*shape)).astype(np.float32)
+            elif init_type in ("truncated_normal", 3):
+                value = np.clip(rng.randn(*shape), -2, 2)
+                value = (arg1 + arg2 * value).astype(np.float32)
+            else:
+                raise ValueError(f"unknown init type {init_type}")
+            optimizer = None
+            if opt is not None:
+                optimizer = SERVER_OPTIMIZERS[opt](**(opt_args or {}))
+            self.params[key] = _Param(value, optimizer)
+            return True
+
+    def param_clear(self, key):
+        with self.lock:
+            self.params.pop(key, None)
+
+    def param_save(self, key, path):
+        p = self.params[key]
+        with p.lock:
+            np.save(os.path.join(path, f"ps_param_{key}.npy"), p.value)
+
+    def param_load(self, key, path):
+        p = self.params[key]
+        with p.lock:
+            p.value[...] = np.load(os.path.join(path, f"ps_param_{key}.npy"))
+
+    def pull(self, key):
+        p = self.params[key]
+        with p.lock:
+            return p.value.copy()
+
+    def push(self, key, grad):
+        """DensePush: apply grad through the server optimizer (or raw add
+        when no optimizer, matching reference kDensePush accumulate)."""
+        p = self.params[key]
+        with p.lock:
+            if p.optimizer is not None:
+                p.optimizer.apply_dense(p.value, np.asarray(grad), p.state)
+            else:
+                p.value += np.asarray(grad)
+
+    def dd_pushpull(self, key, grad):
+        p = self.params[key]
+        with p.lock:
+            if p.optimizer is not None:
+                p.optimizer.apply_dense(p.value, np.asarray(grad), p.state)
+            else:
+                p.value += np.asarray(grad)
+            return p.value.copy()
+
+    def sparse_pull(self, key, ids):
+        p = self.params[key]
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with p.lock:
+            return p.value[ids]
+
+    def sparse_push(self, key, ids, rows):
+        p = self.params[key]
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, np.float32).reshape(len(ids), -1)
+        with p.lock:
+            if p.optimizer is not None:
+                p.optimizer.apply_sparse(p.value, ids, rows, p.state)
+            else:
+                np.add.at(p.value, ids, rows)
+            if p.versions is not None:
+                p.versions[np.unique(ids)] += 1
+
+    def sd_pushpull(self, key, ids, rows, pull_ids=None):
+        self.sparse_push(key, ids, rows)
+        return self.sparse_pull(key, pull_ids if pull_ids is not None else ids)
+
+    def ss_pushpull(self, key, ids, rows, pull_ids):
+        return self.sd_pushpull(key, ids, rows, pull_ids)
+
+    # ---------------- cache sync (HET protocol) ---------------- #
+
+    def sync_embedding(self, key, ids, stored_versions, bound):
+        """kSyncEmbedding (hetu_client.cc): return rows whose server version
+        exceeds the client's stored version by more than ``bound``."""
+        p = self.params[key]
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        stored_versions = np.asarray(stored_versions, np.int64).reshape(-1)
+        with p.lock:
+            server_v = p.versions[ids]
+            stale = (server_v - stored_versions) > bound
+            return ids[stale], p.value[ids[stale]], server_v[stale]
+
+    def push_embedding(self, key, ids, rows, versions=None):
+        """kPushEmbedding: apply client-accumulated embedding grads."""
+        self.sparse_push(key, ids, rows)
+
+    def push_sync_embedding(self, key, ids, rows, sync_ids,
+                            stored_versions, bound):
+        self.sparse_push(key, ids, rows)
+        return self.sync_embedding(key, sync_ids, stored_versions, bound)
+
+    # ---------------- SSP / BSP ---------------- #
+
+    def ssp_init(self, group, worker, bound):
+        with self.ssp_cv:
+            self.ssp_clocks.setdefault(group, {})[worker] = 0
+            self.ssp_bound[group] = bound
+
+    def ssp_sync(self, group, worker, timeout=60.0):
+        """Advance worker clock; block while ahead of slowest by > bound."""
+        with self.ssp_cv:
+            self.ssp_clocks[group][worker] += 1
+            self.ssp_cv.notify_all()
+            bound = self.ssp_bound[group]
+            deadline = time.time() + timeout
+            while True:
+                clocks = self.ssp_clocks[group]
+                if clocks[worker] - min(clocks.values()) <= bound:
+                    return clocks[worker]
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError("ssp_sync timed out")
+                self.ssp_cv.wait(remaining)
+
+    def barrier(self, group, worker, nworkers, timeout=60.0):
+        """BSP barrier (reference BarrierWorker)."""
+        with self._barrier_cv:
+            gen, count = self._barrier_count.get(group, (0, 0))
+            count += 1
+            if count >= nworkers:
+                self._barrier_count[group] = (gen + 1, 0)
+                self._barrier_cv.notify_all()
+                return
+            self._barrier_count[group] = (gen, count)
+            deadline = time.time() + timeout
+            while self._barrier_count.get(group, (0, 0))[0] == gen:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError("barrier timed out")
+                self._barrier_cv.wait(remaining)
+
+    # ---------------- preduce matchmaking ---------------- #
+
+    def preduce_get_partner(self, key, rank, max_worker, wait_time):
+        """kPReduceGetPartner (preduce_handler.cc): batch arriving workers
+        into a group; return the member ranks once the group fills or
+        ``wait_time`` (seconds) elapses."""
+        with self._preduce_cv:
+            group = self._preduce_groups.setdefault(key, [])
+            group.append(rank)
+            self._preduce_cv.notify_all()
+            deadline = time.time() + wait_time
+            while len(group) < max_worker:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._preduce_cv.wait(remaining)
+            members = sorted(group)
+            # first member to wake clears the batch for the next round
+            if self._preduce_groups.get(key) is group:
+                self._preduce_groups[key] = []
+            return members
+
+    # ---------------- introspection ---------------- #
+
+    def get_loads(self):
+        return {k: int(np.prod(p.value.shape)) for k, p in self.params.items()}
+
+
+# --------------------------------------------------------------------- #
+# TCP framing
+# --------------------------------------------------------------------- #
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (n,) = struct.unpack("!Q", header)
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class Scheduler:
+    """Role parity with ps-lite's scheduler (Postoffice): with the TCP
+    transport, workers connect directly to servers, so the scheduler only
+    serves the rendezvous file/port mapping."""
+
+    @classmethod
+    def serve_from_env(cls):
+        # single-server deployments need no rendezvous; multi-server
+        # sharding reuses the same code with a static port map.
+        pass

@@ -1,0 +1,18 @@
+"""Test config: force a virtual 8-device CPU platform BEFORE jax initializes.
+
+This is the TPU build's substitute for the reference's multi-process local
+clusters (SURVEY.md §4 tier-2/3): N-device semantics on CPU so the
+equivalence suite runs anywhere.  Note: the TPU plugin in this image ignores
+the JAX_PLATFORMS env var, so we force via jax.config, which wins.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,265 @@
+"""Tier-1 op unit tests vs numpy (reference tests/test_ops.py covers ~40 ops
+this way, test_ops.py:7-80)."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from tester import HetuTester
+
+
+def softmax_np(x, axis=-1):
+    x = x - np.max(x, axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------- elementwise ---------------- #
+
+@pytest.mark.parametrize("factory,np_fn,n", [
+    (ht.add_op, lambda a, b: a + b, 2),
+    (ht.minus_op, lambda a, b: a - b, 2),
+    (ht.mul_op, lambda a, b: a * b, 2),
+    (ht.div_op, lambda a, b: a / b, 2),
+    (ht.exp_op, np.exp, 1),
+    (ht.abs_op, np.abs, 1),
+    (ht.sqrt_op, lambda a: np.sqrt(np.abs(a) + 1), 1),
+    (ht.sin_op, np.sin, 1),
+    (ht.cos_op, np.cos, 1),
+    (ht.floor_op, np.floor, 1),
+    (ht.opposite_op, lambda a: -a, 1),
+    (ht.sigmoid_op, lambda a: 1 / (1 + np.exp(-a)), 1),
+    (ht.tanh_op, np.tanh, 1),
+    (ht.relu_op, lambda a: np.maximum(a, 0), 1),
+])
+def test_elementwise(factory, np_fn, n):
+    shapes = [(4, 5)] * n
+    if factory is ht.sqrt_op:
+        t = HetuTester(lambda x: factory(ht.addbyconst_op(ht.abs_op(x), 1)), 1)
+        t.test(shapes, np_fn, rtol=1e-5)
+    elif factory is ht.div_op:
+        t = HetuTester(lambda a, b: factory(a, ht.addbyconst_op(ht.abs_op(b), 1)), 2)
+        t.test(shapes, lambda a, b: a / (np.abs(b) + 1), rtol=1e-5)
+    else:
+        HetuTester(factory, n).test(shapes, np_fn, rtol=1e-4, atol=1e-5)
+
+
+def test_const_ops():
+    HetuTester(ht.addbyconst_op, 1, 3.5).test([(3, 4)], lambda a: a + 3.5)
+    HetuTester(ht.mul_byconst_op, 1, -2.0).test([(3, 4)], lambda a: a * -2.0)
+    HetuTester(ht.pow_op, 1, 3.0).test([(3, 4)], lambda a: np.power(a, 3.0),
+                                       rtol=1e-4, atol=1e-5)
+    HetuTester(ht.clamp_op, 1, -0.5, 0.5).test(
+        [(3, 4)], lambda a: np.clip(a, -0.5, 0.5))
+
+
+def test_gelu():
+    def gelu_np(x):
+        return 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3)))
+    HetuTester(ht.gelu_op, 1).test([(8, 16)], gelu_np, rtol=1e-4, atol=1e-5)
+
+
+def test_leaky_relu():
+    HetuTester(ht.leaky_relu_op, 1, 0.1).test(
+        [(5, 5)], lambda a: np.where(a > 0, a, 0.1 * a))
+
+
+def test_softmax():
+    HetuTester(ht.softmax_op, 1).test([(4, 10)], softmax_np, rtol=1e-5)
+
+
+def test_where():
+    t = HetuTester(ht.where_op, 3)
+    cond = (np.random.RandomState(0).rand(4, 4) > 0.5).astype(np.float32)
+    a = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+    b = np.random.RandomState(2).randn(4, 4).astype(np.float32)
+    feeds, out, ex = t.build(None)
+    (res,) = ex.run("test", feed_dict=dict(zip(feeds, [cond, a, b])),
+                    convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(res, np.where(cond > 0.5, a, b))
+
+
+# ---------------- matmul ---------------- #
+
+def test_matmul():
+    HetuTester(ht.matmul_op, 2).test([(4, 6), (6, 8)], np.matmul, rtol=1e-4)
+    HetuTester(ht.matmul_op, 2, True, False).test(
+        [(6, 4), (6, 8)], lambda a, b: a.T @ b, rtol=1e-4)
+    HetuTester(ht.matmul_op, 2, False, True).test(
+        [(4, 6), (8, 6)], lambda a, b: a @ b.T, rtol=1e-4)
+
+
+def test_batch_matmul():
+    HetuTester(ht.batch_matmul_op, 2).test(
+        [(3, 4, 5), (3, 5, 6)], np.matmul, rtol=1e-4)
+
+
+def test_linear():
+    HetuTester(ht.linear_op, 3).test(
+        [(4, 6), (6, 8), (8,)], lambda a, w, b: a @ w + b, rtol=1e-4)
+
+
+# ---------------- shape ---------------- #
+
+def test_reshape_transpose():
+    HetuTester(ht.array_reshape_op, 1, (2, 12)).test(
+        [(4, 6)], lambda a: a.reshape(2, 12))
+    HetuTester(ht.transpose_op, 1, (1, 0)).test([(4, 6)], lambda a: a.T)
+
+
+def test_broadcast_reduce():
+    HetuTester(ht.reduce_sum_op, 1, 0).test([(4, 6)], lambda a: a.sum(0),
+                                            rtol=1e-5)
+    HetuTester(ht.reduce_mean_op, 1, [1], True).test(
+        [(4, 6)], lambda a: a.mean(1, keepdims=True), rtol=1e-5)
+    HetuTester(ht.broadcast_shape_op, 1, (3, 4, 6)).test(
+        [(4, 6)], lambda a: np.broadcast_to(a, (3, 4, 6)))
+
+
+def test_concat_split():
+    HetuTester(ht.concat_op, 2, 1).test(
+        [(3, 4), (3, 5)], lambda a, b: np.concatenate([a, b], 1))
+    HetuTester(ht.split_op, 1, [1], [1], [2]).test(
+        [(4, 6)], lambda a: a[:, 3:])
+
+
+def test_slice_pad():
+    HetuTester(ht.slice_op, 1, (1, 2), (2, 3)).test(
+        [(4, 6)], lambda a: a[1:3, 2:5])
+    HetuTester(ht.pad_op, 1, [(1, 1), (2, 2)]).test(
+        [(3, 3)], lambda a: np.pad(a, [(1, 1), (2, 2)]))
+
+
+def test_gather_onehot_argmax():
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, 4, (5,)).astype(np.float32)
+    x = rng.randn(4, 3).astype(np.float32)
+    t = HetuTester(ht.indexing_op, 2)
+    feeds, out, ex = t.build(None)
+    (res,) = ex.run("test", feed_dict=dict(zip(feeds, [x, idx])),
+                    convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(res, x[idx.astype(int)])
+
+    HetuTester(ht.one_hot_op, 1, 10, dtypes=[np.int32]).test(
+        [(7,)], lambda a: np.eye(10, dtype=np.float32)[a])
+    HetuTester(ht.argmax_op, 1, -1).test(
+        [(6, 5)], lambda a: np.argmax(a, -1).astype(np.float32))
+
+
+def test_cumsum_topk():
+    HetuTester(ht.cumsum_with_bias_op, 1, -1.0, 0).test(
+        [(5, 4)], lambda a: np.cumsum(a - 1, 0), rtol=1e-5)
+    x = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+    t = HetuTester(ht.topk_val_op, 1, 3)
+    feeds, out, ex = t.build(None)
+    (res,) = ex.run("test", feed_dict={feeds[0]: x},
+                    convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(res, -np.sort(-x, -1)[:, :3], rtol=1e-6)
+
+
+# ---------------- losses ---------------- #
+
+def test_softmax_cross_entropy():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(6, 10).astype(np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 6)]
+
+    def np_fn(x, y):
+        p = softmax_np(x)
+        return -np.sum(y * np.log(p), -1)
+    t = HetuTester(ht.softmaxcrossentropy_op, 2)
+    feeds, out, ex = t.build(None)
+    (res,) = ex.run("test", feed_dict=dict(zip(feeds, [logits, labels])),
+                    convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(res, np_fn(logits, labels), rtol=1e-5)
+
+
+def test_softmax_cross_entropy_sparse():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(6, 10).astype(np.float32)
+    labels = rng.randint(0, 10, 6).astype(np.int32)
+    labels[2] = -1  # ignored
+
+    t = HetuTester(ht.softmaxcrossentropy_sparse_op, 2, -1)
+    feeds, out, ex = t.build(None)
+    (res,) = ex.run("test", feed_dict=dict(zip(feeds, [logits, labels])),
+                    convert_to_numpy_ret_vals=True)
+    p = softmax_np(logits)
+    exp = -np.log(p[np.arange(6), np.where(labels < 0, 0, labels)])
+    exp[labels < 0] = 0
+    np.testing.assert_allclose(res, exp, rtol=1e-5)
+
+
+def test_bce():
+    rng = np.random.RandomState(0)
+    p = rng.rand(8).astype(np.float32) * 0.9 + 0.05
+    y = (rng.rand(8) > 0.5).astype(np.float32)
+    t = HetuTester(ht.binarycrossentropy_op, 2)
+    feeds, out, ex = t.build(None)
+    (res,) = ex.run("test", feed_dict=dict(zip(feeds, [p, y])),
+                    convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(
+        res, -(y * np.log(p) + (1 - y) * np.log(1 - p)), rtol=1e-4)
+
+
+# ---------------- conv/pool/norm ---------------- #
+
+def _conv2d_np(x, w, stride=1, padding=0):
+    n, c, h, ww = x.shape
+    o, _, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (padding, padding), (padding, padding)])
+    oh = (h + 2 * padding - kh) // stride + 1
+    ow = (ww + 2 * padding - kw) // stride + 1
+    out = np.zeros((n, o, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh, j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def test_conv2d():
+    HetuTester(ht.conv2d_op, 2, 1, 1).test(
+        [(2, 3, 8, 8), (4, 3, 3, 3)],
+        lambda x, w: _conv2d_np(x, w, 1, 1), rtol=1e-3, atol=1e-4)
+
+
+def test_pools():
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+
+    def maxpool_np(x):
+        return x.reshape(2, 3, 4, 2, 4, 2).max((3, 5))
+
+    def avgpool_np(x):
+        return x.reshape(2, 3, 4, 2, 4, 2).mean((3, 5))
+
+    for op, ref in [(ht.max_pool2d_op, maxpool_np), (ht.avg_pool2d_op, avgpool_np)]:
+        t = HetuTester(op, 1, 2, 2, 0, 2)
+        feeds, out, ex = t.build(None)
+        (res,) = ex.run("test", feed_dict={feeds[0]: x},
+                        convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(res, ref(x), rtol=1e-5)
+
+
+def test_layer_norm():
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    scale = np.ones(16, np.float32)
+    bias = np.zeros(16, np.float32)
+    t = HetuTester(ht.layer_normalization_op, 3, 1e-5)
+    feeds, out, ex = t.build(None)
+    (res,) = ex.run("test", feed_dict=dict(zip(feeds, [x, scale, bias])),
+                    convert_to_numpy_ret_vals=True)
+    exp = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(res, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_lookup():
+    rng = np.random.RandomState(0)
+    table_np = rng.randn(10, 4).astype(np.float32)
+    ids = rng.randint(0, 10, (6,)).astype(np.int32)
+    table = ht.Variable("table_emb", value=table_np)
+    x = ht.placeholder_op("ids")
+    out = ht.embedding_lookup_op(table, x)
+    ex = ht.Executor({"test": [out]})
+    (res,) = ex.run("test", feed_dict={x: ids}, convert_to_numpy_ret_vals=True)
+    np.testing.assert_allclose(res, table_np[ids])

@@ -1,0 +1,133 @@
+"""Optimizer correctness vs closed-form numpy updates (reference
+tests/test_optimizer.py)."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+
+
+def _train_quadratic(opt, steps=3):
+    """loss = 0.5*sum(w^2); grad = w. Track w trajectory."""
+    w0 = np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)
+    w = ht.Variable("w_q", value=w0.copy())
+    loss = ht.mul_byconst_op(ht.reduce_sum_op(ht.mul_op(w, w), [0, 1]), 0.5)
+    train = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train]})
+    traj = [w0.copy()]
+    for _ in range(steps):
+        ex.run("train")
+        traj.append(np.asarray(ex.var_values["w_q"]))
+    return traj
+
+
+def test_sgd():
+    traj = _train_quadratic(ht.optim.SGDOptimizer(learning_rate=0.1))
+    expect = traj[0]
+    for t in traj[1:]:
+        expect = expect - 0.1 * expect
+        np.testing.assert_allclose(t, expect, rtol=1e-5)
+
+
+def test_momentum():
+    traj = _train_quadratic(
+        ht.optim.MomentumOptimizer(learning_rate=0.1, momentum=0.9))
+    w, v = traj[0], np.zeros_like(traj[0])
+    for t in traj[1:]:
+        v = 0.9 * v - 0.1 * w
+        w = w + v
+        np.testing.assert_allclose(t, w, rtol=1e-5)
+
+
+def test_adagrad():
+    traj = _train_quadratic(
+        ht.optim.AdaGradOptimizer(learning_rate=0.1, eps=1e-7))
+    w, acc = traj[0], np.zeros_like(traj[0])
+    for t in traj[1:]:
+        acc = acc + w * w
+        w = w - 0.1 * w / (np.sqrt(acc) + 1e-7)
+        np.testing.assert_allclose(t, w, rtol=1e-5)
+
+
+def test_adam():
+    traj = _train_quadratic(
+        ht.optim.AdamOptimizer(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                               epsilon=1e-7))
+    w = traj[0]
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for i, t in enumerate(traj[1:]):
+        g = w
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mhat = m / (1 - 0.9 ** (i + 1))
+        vhat = v / (1 - 0.999 ** (i + 1))
+        w = w - 0.1 * mhat / (np.sqrt(vhat) + 1e-7)
+        np.testing.assert_allclose(t, w, rtol=1e-4)
+
+
+def test_adamw():
+    traj = _train_quadratic(
+        ht.optim.AdamWOptimizer(learning_rate=0.1, weight_decay=0.01))
+    w = traj[0]
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for i, t in enumerate(traj[1:]):
+        g = w
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mhat = m / (1 - 0.9 ** (i + 1))
+        vhat = v / (1 - 0.999 ** (i + 1))
+        w = w - 0.1 * (mhat / (np.sqrt(vhat) + 1e-7) + 0.01 * w)
+        np.testing.assert_allclose(t, w, rtol=1e-4)
+
+
+def test_lamb():
+    traj = _train_quadratic(
+        ht.optim.LambOptimizer(learning_rate=0.1, weight_decay=0.01))
+    w = traj[0]
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in traj[1:]:
+        g = w
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        upd = m / (np.sqrt(v) + 1e-7) + 0.01 * w
+        ratio = np.linalg.norm(w) / np.linalg.norm(upd)
+        w = w - 0.1 * ratio * upd
+        np.testing.assert_allclose(t, w, rtol=1e-4)
+
+
+def test_lr_scheduler_in_optimizer():
+    sched = ht.lr.StepScheduler(0.1, step_size=2, gamma=0.5)
+    traj = _train_quadratic(ht.optim.SGDOptimizer(learning_rate=sched),
+                            steps=4)
+    w = traj[0]
+    lrs = [0.1, 0.1, 0.05, 0.05]
+    for lr_t, t in zip(lrs, traj[1:]):
+        w = w - lr_t * w
+        np.testing.assert_allclose(t, w, rtol=1e-5)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    opt = ht.optim.AdamOptimizer(learning_rate=0.05)
+    w = ht.Variable("w_ckpt", value=np.ones((3, 3), np.float32))
+    loss = ht.reduce_sum_op(ht.mul_op(w, w), [0, 1])
+    train = opt.minimize(loss)
+    ex = ht.Executor({"train": [loss, train]})
+    ex.run("train")
+    ex.run("train")
+    ex.save(str(tmp_path), "ck.pkl")
+    after_2 = np.asarray(ex.var_values["w_ckpt"])
+
+    # fresh executor, load, continue — must match uninterrupted run
+    w2 = ht.Variable("w_ckpt", value=np.ones((3, 3), np.float32))
+    loss2 = ht.reduce_sum_op(ht.mul_op(w2, w2), [0, 1])
+    train2 = ht.optim.AdamOptimizer(learning_rate=0.05).minimize(loss2)
+    ex2 = ht.Executor({"train": [loss2, train2]})
+    ex2.load(str(tmp_path), "ck.pkl")
+    np.testing.assert_allclose(np.asarray(ex2.var_values["w_ckpt"]), after_2)
+    ex.run("train")
+    ex2.run("train")
+    np.testing.assert_allclose(np.asarray(ex2.var_values["w_ckpt"]),
+                               np.asarray(ex.var_values["w_ckpt"]), rtol=1e-6)

@@ -1,0 +1,49 @@
+"""HetuTester: build op -> run executor -> compare to numpy reference.
+
+Mirrors the reference test harness (tests/tester.py: HetuTester runs the
+GPU executor and asserts allclose against a numpy function).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import hetu_tpu as ht
+
+
+class HetuTester:
+    def __init__(self, op_factory, num_inputs, *args, shapes=None,
+                 dtypes=None, **kwargs):
+        self.op_factory = op_factory
+        self.num_inputs = num_inputs
+        self.args = args
+        self.kwargs = kwargs
+        self.shapes = shapes
+        self.dtypes = dtypes
+
+    def build(self, shapes):
+        feeds = [ht.placeholder_op(f"input_{i}") for i in range(self.num_inputs)]
+        out = self.op_factory(*feeds, *self.args, **self.kwargs)
+        executor = ht.Executor({"test": [out]})
+        return feeds, out, executor
+
+    def make_inputs(self, shapes, seed=0):
+        rng = np.random.RandomState(seed)
+        inputs = []
+        for i, s in enumerate(shapes):
+            dt = self.dtypes[i] if self.dtypes else np.float32
+            if np.issubdtype(dt, np.integer):
+                inputs.append(rng.randint(0, 10, size=s).astype(dt))
+            else:
+                inputs.append(rng.uniform(-1, 1, size=s).astype(dt))
+        return inputs
+
+    def test(self, shapes, numpy_fn, rtol=1e-5, atol=1e-6, seed=0):
+        feeds, out, executor = self.build(shapes)
+        inputs = self.make_inputs(shapes, seed)
+        (result,) = executor.run(
+            "test", feed_dict=dict(zip(feeds, inputs)),
+            convert_to_numpy_ret_vals=True)
+        expected = numpy_fn(*inputs)
+        np.testing.assert_allclose(result, expected, rtol=rtol, atol=atol)
+        return result
