@@ -8,6 +8,11 @@ design (SURVEY.md §2.5 mapping table).
 from .mesh import (
     make_mesh, default_mesh, MeshAxes, local_device_count,
 )
+from . import pipeline
+from .pipeline import (
+    spmd_pipeline, stack_stage_params, shard_stacked_params,
+    gpipe_schedule, one_f_one_b_schedule, PipelineStage, PipelineTrainer,
+)
 from . import distributed_strategies
 from .distributed_strategies import (
     DataParallel, ModelParallel4LM, ExpertParallel, PipelineParallel4LM,

@@ -86,7 +86,7 @@ class NCCLProfiler:
         self.mesh = mesh if mesh is not None else default_mesh()
 
     def profile_allreduce(self, size_mb=16, axis=None, iters=5):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
         axis = axis or self.mesh.axis_names[0]
         n = self.mesh.shape[axis]

@@ -1,0 +1,249 @@
+"""Pipeline parallelism tests (tier-2 equivalence, SURVEY.md §4):
+N-stage pipeline output/training must equal the single-device ground truth.
+
+Reference patterns: examples/runner/parallel/all_mlp_tests.sh PP configs +
+validate_results.py allclose assertions.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hetu_tpu.parallel.mesh import make_mesh
+from hetu_tpu.parallel.pipeline import (
+    spmd_pipeline, stack_stage_params, shard_stacked_params,
+    gpipe_schedule, one_f_one_b_schedule, PipelineStage, PipelineTrainer,
+    FWD, BWD,
+)
+
+HID = 16
+S = 4   # stages
+M = 8   # microbatches
+MB = 4  # microbatch size
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_params(seed):
+    rng = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rng.randn(HID, HID) * 0.3, jnp.float32),
+             "b": jnp.asarray(rng.randn(HID) * 0.1, jnp.float32)}
+            for _ in range(S)]
+
+
+def _sequential_fwd(per_stage, mb):
+    out = []
+    for m in range(mb.shape[0]):
+        h = mb[m]
+        for p in per_stage:
+            h = _stage_fn(p, h)
+        out.append(h)
+    return jnp.stack(out)
+
+
+def test_spmd_pipeline_matches_sequential():
+    mesh = make_mesh({"pp": S})
+    per_stage = _make_params(0)
+    stacked = shard_stacked_params(stack_stage_params(per_stage), mesh)
+    mb = jnp.asarray(np.random.RandomState(1).randn(M, MB, HID), jnp.float32)
+    got = spmd_pipeline(_stage_fn, stacked, mb, mesh=mesh)
+    want = _sequential_fwd(per_stage, mb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spmd_pipeline_grads_match_sequential():
+    mesh = make_mesh({"pp": S})
+    per_stage = _make_params(2)
+    stacked = stack_stage_params(per_stage)
+    mb = jnp.asarray(np.random.RandomState(3).randn(M, MB, HID), jnp.float32)
+    tgt = jnp.asarray(np.random.RandomState(4).randn(M, MB, HID), jnp.float32)
+
+    def loss_pipe(stacked_params):
+        sp = shard_stacked_params(stacked_params, mesh)
+        y = spmd_pipeline(_stage_fn, sp, mb, mesh=mesh)
+        return jnp.mean((y - tgt) ** 2)
+
+    def loss_seq(stacked_params):
+        per = [jax.tree_util.tree_map(lambda p: p[i], stacked_params)
+               for i in range(S)]
+        y = _sequential_fwd(per, mb)
+        return jnp.mean((y - tgt) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_schedule_order():
+    sched = gpipe_schedule(4)
+    assert sched[:4] == [(0, FWD), (1, FWD), (2, FWD), (3, FWD)]
+    assert sched[4:] == [(3, BWD), (2, BWD), (1, BWD), (0, BWD)]
+
+
+def test_1f1b_schedule_validity():
+    for stage in range(4):
+        sched = one_f_one_b_schedule(6, stage, 4)
+        fwd_seen = set()
+        for m, d in sched:
+            if d == FWD:
+                fwd_seen.add(m)
+            else:
+                assert m in fwd_seen, "bwd before fwd"
+        assert len([1 for _, d in sched if d == BWD]) == 6
+        # fwds before the first bwd = warmup + the first steady-state fwd
+        warm = 0
+        for _, d in sched:
+            if d == FWD:
+                warm += 1
+            else:
+                break
+        assert warm == min(4 - stage - 1, 6) + 1
+
+
+def _trainer_setup(mode, seed=0):
+    per_stage = _make_params(seed)
+    stages = [PipelineStage(apply=_stage_fn, params=dict(p))
+              for p in per_stage]
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+    return PipelineTrainer(stages, mode=mode, loss_fn=loss_fn)
+
+
+def test_gpipe_trainer_matches_plain_sgd():
+    """gpipe over M microbatches == one SGD step on the mean-of-microbatch
+    losses (the reference's single optimizer apply after all microbatches,
+    gpipe_subexecutor.py:84-89)."""
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(M, MB, HID), jnp.float32)
+    t = jnp.asarray(rng.randn(M, MB, HID), jnp.float32)
+
+    trainer = _trainer_setup("gpipe", seed=5)
+    ref_params = [dict(st.params) for st in trainer.stages]
+    trainer.train_batch(list(x), list(t))
+
+    def total_loss(params_list):
+        losses = []
+        for m in range(M):
+            h = x[m]
+            for p in params_list:
+                h = _stage_fn(p, h)
+            losses.append(jnp.mean((h - t[m]) ** 2))
+        return jnp.mean(jnp.stack(losses))
+
+    grads = jax.grad(total_loss)(ref_params)
+    want = [jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, pl, gr)
+            for pl, gr in zip(ref_params, grads)]
+    for st, w in zip(trainer.stages, want):
+        for k in w:
+            np.testing.assert_allclose(np.asarray(st.params[k]),
+                                       np.asarray(w[k]), rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_trainer_matches_gpipe_math():
+    """Synchronous 1F1B computes the same update as gpipe."""
+    rng = np.random.RandomState(9)
+    x = list(jnp.asarray(rng.randn(M, MB, HID), jnp.float32))
+    t = list(jnp.asarray(rng.randn(M, MB, HID), jnp.float32))
+    tr_a = _trainer_setup("gpipe", seed=11)
+    tr_b = _trainer_setup("1f1b", seed=11)
+    la = tr_a.train_batch(x, t)
+    lb = tr_b.train_batch(x, t)
+    assert abs(la - lb) < 1e-6
+    for sa, sb in zip(tr_a.stages, tr_b.stages):
+        for k in sa.params:
+            np.testing.assert_allclose(np.asarray(sa.params[k]),
+                                       np.asarray(sb.params[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_pipedream_trainer_descends():
+    """PipeDream (per-microbatch updates w/ stashed weights) reduces loss."""
+    rng = np.random.RandomState(13)
+    trainer = _trainer_setup("pipedream", seed=13)
+    losses = []
+    for it in range(5):
+        x = list(jnp.asarray(rng.randn(M, MB, HID), jnp.float32))
+        t = [jnp.zeros((MB, HID), jnp.float32)] * M
+        losses.append(trainer.train_batch(x, t))
+    assert losses[-1] < losses[0]
+
+
+def test_hetpipe_ps_sync():
+    """HetPipe pushes to a PS every sync_every batches."""
+    class FakePS:
+        """Accumulating store, same contract as ps/server.py push()."""
+        def __init__(self):
+            self.store = {}
+            self.pushes = 0
+        def push(self, k, v):
+            self.pushes += 1
+            self.store[k] = self.store.get(k, 0) + np.asarray(v)
+        def pull(self, k):
+            return self.store[k]
+
+    ps = FakePS()
+    rng = np.random.RandomState(17)
+    per_stage = _make_params(17)
+    stages = [PipelineStage(apply=_stage_fn, params=dict(p))
+              for p in per_stage]
+    trainer = PipelineTrainer(
+        stages, mode="hetpipe",
+        loss_fn=lambda y, t: jnp.mean((y - t) ** 2),
+        sync_every=2, ps=ps)
+    for _ in range(4):
+        x = list(jnp.asarray(rng.randn(M, MB, HID), jnp.float32))
+        t = [jnp.zeros((MB, HID), jnp.float32)] * M
+        trainer.train_batch(x, t)
+    assert ps.pushes == 2 * S * 2  # 2 syncs x S stages x 2 tensors
+    # after the final sync the PS view and worker view agree
+    for i, st in enumerate(trainer.stages):
+        for k in st.params:
+            np.testing.assert_allclose(np.asarray(st.params[k]),
+                                       ps.store[f"stage{i}/{k}"],
+                                       rtol=1e-6, atol=1e-6)
+
+
+def test_trainer_honors_real_optimizer():
+    """PipelineTrainer uses Optimizer.update_one (momentum state advances),
+    not silent vanilla SGD."""
+    import hetu_tpu as ht
+    rng = np.random.RandomState(21)
+    per_stage = _make_params(21)
+    stages = [PipelineStage(apply=_stage_fn, params=dict(p))
+              for p in per_stage]
+    opt = ht.optim.MomentumOptimizer(learning_rate=0.05, momentum=0.9)
+    trainer = PipelineTrainer(
+        stages, optimizer=opt, mode="gpipe",
+        loss_fn=lambda y, t: jnp.mean((y - t) ** 2))
+    ref_params = [dict(st.params) for st in trainer.stages]
+    x = jnp.asarray(rng.randn(M, MB, HID), jnp.float32)
+    t = jnp.asarray(rng.randn(M, MB, HID), jnp.float32)
+    trainer.train_batch(list(x), list(t))
+
+    def total_loss(params_list):
+        losses = []
+        for m in range(M):
+            h = x[m]
+            for p in params_list:
+                h = _stage_fn(p, h)
+            losses.append(jnp.mean((h - t[m]) ** 2))
+        return jnp.mean(jnp.stack(losses))
+
+    grads = jax.grad(total_loss)(ref_params)
+    step = jnp.zeros((), jnp.int32)
+    for st, pl, gr in zip(trainer.stages, ref_params, grads):
+        for k in pl:
+            s0 = opt.init_state_one(pl[k])
+            want, _ = opt.update_one(pl[k], gr[k], s0,
+                                     opt.lr_value(step), step)
+            np.testing.assert_allclose(np.asarray(st.params[k]),
+                                       np.asarray(want),
+                                       rtol=1e-5, atol=1e-6)
+    assert trainer._opt_states is not None
