@@ -47,6 +47,7 @@ from .graph.ops_moe import (
     balance_assignment_op, group_topk_idx_op, sam_group_sum_op, sam_max_op,
     dispatch,
 )
+from .graph.ops_attention import flash_attention_op, ring_attention_op
 from .graph.ops_comm import (
     allreduceCommunicate_op, allreduceCommunicatep2p_op,
     groupallreduceCommunicate_op, allgatherCommunicate_op,

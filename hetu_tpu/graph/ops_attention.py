@@ -1,0 +1,34 @@
+"""Attention graph ops backed by the Pallas flash kernel.
+
+No reference counterpart (the reference builds attention from
+batch_matmul/softmax inline, examples/nlp/bert/hetu_bert.py); this is the
+fused fast path.  Gradient flows through the kernel's custom_vjp via the
+generic VJPOp fallback.
+"""
+
+from __future__ import annotations
+
+from .node import SimpleOp
+
+
+def flash_attention_op(q, k, v, causal=False, block_q=128, block_k=128,
+                       ctx=None):
+    """Fused attention on [B, S, H, D] q/k/v nodes -> [B, S, H, D]."""
+    from ..kernels.flash_attention import flash_attention
+
+    def fn(q, k, v):
+        return flash_attention(q, k, v, causal=causal,
+                               block_q=block_q, block_k=block_k)
+
+    return SimpleOp(fn, q, k, v, name="FlashAttention", ctx=ctx)
+
+
+def ring_attention_op(q, k, v, mesh, axis="cp", causal=False, ctx=None):
+    """Ring attention over a sequence-sharded 'cp' mesh axis (long-context
+    path, SURVEY.md §5.7 — new capability vs the reference)."""
+    from ..parallel.context_parallel import ring_attention
+
+    def fn(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal)
+
+    return SimpleOp(fn, q, k, v, name="RingAttention", ctx=ctx)

@@ -11,5 +11,5 @@ __all__ = []
 try:
     from . import flash_attention  # noqa: F401
     __all__.append("flash_attention")
-except ImportError:
+except ImportError:  # pallas unavailable: call sites fall back to jnp paths
     pass

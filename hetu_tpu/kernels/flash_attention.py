@@ -1,0 +1,184 @@
+"""Pallas TPU flash attention (forward kernel + custom VJP).
+
+The hot op of every transformer in the model zoo (SURVEY.md §2.1 "TPU
+equivalent": the genuinely custom kernels become Pallas).  Blockwise
+online-softmax attention: for each query block the kernel streams key/value
+blocks through VMEM, keeping running max/denominator, so the S x S score
+matrix never leaves VMEM and HBM traffic is O(S*D) instead of O(S^2).
+
+Grid: (batch*heads, q_blocks, kv_blocks); the kv dimension is innermost so
+the VMEM scratch accumulators (m, l, acc) persist across kv steps of one
+query block (TPU grids execute sequentially).  Causal blocks strictly above
+the diagonal are skipped with @pl.when — ~2x fewer FLOPs for causal LM.
+
+Backward: custom_vjp recomputing through the pure-jnp blockwise oracle
+(parallel/context_parallel.blockwise_attention) — numerically identical
+math, O(S) memory via block streaming; a fused Pallas backward kernel is a
+future optimization.
+
+On non-TPU backends the kernel runs in interpret mode, so the same code
+path is testable on the 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _fit_block(block, length):
+    """Largest divisor of ``length`` that is <= min(block, length), so any
+    sequence length works (non-divisible requests shrink the block rather
+    than assert)."""
+    b = min(block, length)
+    while length % b:
+        b -= 1
+    return b
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, bq, bk, n_kv):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        # block (qi, kj) is live unless every q position < every kv position
+        run = (kj * bk) <= (qi * bq + bq - 1)
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0]          # [bq, D]
+        k = k_ref[0]          # [bk, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kv_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]                      # [bq, 1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(jnp.clip(m_prev - m_new, max=0.0))
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    """q, k, v: [BH, S, D] -> o: [BH, S, D]."""
+    BH, S, D = q.shape
+    Sk = k.shape[1]
+    bq = _fit_block(block_q, S)
+    bk = _fit_block(block_k, Sk)
+    n_q, n_kv = S // bq, Sk // bk
+    scale = D ** -0.5
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, n_kv=n_kv)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running denom
+            pltpu.VMEM((bq, D), jnp.float32),        # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                      block_k=block_k, interpret=_use_interpret())
+
+
+def _oracle(q, k, v, causal):
+    """Pure-jnp blockwise attention on [BH, S, D] (bwd recompute path)."""
+    from ..parallel.context_parallel import blockwise_attention
+    # blockwise_attention expects [B, S, H, D]; fold BH into batch, H=1
+    qo = q[:, :, None, :]
+    ko = k[:, :, None, :]
+    vo = v[:, :, None, :]
+    out = blockwise_attention(qo, ko, vo, block_size=512, causal=causal)
+    return out[:, :, 0, :]
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    o = _flash(q, k, v, causal, block_q, block_k)
+    return o, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _oracle(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal=False, block_q=128, block_k=128):
+    """Flash attention on [B, S, H, D] (framework layout).
+
+    Differentiable; runs the Pallas kernel forward (interpret mode off-TPU)
+    and a blockwise-recompute backward.
+    """
+    B, S, H, D = q.shape
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+    o = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def mha_reference(q, k, v, *, causal=False):
+    """Exact attention oracle on [B, S, H, D] for tests."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Sq, Sk), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

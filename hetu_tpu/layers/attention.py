@@ -21,7 +21,8 @@ from ..graph import (
 
 class MultiHeadAttention(BaseLayer):
     def __init__(self, hidden_size, num_heads, seq_len, batch_size,
-                 dropout_rate=0.0, initializer=None, name="attn"):
+                 dropout_rate=0.0, initializer=None, name="attn",
+                 use_flash=False, causal=False, block_q=128, block_k=128):
         assert hidden_size % num_heads == 0
         self.h = hidden_size
         self.nh = num_heads
@@ -29,6 +30,10 @@ class MultiHeadAttention(BaseLayer):
         self.seq = seq_len
         self.bs = batch_size
         self.keep_prob = 1.0 - dropout_rate
+        self.use_flash = use_flash
+        self.causal = causal
+        self.block_q = block_q
+        self.block_k = block_k
         ini = initializer or init.GenXavierUniform()
         self.wq = ini(shape=(self.h, self.h), name=name + "_q_weight")
         self.wk = ini(shape=(self.h, self.h), name=name + "_k_weight")
@@ -46,6 +51,21 @@ class MultiHeadAttention(BaseLayer):
 
     def __call__(self, x, attention_mask=None):
         """x: (B*S, H) flattened hidden states; mask: additive (B,1,1,S)."""
+        if self.use_flash and attention_mask is None \
+                and self.keep_prob == 1.0:
+            from ..graph.ops_attention import flash_attention_op
+            # [B*S, H] -> [B, S, nh, hd] (kernel layout)
+            def bshd(node):
+                return array_reshape_op(
+                    node, [self.bs, self.seq, self.nh, self.hd])
+            q = bshd(linear_op(x, self.wq, self.bq))
+            k = bshd(linear_op(x, self.wk, self.bk))
+            v = bshd(linear_op(x, self.wv, self.bv))
+            o = flash_attention_op(q, k, v, causal=self.causal,
+                                   block_q=self.block_q,
+                                   block_k=self.block_k)
+            o = array_reshape_op(o, [self.bs * self.seq, self.h])
+            return linear_op(o, self.wo, self.bo)
         q = self._split_heads(linear_op(x, self.wq, self.bq))
         k = self._split_heads(linear_op(x, self.wk, self.bk))
         v = self._split_heads(linear_op(x, self.wv, self.bv))

@@ -13,6 +13,10 @@ from .pipeline import (
     spmd_pipeline, stack_stage_params, shard_stacked_params,
     gpipe_schedule, one_f_one_b_schedule, PipelineStage, PipelineTrainer,
 )
+from . import context_parallel
+from .context_parallel import (
+    ring_attention, ulysses_attention, blockwise_attention,
+)
 from . import distributed_strategies
 from .distributed_strategies import (
     DataParallel, ModelParallel4LM, ExpertParallel, PipelineParallel4LM,

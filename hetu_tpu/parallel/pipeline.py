@@ -301,12 +301,20 @@ class PipelineTrainer:
         the reference's server-side accumulate, pipedream_subexecutor.py:
         317-328), then pull the merged view and rebase the snapshot."""
         if self._ps_snapshot is None:
-            # first sync: seed the PS with our params so deltas make sense
+            # first sync: seed the PS idempotently — exactly one worker wins
+            # param_init (it returns False if the key exists) and pushes its
+            # weights; everyone else just pulls the shared copy.  A bare
+            # accumulate-push here would sum every worker's full weights.
             self._ps_snapshot = {}
             for i, st in enumerate(self.stages):
                 for k, v in st.params.items():
                     key = f"stage{i}/{k}"
-                    self.ps.push(key, np.asarray(v))
+                    arr = np.asarray(v)
+                    created = True
+                    if hasattr(self.ps, "param_init"):
+                        created = self.ps.param_init(key, arr.shape)
+                    if created:
+                        self.ps.push(key, arr)
                     self._ps_snapshot[key] = np.asarray(
                         self.ps.pull(key)).copy()
                     st.params[k] = jnp.asarray(self._ps_snapshot[key])
