@@ -39,6 +39,7 @@ from . import metrics
 from . import parallel
 from .parallel import distributed_strategies as dist
 from .profiler import HetuProfiler, NCCLProfiler, TPUProfiler
+from .cache import CacheSparseTable, EmbeddingCache
 
 # MoE / communication op surface
 from .graph.ops_moe import (

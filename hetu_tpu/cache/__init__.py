@@ -1,0 +1,12 @@
+"""HET embedding cache (client side): native core + CacheSparseTable.
+
+Reference: src/hetu_cache (CacheBase cache.h:21-60, LRU/LFU/LFUOpt
+policies, per-row versioned Lines embedding.h:19, sync protocol
+hetu_client.cc) and its Python facade cstable.py:19-187.
+"""
+
+from .cache import EmbeddingCache, PythonCache, NativeCache
+from .cstable import CacheSparseTable
+
+__all__ = ["EmbeddingCache", "PythonCache", "NativeCache",
+           "CacheSparseTable"]

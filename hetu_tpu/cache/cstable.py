@@ -1,0 +1,189 @@
+"""CacheSparseTable: the cache-enabled embedding facade (HET, VLDB'22).
+
+Reference: python/hetu/cstable.py:19-187 (embedding_lookup/update/
+push_pull + perf counters) over src/hetu_cache's bounded-staleness sync
+protocol (hetu_client.cc kSyncEmbedding/kPushEmbedding/kPushSyncEmbedding).
+
+Protocol here (same semantics, TPU-shaped):
+  lookup(ids):
+    - cache hits within the pull staleness bound are served locally;
+    - hits whose version lags the server by > pull_bound are re-synced via
+      the PS sync_embedding RPC (server returns only rows that moved);
+    - misses are sparse-pulled and inserted (evicted dirty lines flush
+      their accumulated updates to the PS on the way out).
+  update(ids, deltas):
+    - deltas (already optimizer-scaled, e.g. -lr*grad) accumulate into
+      cached lines (write-back);
+    - once any line holds > push_bound unpushed updates, all dirty lines
+      are pushed via push_embedding.
+
+Async variants return concurrent.futures so the next batch's lookup can
+overlap the current step (reference prefetch + CSEvent, stream.py:90-105).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .cache import EmbeddingCache
+
+
+class CacheSparseTable:
+    def __init__(self, limit, vocab_size, width, key, comm=None,
+                 policy="LFUOpt", pull_bound=0, push_bound=0,
+                 prefer_native=True):
+        """``comm``: a PS client/server exposing sparse_pull/sparse_push/
+        sync_embedding/push_embedding (ps/client.py or ps/server.py)."""
+        self.key = key
+        self.vocab = int(vocab_size)
+        self.width = int(width)
+        self.comm = comm
+        self.pull_bound = int(pull_bound)
+        self.push_bound = int(push_bound)
+        self.cache = EmbeddingCache(limit, width, policy,
+                                    prefer_native=prefer_native)
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        # cache state is not thread-safe; one lock serializes the sync
+        # methods against pool-submitted async calls
+        self._lock = threading.RLock()
+        # perf counters (reference cstable.py:126-187)
+        self.num_lookups = 0
+        self.num_rows_looked = 0
+        self.num_pulled_rows = 0
+        self.num_pushed_rows = 0
+        self.num_synced_rows = 0
+
+    # ------------------------------------------------------------------ #
+
+    def embedding_lookup(self, ids):
+        """ids: any int array; returns float32 rows [..., width]."""
+        with self._lock:
+            return self._lookup(ids)
+
+    def _lookup(self, ids):
+        shape = np.shape(ids)
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        self.num_lookups += 1
+        self.num_rows_looked += len(uniq)
+
+        rows, hit = self.cache.lookup(uniq)
+
+        # bounded-staleness re-sync of hits.  Locally-dirty lines are
+        # excluded from the refresh: overwriting them would drop our own
+        # unpushed updates (read-your-writes); they re-sync right after
+        # their flush (reference orders this with push_sync_embedding).
+        if hit.any() and self.comm is not None:
+            hit_ids = uniq[hit]
+            clean = ~self.cache.dirty(hit_ids)
+            sync_ids = hit_ids[clean]
+            if len(sync_ids):
+                stored_v = self.cache.versions(sync_ids)
+                s_ids, s_rows, s_vers = self.comm.sync_embedding(
+                    self.key, sync_ids, stored_v, self.pull_bound)
+                if len(s_ids):
+                    self.cache.refresh(s_ids, s_rows, s_vers)
+                    self.num_synced_rows += len(s_ids)
+                    pos = {int(i): k for k, i in enumerate(uniq)}
+                    for j, sid in enumerate(s_ids):
+                        rows[pos[int(sid)]] = s_rows[j]
+
+        # pull misses — one RPC: sync_embedding against -inf versions
+        # returns (ids, rows, versions) together
+        miss_ids = uniq[~hit]
+        if len(miss_ids):
+            assert self.comm is not None, "cache miss with no PS attached"
+            pulled, vers = self._fetch_rows(miss_ids)
+            ev_ids, ev_grads = self.cache.insert(miss_ids, pulled, vers)
+            if len(ev_ids):
+                self.comm.push_embedding(self.key, ev_ids, ev_grads)
+                self.num_pushed_rows += len(ev_ids)
+            self.num_pulled_rows += len(miss_ids)
+            rows[~hit] = pulled
+
+        return rows[inv].reshape(*shape, self.width)
+
+    def embedding_update(self, ids, deltas):
+        """Accumulate optimizer-scaled deltas; push when past push_bound."""
+        with self._lock:
+            self._update(ids, deltas)
+
+    def _update(self, ids, deltas):
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(flat), self.width)
+        # merge duplicate ids (scatter-add semantics)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        merged = np.zeros((len(uniq), self.width), np.float32)
+        np.add.at(merged, inv, deltas)
+        missed = self.cache.update(uniq, merged)
+        if missed and self.comm is not None:
+            # uncached ids (version query leaves policy state untouched):
+            # push straight through to the PS
+            cold_mask = self.cache.versions(uniq) == -1
+            self.comm.push_embedding(self.key, uniq[cold_mask],
+                                     merged[cold_mask])
+            self.num_pushed_rows += int(cold_mask.sum())
+        if self.comm is not None and \
+                self.cache.max_updates() > self.push_bound:
+            self.flush()
+
+    def embedding_push_pull(self, push_ids, deltas, pull_ids):
+        """Fused update+lookup (reference push_pull, cstable.py:95-116)."""
+        with self._lock:
+            self._update(push_ids, deltas)
+            return self._lookup(pull_ids)
+
+    def flush(self):
+        """Push all dirty lines to the PS.  No-op without a PS (draining
+        the accumulators with nowhere to send them would lose updates)."""
+        if self.comm is None:
+            return
+        with self._lock:
+            ids, grads = self.cache.collect_dirty()
+            if len(ids):
+                self.comm.push_embedding(self.key, ids, grads)
+                self.num_pushed_rows += len(ids)
+
+    # async variants (reference wait_t futures, python_api.cc:76);
+    # safe to overlap with the sync methods — everything serializes on
+    # self._lock
+    def embedding_lookup_async(self, ids):
+        return self._pool.submit(self.embedding_lookup, ids)
+
+    def embedding_update_async(self, ids, deltas):
+        return self._pool.submit(self.embedding_update, ids, deltas)
+
+    # ------------------------------------------------------------------ #
+
+    def _fetch_rows(self, ids):
+        """Rows + versions for uncached ids in ONE RPC when the comm
+        speaks sync_embedding (stored_version=-inf returns everything);
+        falls back to sparse_pull (versions unknown -> 0)."""
+        sync = getattr(self.comm, "sync_embedding", None)
+        if sync is not None:
+            s_ids, s_rows, s_vers = sync(
+                self.key, ids, np.full(len(ids), -1 << 40, np.int64), 0)
+            if len(s_ids) == len(ids):
+                order = {int(i): k for k, i in enumerate(s_ids)}
+                perm = np.array([order[int(i)] for i in ids])
+                return (np.asarray(s_rows, np.float32)[perm],
+                        np.asarray(s_vers, np.int64)[perm])
+        return (np.asarray(self.comm.sparse_pull(self.key, ids),
+                           np.float32), None)
+
+    def perf_summary(self):
+        c = self.cache.counters()
+        total = c["hits"] + c["misses"]
+        return {
+            "lookups": self.num_lookups,
+            "rows_looked": self.num_rows_looked,
+            "hit_rate": c["hits"] / total if total else 0.0,
+            "pulled_rows": self.num_pulled_rows,
+            "pushed_rows": self.num_pushed_rows,
+            "synced_rows": self.num_synced_rows,
+            "evictions": c["evictions"],
+            "cache_size": self.cache.size(),
+        }

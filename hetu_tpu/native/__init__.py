@@ -1,0 +1,33 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference keeps its PS transport, server, and embedding cache in C++
+(ps-lite, src/hetu_cache — SURVEY.md §2.2/2.3).  Here the host-side systems
+code that survives on TPU is likewise native: this package builds small
+C++ shared libraries at first import (cached next to the source) and loads
+them via ctypes.  Every consumer has a pure-Python fallback so the
+framework works where no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import ctypes
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_and_load(src_name, lib_name, extra_flags=()):
+    """Compile ``src_name`` to ``lib_name`` (if stale) and dlopen it.
+    Returns the ctypes.CDLL or None when no compiler is available."""
+    src = os.path.join(_DIR, src_name)
+    lib = os.path.join(_DIR, lib_name)
+    try:
+        if (not os.path.exists(lib)
+                or os.path.getmtime(lib) < os.path.getmtime(src)):
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                   *extra_flags, src, "-o", lib]
+            subprocess.run(cmd, check=True, capture_output=True)
+        return ctypes.CDLL(lib)
+    except (OSError, subprocess.CalledProcessError):
+        return None

@@ -1,0 +1,326 @@
+// HET-style client-side embedding cache: native core.
+//
+// TPU-native counterpart of the reference's C++ hetu_cache
+// (src/hetu_cache/include/cache.h:21-60 CacheBase with pull/push staleness
+// bounds; lru_cache.h:17 / lfu_cache.h:17 / lfuopt_cache.h:18 policies;
+// embedding.h:19 per-row Line with version).  Re-designed, not translated:
+// one flat C ABI (ctypes-friendly, no pybind11 in this image), row storage
+// in a single contiguous float buffer (slot-indexed, so lookups produce a
+// gather the caller can ship to the TPU in one host->device transfer), and
+// policy bookkeeping in intrusive lists over slot indices.
+//
+// Policies:
+//   0 = LRU    doubly-linked recency list, O(1) touch/evict
+//   1 = LFU    frequency buckets (freq -> LRU list), O(1) touch/evict
+//   2 = LFUOpt LFU whose counters age on insert pressure (evict scans the
+//              minimum bucket but halves frequencies when the min bucket
+//              drains), approximating the reference's optimized LFU.
+//
+// Build: g++ -O3 -shared -fPIC cache.cpp -o libhetu_cache.so
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <map>
+#include <list>
+#include <vector>
+
+namespace {
+
+struct Line {
+  int64_t id;
+  int64_t version;      // server version at fetch time
+  int64_t updates;      // local (unpushed) update count
+  bool dirty;
+  int64_t freq;         // LFU counter
+  std::list<int64_t>::iterator pos;  // position in its recency/freq list
+};
+
+struct Cache {
+  int policy;           // 0 LRU, 1 LFU, 2 LFUOpt
+  int64_t limit;        // max rows
+  int64_t width;        // row width (floats)
+  std::vector<float> rows;       // limit x width value storage
+  std::vector<float> grads;      // limit x width accumulated updates
+  std::unordered_map<int64_t, int64_t> slot_of;  // id -> slot
+  std::vector<Line> lines;       // slot -> metadata
+  std::vector<int64_t> free_slots;
+  // LRU: one list (front = most recent).  LFU: per-freq lists.
+  std::list<int64_t> lru;
+  std::map<int64_t, std::list<int64_t>> buckets;
+  int64_t hits = 0, misses = 0, evictions = 0;
+  int64_t max_upd = 0;  // running max of per-line unpushed updates
+
+  explicit Cache(int policy_, int64_t limit_, int64_t width_)
+      : policy(policy_), limit(limit_), width(width_) {
+    rows.resize(size_t(limit) * width);
+    grads.assign(size_t(limit) * width, 0.f);
+    lines.resize(limit);
+    free_slots.reserve(limit);
+    for (int64_t s = limit - 1; s >= 0; --s) free_slots.push_back(s);
+  }
+
+  void touch(int64_t slot) {
+    Line &ln = lines[slot];
+    if (policy == 0) {
+      lru.erase(ln.pos);
+      lru.push_front(slot);
+      ln.pos = lru.begin();
+    } else {
+      auto &from = buckets[ln.freq];
+      from.erase(ln.pos);
+      if (from.empty()) buckets.erase(ln.freq);
+      ln.freq += 1;
+      auto &to = buckets[ln.freq];
+      to.push_front(slot);
+      ln.pos = to.begin();
+    }
+  }
+
+  void attach(int64_t slot, int64_t freq0) {
+    Line &ln = lines[slot];
+    if (policy == 0) {
+      lru.push_front(slot);
+      ln.pos = lru.begin();
+    } else {
+      ln.freq = freq0;
+      auto &b = buckets[freq0];
+      b.push_front(slot);
+      ln.pos = b.begin();
+    }
+  }
+
+  // pick the victim slot per policy (caller guarantees non-empty)
+  int64_t victim() {
+    if (policy == 0) return lru.back();
+    auto it = buckets.begin();
+    int64_t v = it->second.back();
+    if (policy == 2 && it->second.size() == 1) {
+      // LFUOpt aging: when the min bucket is about to drain, halve all
+      // frequencies so long-lived-but-cold lines can't pin the cache
+      age();
+    }
+    return v;
+  }
+
+  void age() {
+    std::map<int64_t, std::list<int64_t>> fresh;
+    for (auto &kv : buckets) {
+      int64_t nf = kv.first / 2;
+      auto &dst = fresh[nf];
+      for (auto s : kv.second) {
+        lines[s].freq = nf;
+        dst.push_back(s);
+        lines[s].pos = std::prev(dst.end());
+      }
+    }
+    buckets.swap(fresh);
+  }
+
+  void detach(int64_t slot) {
+    Line &ln = lines[slot];
+    if (policy == 0) {
+      lru.erase(ln.pos);
+    } else {
+      auto &b = buckets[ln.freq];
+      b.erase(ln.pos);
+      if (b.empty()) buckets.erase(ln.freq);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *cache_create(int policy, int64_t limit, int64_t width) {
+  return new Cache(policy, limit, width);
+}
+
+void cache_destroy(void *h) { delete static_cast<Cache *>(h); }
+
+int64_t cache_size(void *h) {
+  return static_cast<int64_t>(static_cast<Cache *>(h)->slot_of.size());
+}
+
+void cache_counters(void *h, int64_t *hits, int64_t *misses,
+                    int64_t *evictions) {
+  Cache *c = static_cast<Cache *>(h);
+  *hits = c->hits;
+  *misses = c->misses;
+  *evictions = c->evictions;
+}
+
+// Lookup n ids; copy hit rows into out (n x width) and set hit[i] = 1.
+// Misses leave their out row untouched and hit[i] = 0.
+void cache_lookup(void *h, const int64_t *ids, int64_t n, float *out,
+                  uint8_t *hit) {
+  Cache *c = static_cast<Cache *>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = c->slot_of.find(ids[i]);
+    if (it == c->slot_of.end()) {
+      hit[i] = 0;
+      c->misses++;
+      continue;
+    }
+    hit[i] = 1;
+    c->hits++;
+    c->touch(it->second);
+    std::memcpy(out + i * c->width, c->rows.data() + it->second * c->width,
+                sizeof(float) * c->width);
+  }
+}
+
+// Versions of cached ids (-1 when not cached).
+void cache_versions(void *h, const int64_t *ids, int64_t n, int64_t *vers) {
+  Cache *c = static_cast<Cache *>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = c->slot_of.find(ids[i]);
+    vers[i] = it == c->slot_of.end() ? -1 : c->lines[it->second].version;
+  }
+}
+
+// Insert/refresh n rows.  Evicted dirty lines are reported through
+// evicted_ids/evicted_grads (each sized max_evicted x width); returns the
+// number of evicted dirty lines written (the caller pushes them to the PS —
+// reference: eviction flushes pending updates, hetu_client.cc).
+int64_t cache_insert(void *h, const int64_t *ids, int64_t n,
+                     const float *rows, const int64_t *versions,
+                     int64_t *evicted_ids, float *evicted_grads,
+                     int64_t max_evicted) {
+  Cache *c = static_cast<Cache *>(h);
+  int64_t n_ev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t id = ids[i];
+    auto it = c->slot_of.find(id);
+    int64_t slot;
+    if (it != c->slot_of.end()) {
+      slot = it->second;  // refresh value + version, keep policy position
+      c->touch(slot);
+    } else {
+      if ((int64_t)c->slot_of.size() >= c->limit) {
+        int64_t v = c->victim();
+        Line &vl = c->lines[v];
+        if (vl.dirty && n_ev < max_evicted) {
+          evicted_ids[n_ev] = vl.id;
+          std::memcpy(evicted_grads + n_ev * c->width,
+                      c->grads.data() + v * c->width,
+                      sizeof(float) * c->width);
+          n_ev++;
+        }
+        c->detach(v);
+        c->slot_of.erase(vl.id);
+        std::memset(c->grads.data() + v * c->width, 0,
+                    sizeof(float) * c->width);
+        c->free_slots.push_back(v);
+        c->evictions++;
+      }
+      slot = c->free_slots.back();
+      c->free_slots.pop_back();
+      c->slot_of.emplace(id, slot);
+      Line &ln = c->lines[slot];
+      ln.id = id;
+      ln.dirty = false;
+      ln.updates = 0;
+      c->attach(slot, 1);
+    }
+    Line &ln = c->lines[slot];
+    ln.version = versions ? versions[i] : 0;
+    std::memcpy(c->rows.data() + slot * c->width, rows + i * c->width,
+                sizeof(float) * c->width);
+  }
+  return n_ev;
+}
+
+// Accumulate grads into cached lines (ids must be cached; unknown ids are
+// ignored and counted in the return value so the caller can route them
+// straight to the PS).  Updates the local value too (write-back cache).
+int64_t cache_update(void *h, const int64_t *ids, int64_t n,
+                     const float *grads) {
+  Cache *c = static_cast<Cache *>(h);
+  int64_t missed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = c->slot_of.find(ids[i]);
+    if (it == c->slot_of.end()) {
+      missed++;
+      continue;
+    }
+    int64_t slot = it->second;
+    Line &ln = c->lines[slot];
+    float *g = c->grads.data() + slot * c->width;
+    float *v = c->rows.data() + slot * c->width;
+    const float *src = grads + i * c->width;
+    for (int64_t j = 0; j < c->width; ++j) {
+      g[j] += src[j];
+      v[j] += src[j];
+    }
+    ln.dirty = true;
+    ln.updates += 1;
+    if (ln.updates > c->max_upd) c->max_upd = ln.updates;
+    c->touch(slot);
+  }
+  return missed;
+}
+
+// Max local update count over cached lines (push-bound staleness check,
+// reference cache.h push_bound_).  O(1): maintained by cache_update,
+// reset by cache_collect_dirty.
+int64_t cache_max_updates(void *h) {
+  return static_cast<Cache *>(h)->max_upd;
+}
+
+// Dirty flags for n ids (0 for unknown ids).
+void cache_dirty(void *h, const int64_t *ids, int64_t n, uint8_t *out) {
+  Cache *c = static_cast<Cache *>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = c->slot_of.find(ids[i]);
+    out[i] = it != c->slot_of.end() && c->lines[it->second].dirty;
+  }
+}
+
+// Drain dirty lines: fill ids/grads (up to max_n), clear dirty+updates,
+// zero grad accumulators.  Returns count.
+int64_t cache_collect_dirty(void *h, int64_t *ids_out, float *grads_out,
+                            int64_t max_n) {
+  Cache *c = static_cast<Cache *>(h);
+  int64_t k = 0;
+  for (auto &kv : c->slot_of) {
+    if (k >= max_n) break;
+    Line &ln = c->lines[kv.second];
+    if (!ln.dirty) continue;
+    ids_out[k] = ln.id;
+    float *g = c->grads.data() + kv.second * c->width;
+    std::memcpy(grads_out + k * c->width, g, sizeof(float) * c->width);
+    std::memset(g, 0, sizeof(float) * c->width);
+    ln.dirty = false;
+    ln.updates = 0;
+    k++;
+  }
+  if (k > 0) {
+    // recompute the running max only over lines still dirty (those that
+    // did not fit in max_n)
+    c->max_upd = 0;
+    for (auto &kv : c->slot_of) {
+      const Line &ln = c->lines[kv.second];
+      if (ln.dirty && ln.updates > c->max_upd) c->max_upd = ln.updates;
+    }
+  }
+  return k;
+}
+
+// Overwrite rows+versions for already-cached ids (server refresh after a
+// kSyncEmbedding round; unknown ids ignored).
+void cache_refresh(void *h, const int64_t *ids, int64_t n, const float *rows,
+                   const int64_t *versions) {
+  Cache *c = static_cast<Cache *>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = c->slot_of.find(ids[i]);
+    if (it == c->slot_of.end()) continue;
+    int64_t slot = it->second;
+    std::memcpy(c->rows.data() + slot * c->width, rows + i * c->width,
+                sizeof(float) * c->width);
+    c->lines[slot].version = versions[i];
+  }
+}
+
+}  // extern "C"
