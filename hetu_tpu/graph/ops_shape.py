@@ -148,11 +148,13 @@ def transpose_op(a, perm=None, ctx=None):
 
 
 def slice_op(a, begin, size, ctx=None):
+    """size entries of -1 mean "to the end" (reference gpu_ops/Slice.py)."""
     begin = tuple(int(b) for b in begin)
     size = tuple(int(s) for s in size)
 
     def f(x):
-        idx = tuple(slice(b, b + s) for b, s in zip(begin, size))
+        idx = tuple(slice(b, None if s == -1 else b + s)
+                    for b, s in zip(begin, size))
         return x[idx]
     return _simple("Slice", f, a, ctx=ctx)
 

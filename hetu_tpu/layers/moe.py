@@ -18,7 +18,8 @@ from .. import initializers as init
 from ..graph import (
     softmax_op, topk_idx_op, split_op, one_hot_op, array_reshape_op,
     cumsum_with_bias_op, reduce_sum_op, reduce_mean_op, reducesumaxiszero_op,
-    mul_op, matmul_op, broadcastto_op, concatenate_op, relu_op, mul_byconst_op,
+    mul_op, matmul_op, broadcastto_op, concatenate_op, relu_op, gelu_op,
+    mul_byconst_op,
     indexing_op, scatter1d_op, addbyconst_op, add_op,
 )
 from ..graph.ops_misc import Variable
@@ -211,8 +212,7 @@ class Expert(BaseLayer):
         self.keep_prob = 1 - dropout_rate
         self.bias = bias
         if isinstance(activation, str):
-            assert activation == "relu"
-            activation = relu_op
+            activation = {"relu": relu_op, "gelu": gelu_op}[activation]
         self.activation = activation
         self.initializer = initializer or init.GenXavierUniform()
         self.name = name

@@ -1,0 +1,253 @@
+"""BERT (reference examples/nlp/bert/hetu_bert.py, ~10.7k-LoC directory).
+
+Class structure mirrors the reference/HuggingFace lineage: Embeddings ->
+Encoder(NxLayer) -> Pooler, with task heads (pretraining = MLM + NSP,
+sequence classification for GLUE).  Hidden states flow flattened as
+(B*S, H) 2-D matmuls — the MXU-friendly layout — exactly like the
+reference keeps them for its cuBLAS path.
+
+Static batch/seq are constructor arguments because the graph compiles to
+a fixed-shape XLA program (SURVEY.md §7 "static shapes").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import initializers as init
+from .. import layers
+from ..graph import (
+    embedding_lookup_op, array_reshape_op, broadcast_shape_op, dropout_op,
+    matmul_op, broadcastto_op, relu_op, gelu_op, tanh_op, slice_op,
+    softmaxcrossentropy_sparse_op, crossentropy_sparse_op, reduce_mean_op,
+    softmaxcrossentropy_op, mul_byconst_op, addbyconst_op, linear_op,
+    one_hot_op, opposite_op,
+)
+from ..graph.ops_misc import Variable
+
+
+class BertConfig:
+    """Hyper-parameters (reference hetu_bert.py BertConfig)."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, batch_size=8, seq_len=128,
+                 use_flash_attention=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.use_flash_attention = use_flash_attention
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        kw.setdefault("hidden_size", 1024)
+        kw.setdefault("num_hidden_layers", 24)
+        kw.setdefault("num_attention_heads", 16)
+        kw.setdefault("intermediate_size", 4096)
+        return cls(**kw)
+
+
+class BertEmbeddings:
+    """word + position + token_type embeddings -> LN -> dropout."""
+
+    def __init__(self, config: BertConfig, name="bert_embeddings"):
+        c = config
+        std = c.initializer_range
+        self.config = c
+        self.word_embeddings = init.random_normal(
+            (c.vocab_size, c.hidden_size), stddev=std,
+            name=name + "_word_embeddings")
+        self.position_embeddings = init.random_normal(
+            (c.max_position_embeddings, c.hidden_size), stddev=std,
+            name=name + "_position_embeddings")
+        self.token_type_embeddings = init.random_normal(
+            (c.type_vocab_size, c.hidden_size), stddev=std,
+            name=name + "_token_type_embeddings")
+        self.layer_norm = layers.LayerNorm(c.hidden_size, name=name + "_ln")
+
+    def __call__(self, input_ids, token_type_ids=None):
+        c = self.config
+        b, s, h = c.batch_size, c.seq_len, c.hidden_size
+        emb = embedding_lookup_op(self.word_embeddings, input_ids)
+        pos = slice_op(self.position_embeddings, (0, 0), (s, h))
+        emb = emb + broadcast_shape_op(pos, (b, s, h), add_axes=[0])
+        if token_type_ids is not None:
+            emb = emb + embedding_lookup_op(self.token_type_embeddings,
+                                            token_type_ids)
+        emb = array_reshape_op(emb, [b * s, h])
+        emb = self.layer_norm(emb)
+        if c.hidden_dropout_prob > 0:
+            emb = dropout_op(emb, 1.0 - c.hidden_dropout_prob)
+        return emb
+
+
+class BertLayer:
+    """One encoder block: self-attention -> add&norm -> FFN -> add&norm."""
+
+    def __init__(self, config: BertConfig, name="bert_layer"):
+        c = config
+        act = gelu_op if c.hidden_act == "gelu" else relu_op
+        self.config = c
+        self.act = act
+        self.attention = layers.MultiHeadAttention(
+            c.hidden_size, c.num_attention_heads, c.seq_len, c.batch_size,
+            dropout_rate=c.attention_probs_dropout_prob,
+            use_flash=c.use_flash_attention, name=name + "_attn")
+        self.attn_ln = layers.LayerNorm(c.hidden_size, name=name + "_attn_ln")
+        self.intermediate = layers.Linear(c.hidden_size, c.intermediate_size,
+                                          name=name + "_intermediate")
+        self.output = layers.Linear(c.intermediate_size, c.hidden_size,
+                                    name=name + "_output")
+        self.out_ln = layers.LayerNorm(c.hidden_size, name=name + "_out_ln")
+
+    def __call__(self, hidden, attention_mask=None):
+        c = self.config
+        attn = self.attention(hidden, attention_mask=attention_mask)
+        if c.hidden_dropout_prob > 0:
+            attn = dropout_op(attn, 1.0 - c.hidden_dropout_prob)
+        hidden = self.attn_ln(hidden + attn)
+        ffn = self.output(self.act(self.intermediate(hidden)))
+        if c.hidden_dropout_prob > 0:
+            ffn = dropout_op(ffn, 1.0 - c.hidden_dropout_prob)
+        return self.out_ln(hidden + ffn)
+
+
+class BertPooler:
+    """tanh projection of the [CLS] token."""
+
+    def __init__(self, config: BertConfig, name="bert_pooler"):
+        self.config = config
+        self.dense = layers.Linear(config.hidden_size, config.hidden_size,
+                                   name=name + "_dense")
+
+    def __call__(self, sequence_output):
+        c = self.config
+        x = array_reshape_op(sequence_output,
+                             [c.batch_size, c.seq_len, c.hidden_size])
+        cls = slice_op(x, (0, 0, 0), (c.batch_size, 1, c.hidden_size))
+        cls = array_reshape_op(cls, [c.batch_size, c.hidden_size])
+        return tanh_op(self.dense(cls))
+
+
+class BertModel:
+    """Backbone; returns (sequence_output (B*S,H), pooled_output (B,H))."""
+
+    def __init__(self, config: BertConfig, name="bert"):
+        self.config = config
+        self.embeddings = BertEmbeddings(config, name=name + "_embeddings")
+        self.encoder_layers = [BertLayer(config, name=f"{name}_layer{i}")
+                               for i in range(config.num_hidden_layers)]
+        self.pooler = BertPooler(config, name=name + "_pooler")
+
+    def attention_mask_from_input(self, attention_mask):
+        """(B, S) {0,1} mask -> additive (B,1,1,S): (1-m) * -10000."""
+        c = self.config
+        m = array_reshape_op(attention_mask, [c.batch_size, 1, 1, c.seq_len])
+        return mul_byconst_op(addbyconst_op(opposite_op(m), 1.0), -10000.0)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        hidden = self.embeddings(input_ids, token_type_ids)
+        add_mask = None
+        if attention_mask is not None:
+            add_mask = self.attention_mask_from_input(attention_mask)
+        for layer in self.encoder_layers:
+            hidden = layer(hidden, attention_mask=add_mask)
+        return hidden, self.pooler(hidden)
+
+
+class BertForPreTraining:
+    """MLM + NSP heads (reference hetu_bert.py BertForPreTraining)."""
+
+    def __init__(self, config: BertConfig, name="bert"):
+        c = config
+        self.config = c
+        self.bert = BertModel(config, name=name)
+        self.transform = layers.Linear(c.hidden_size, c.hidden_size,
+                                       name=name + "_mlm_transform")
+        self.transform_ln = layers.LayerNorm(c.hidden_size,
+                                             name=name + "_mlm_ln")
+        self.decoder_bias = init.zeros((c.vocab_size,),
+                                       name=name + "_mlm_bias")
+        self.nsp = layers.Linear(c.hidden_size, 2, name=name + "_nsp")
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 masked_lm_labels=None, next_sentence_label=None):
+        c = self.config
+        seq_out, pooled = self.bert(input_ids, token_type_ids,
+                                    attention_mask)
+        h = self.transform_ln(gelu_op(self.transform(seq_out)))
+        # tied decoder: logits = h @ word_emb^T + bias
+        logits = matmul_op(h, self.bert.embeddings.word_embeddings,
+                           trans_B=True)
+        logits = logits + broadcastto_op(self.decoder_bias, logits)
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is None:
+            return logits, nsp_logits
+        mlm_labels_flat = array_reshape_op(masked_lm_labels,
+                                           [c.batch_size * c.seq_len])
+        mlm_loss = softmaxcrossentropy_sparse_op(
+            logits, mlm_labels_flat, ignored_index=-1)
+        nsp_loss = softmaxcrossentropy_sparse_op(nsp_logits,
+                                                 next_sentence_label)
+        loss = reduce_mean_op(mlm_loss, [0]) + reduce_mean_op(nsp_loss, [0])
+        return loss, logits, nsp_logits
+
+
+class BertForMaskedLM:
+    def __init__(self, config: BertConfig, name="bert"):
+        self.pretraining = BertForPreTraining(config, name=name)
+        self.config = config
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 masked_lm_labels=None):
+        c = self.config
+        out = self.pretraining(input_ids, token_type_ids, attention_mask)
+        logits, _ = out
+        if masked_lm_labels is None:
+            return logits
+        labels_flat = array_reshape_op(masked_lm_labels,
+                                       [c.batch_size * c.seq_len])
+        loss = softmaxcrossentropy_sparse_op(logits, labels_flat,
+                                             ignored_index=-1)
+        return reduce_mean_op(loss, [0]), logits
+
+
+class BertForSequenceClassification:
+    """GLUE-style classifier head (reference hetu_bert.py)."""
+
+    def __init__(self, config: BertConfig, num_labels=2, name="bert"):
+        c = config
+        self.config = c
+        self.num_labels = num_labels
+        self.bert = BertModel(config, name=name)
+        self.classifier = layers.Linear(c.hidden_size, num_labels,
+                                        name=name + "_classifier")
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 labels=None):
+        c = self.config
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        if c.hidden_dropout_prob > 0:
+            pooled = dropout_op(pooled, 1.0 - c.hidden_dropout_prob)
+        logits = self.classifier(pooled)
+        if labels is None:
+            return logits
+        loss = softmaxcrossentropy_sparse_op(logits, labels)
+        return reduce_mean_op(loss, [0]), logits
