@@ -76,7 +76,16 @@ def main():
 
     x = ht.placeholder_op("x")
     y_ = ht.placeholder_op("y_")
-    loss, y = builder(x, y_)
+    import inspect
+    params = inspect.signature(builder).parameters
+    if "num_class" in params:
+        loss, y = builder(x, y_, num_class=n_cls)
+    elif "dimoutput" in params:
+        loss, y = builder(x, y_, dimoutput=n_cls)
+    else:
+        assert n_cls == 10, (
+            f"{args.model} has a fixed 10-class head; got {n_cls} classes")
+        loss, y = builder(x, y_)
 
     opts = {"sgd": ht.optim.SGDOptimizer,
             "momentum": ht.optim.MomentumOptimizer,

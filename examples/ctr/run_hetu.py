@@ -85,7 +85,7 @@ def main():
                                        args.feature_dim)
             return {dense: d, sparse: s, y_: y}
 
-    executor = ht.Executor({"train": [loss, pred, train_op]},
+    executor = ht.Executor({"train": [loss, pred, label, train_op]},
                            comm_mode=args.comm_mode,
                            cstable_policy=args.cache,
                            cache_bound=args.cache_bound)
@@ -94,9 +94,19 @@ def main():
         out = executor.run("train", feed_dict=batch())
         if step % 10 == 0 or step == args.num_steps - 1:
             dt = time.time() - t0
-            logger.info("step %d loss=%.4f (%.1f samples/s)", step,
+            msg = ""
+            if args.all:
+                y_score = np.asarray(out[1])
+                y_true = np.asarray(out[2])
+                if y_score.ndim == 2 and y_score.shape[-1] == 2:
+                    y_score = y_score[:, 1]
+                if y_true.ndim == 2 and y_true.shape[-1] == 2:
+                    y_true = y_true[:, 1]
+                msg = " auc=%.4f" % ht.metrics.auc_score(
+                    y_score.reshape(-1), y_true.reshape(-1))
+            logger.info("step %d loss=%.4f (%.1f samples/s)%s", step,
                         float(np.asarray(out[0]).reshape(-1)[0]),
-                        (step + 1) * args.batch_size / dt)
+                        (step + 1) * args.batch_size / dt, msg)
 
 
 if __name__ == "__main__":

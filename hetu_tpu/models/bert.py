@@ -19,11 +19,10 @@ from .. import layers
 from ..graph import (
     embedding_lookup_op, array_reshape_op, broadcast_shape_op, dropout_op,
     matmul_op, broadcastto_op, relu_op, gelu_op, tanh_op, slice_op,
-    softmaxcrossentropy_sparse_op, crossentropy_sparse_op, reduce_mean_op,
-    softmaxcrossentropy_op, mul_byconst_op, addbyconst_op, linear_op,
-    one_hot_op, opposite_op,
+    softmaxcrossentropy_sparse_op, reduce_mean_op, reduce_sum_op,
+    addbyconst_op, mul_byconst_op, opposite_op, div_op, bool_op,
+    full_like_op,
 )
-from ..graph.ops_misc import Variable
 
 
 class BertConfig:
@@ -172,6 +171,16 @@ class BertModel:
         return hidden, self.pooler(hidden)
 
 
+def _masked_mean(per_token_loss, labels_flat, ignored_index=-1):
+    """Mean over non-ignored positions only (reference averages MLM loss
+    over masked tokens, hetu_bert.py), so the MLM/NSP weighting does not
+    depend on the mask rate."""
+    valid = bool_op(labels_flat, full_like_op(labels_flat, ignored_index),
+                    cond=2)  # labels > ignored_index
+    count = addbyconst_op(reduce_sum_op(valid, [0]), 1e-12)
+    return div_op(reduce_sum_op(per_token_loss, [0]), count)
+
+
 class BertForPreTraining:
     """MLM + NSP heads (reference hetu_bert.py BertForPreTraining)."""
 
@@ -206,7 +215,8 @@ class BertForPreTraining:
             logits, mlm_labels_flat, ignored_index=-1)
         nsp_loss = softmaxcrossentropy_sparse_op(nsp_logits,
                                                  next_sentence_label)
-        loss = reduce_mean_op(mlm_loss, [0]) + reduce_mean_op(nsp_loss, [0])
+        loss = (_masked_mean(mlm_loss, mlm_labels_flat)
+                + reduce_mean_op(nsp_loss, [0]))
         return loss, logits, nsp_logits
 
 
@@ -226,7 +236,7 @@ class BertForMaskedLM:
                                        [c.batch_size * c.seq_len])
         loss = softmaxcrossentropy_sparse_op(logits, labels_flat,
                                              ignored_index=-1)
-        return reduce_mean_op(loss, [0]), logits
+        return _masked_mean(loss, labels_flat), logits
 
 
 class BertForSequenceClassification:

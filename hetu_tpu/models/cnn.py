@@ -19,7 +19,6 @@ from ..graph import (
     matmul_op, broadcastto_op, relu_op, tanh_op, sigmoid_op, conv2d_op,
     max_pool2d_op, avg_pool2d_op, batch_normalization_op, array_reshape_op,
     softmaxcrossentropy_op, reduce_mean_op, slice_op, concat_op, mul_op,
-    dropout_op,
 )
 from ..graph.ops_misc import Variable
 
