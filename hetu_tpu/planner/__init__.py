@@ -1,7 +1,31 @@
 """Auto-parallel planner (Galvatron-equivalent, SURVEY.md §2.6).
 
-Searches per-layer (pp, tp, dp, fsdp, cp) strategies with memory/time cost
-models fed by the collective bandwidth probe (profiler.NCCLProfiler) and
-emits mesh + sharding specs.  Modules land incrementally; see
-planner/cost_model.py and planner/search.py once present.
+Reference: tools/Galvatron — profiler scripts (test_env), cost models
+(utils/cost_model.py), per-layer DP search (utils/dp_utils.py:56-130), and
+a runtime that consumes per-layer (pp,tp,dp,fsdp) configs.  The TPU build
+searches the same lattice plus a `cp` (context-parallel) axis, against
+ICI/DCN-retargeted analytic cost models optionally calibrated by live
+probes, and emits a `jax.sharding.Mesh` + per-layer NamedShardings.
+
+    layers = [LayerSpec.transformer_encoder(1024, 512)] * 24
+    plan = PlannerSearch(layers, global_batch_size=64,
+                         cluster=measure_cluster()).search()
+    ex = Executor(graph, dist_strategy=AutoParallel(plan))
 """
+
+from .cost_model import (ClusterSpec, LayerSpec, MemoryCostModel,
+                         ParallelStrategy, TimeCostModel,
+                         candidate_strategies)
+from .search import DPAlg, ParallelPlan, PlannerSearch, \
+    pipeline_division_even
+from .profiler import (measure_cluster, profile_collective_bandwidth,
+                       profile_layer, profile_matmul_throughput)
+from .apply import AutoParallel, plan_to_json
+
+__all__ = [
+    "ClusterSpec", "LayerSpec", "MemoryCostModel", "TimeCostModel",
+    "ParallelStrategy", "candidate_strategies", "DPAlg", "ParallelPlan",
+    "PlannerSearch", "pipeline_division_even", "measure_cluster",
+    "profile_collective_bandwidth", "profile_layer",
+    "profile_matmul_throughput", "AutoParallel", "plan_to_json",
+]

@@ -1,0 +1,84 @@
+"""Turn a ParallelPlan into an executable configuration.
+
+The reference's Galvatron emits per-layer (pp, tp, dp, fsdp) configs that
+its own PyTorch runtime consumes (hybrid_parallel_model_dist.py).  Here a
+plan becomes (a) a `jax.sharding.Mesh` and (b) an Executor `dist`
+strategy that assigns NamedShardings to variables by layer membership —
+the TPU-native carrier of the same information.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import make_mesh
+from ..parallel.distributed_strategies import BaseSearchingStrategy
+
+
+class AutoParallel(BaseSearchingStrategy):
+    """Executor dist_strategy driven by a planner result.
+
+    ``layer_of(name)`` maps a variable name to a layer index (default: the
+    first integer in the name, the `l{i}_` convention used across
+    hetu_tpu.models).  Column/row split patterns follow ModelParallel4LM.
+    """
+
+    def __init__(self, plan, layer_of=None,
+                 col_patterns=("qkv", "wi", "fc1", "expand", "query",
+                               "key", "value"),
+                 row_patterns=("proj", "wo", "fc2", "reduce", "dense")):
+        super().__init__()
+        self.plan = plan
+        self.layer_of = layer_of or self._default_layer_of
+        self.col_patterns = col_patterns
+        self.row_patterns = row_patterns
+
+    @staticmethod
+    def _default_layer_of(name):
+        m = re.search(r"(\d+)", name)
+        return int(m.group(1)) if m else None
+
+    def _strategy_for(self, name):
+        i = self.layer_of(name)
+        if i is None or not (0 <= i < len(self.plan.strategies)):
+            return self.plan.strategies[0]
+        return self.plan.strategies[i]
+
+    def configure(self, executor):
+        axes = self.plan.mesh_axes()
+        if executor.config.mesh is None:
+            want = {k: v for k, v in axes.items() if v > 1} or {"dp": 1}
+            executor.config.mesh = make_mesh(want)
+        mesh_axes = set(executor.config.mesh.axis_names)
+        for name, node in executor.variables.items():
+            if node.sharding_spec is not None or not node.shape:
+                continue
+            s = self._strategy_for(name)
+            lname = name.lower()
+            dims = len(node.shape)
+            spec = [None] * dims
+            if s.tp > 1 and "tp" in mesh_axes and dims == 2:
+                if any(p in lname for p in self.col_patterns):
+                    spec[1] = "tp"
+                elif any(p in lname for p in self.row_patterns):
+                    spec[0] = "tp"
+            if s.fsdp and "dp" in mesh_axes and dims >= 1:
+                # shard the largest un-sharded dim over dp (ZeRO-3 style)
+                free = [d for d in range(dims) if spec[d] is None]
+                if free:
+                    d = max(free, key=lambda d: node.shape[d])
+                    if node.shape[d] % executor.config.mesh.shape["dp"] == 0:
+                        spec[d] = "dp"
+            if any(spec):
+                node.sharding_spec = P(*spec)
+
+
+def plan_to_json(plan):
+    return {"cost_s": plan.cost,
+            "mesh": plan.mesh_axes(),
+            "stages": plan.stage_assignment(),
+            "layers": [{"name": l.name, "strategy": str(s)}
+                       for l, s in zip(plan.layers, plan.strategies)]}

@@ -1,0 +1,277 @@
+"""Analytic memory / time cost models for the auto-parallel planner.
+
+Galvatron-equivalent (reference tools/Galvatron/utils/cost_model.py:3-36
+``MemoryCostModel``, :38-160 ``TimeCostModel_with_overlap``), re-derived for
+TPU: communication rides ICI (per-axis bidirectional ring bandwidth) or DCN
+for the outermost axis, bf16 compute on the MXU, and XLA's async collectives
+give compute/comm overlap modelled by a single overlap coefficient instead
+of the reference's NCCL/PCIe-specific ``dp_overlap_coe``/``bct_overlap_coe``
+pair (cost_model.py:49-56), which must be re-profiled per topology anyway.
+
+All sizes are bytes, all times seconds, so profiled numbers plug in
+directly.  A :class:`ClusterSpec` holds the hardware constants; defaults
+approximate one TPU v5e chip and can be overwritten by the planner profiler
+(hetu_tpu/planner/profiler.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ParallelStrategy:
+    """One point in the search space: (pp, tp, dp, fsdp, cp).
+
+    Reference strategies are ``[pp, tp, dp, {'fsdp': 0/1, 'tp': consec}]``
+    (dp_utils.py:4-19).  The TPU build adds ``cp`` (context parallel — the
+    reference has no sequence parallelism, SURVEY.md §5.7) and drops the
+    ``tp_consecutive`` flag: mesh-axis order fixes device adjacency once
+    for all (parallel/mesh.py AXIS_ORDER).
+    """
+
+    pp: int = 1
+    tp: int = 1
+    dp: int = 1
+    fsdp: bool = False
+    cp: int = 1
+
+    @property
+    def n_devices(self):
+        return self.pp * self.tp * self.dp * self.cp
+
+    def __str__(self):
+        dp = f"{self.dp}f" if self.fsdp else str(self.dp)
+        s = f"{self.pp}-{self.tp}-{dp}"
+        if self.cp > 1:
+            s += f"-cp{self.cp}"
+        return s
+
+
+@dataclass
+class ClusterSpec:
+    """Hardware constants feeding both cost models.
+
+    Defaults are order-of-magnitude v5e numbers; `planner.profiler`
+    measures the real ones (matmul throughput + per-axis collective
+    bandwidth) the way the reference's Galvatron profiler scripts do
+    (tools/Galvatron/test_env)."""
+
+    n_devices: int = 8
+    hbm_bytes: float = 16e9
+    flops_per_sec: float = 197e12      # bf16 MXU peak, one v5e chip
+    mfu: float = 0.4                   # achieved fraction of peak
+    ici_bandwidth: float = 45e9        # bytes/s per link direction
+    dcn_bandwidth: float = 6.25e9      # bytes/s per host
+    devices_per_host: int = 8          # ICI domain size (one slice/host)
+    overlap: float = 0.7               # fraction of comm hidden by compute
+    bytes_per_param: int = 4           # fp32 master params
+    bytes_per_act: int = 2             # bf16 activations
+
+    def collective_bw(self, axis_size, over_dcn=False):
+        bw = self.dcn_bandwidth if over_dcn else self.ici_bandwidth
+        return bw
+
+    def allreduce_time(self, nbytes, axis_size, over_dcn=False):
+        """Ring allreduce: 2*(k-1)/k * n / bw (same formula the reference
+        uses for dp_message_size, cost_model.py:101)."""
+        if axis_size <= 1 or nbytes == 0:
+            return 0.0
+        k = axis_size
+        return 2.0 * (k - 1) / k * nbytes / self.collective_bw(k, over_dcn)
+
+    def allgather_time(self, nbytes, axis_size, over_dcn=False):
+        if axis_size <= 1 or nbytes == 0:
+            return 0.0
+        k = axis_size
+        return (k - 1) / k * nbytes / self.collective_bw(k, over_dcn)
+
+    reduce_scatter_time = allgather_time
+
+
+@dataclass
+class LayerSpec:
+    """Per-layer quantities the cost models consume.  Either analytic
+    (from hidden/seq sizes) or measured (profiler.profile_layer)."""
+
+    name: str = "enc"
+    param_bytes: float = 0.0           # full (unsharded) parameter bytes
+    flops_per_sample: float = 0.0      # fwd flops for one sample
+    act_bytes_per_sample: float = 0.0  # saved activations, one sample
+    seq_len: int = 1
+    hidden: int = 1
+    # comm volume factor for TP: activations cross the tp cut this many
+    # times per layer fwd (reference uses 4 for encoders, 6 for decoders,
+    # cost_model.py:102-103)
+    tp_comm_factor: int = 4
+    # measured per-sample forward time (seconds); overrides the flops
+    # estimate when set
+    fwd_time_per_sample: float | None = None
+
+    @classmethod
+    def transformer_encoder(cls, hidden, seq_len, ffn_mult=4, name="enc",
+                            bytes_per_param=4, bytes_per_act=2):
+        """Analytic spec for one pre/post-LN transformer encoder layer."""
+        p = (4 * hidden * hidden            # qkv + out proj
+             + 2 * ffn_mult * hidden * hidden  # ffn in/out
+             + 4 * hidden)                  # ln scales/biases (approx)
+        flops = 2 * p * seq_len + 2 * 2 * seq_len * seq_len * hidden
+        act = seq_len * hidden * (8 + 2 * ffn_mult)
+        return cls(name=name, param_bytes=p * bytes_per_param,
+                   flops_per_sample=flops,
+                   act_bytes_per_sample=act * bytes_per_act,
+                   seq_len=seq_len, hidden=hidden, tp_comm_factor=4)
+
+
+class MemoryCostModel:
+    """Per-device memory for one layer under a strategy.
+
+    Mirrors reference MemoryCostModel semantics (cost_model.py:3-36):
+    model states = params + grads + 2 optimizer moments (4x params, as the
+    reference's ``model_states_size = 4 * parameter_size``); fsdp divides
+    states by dp with the same +0.025 safety bias; activations scale with
+    the per-device batch.  TP divides params and activations; CP divides
+    activations along sequence."""
+
+    FSDP_BIAS = 0.025
+
+    def __init__(self, strategy: ParallelStrategy, layer: LayerSpec,
+                 global_batch_size: int, cluster: ClusterSpec):
+        s, l = strategy, layer
+        self.strategy = s
+        params = l.param_bytes / s.tp
+        states = 4.0 * params
+        if s.fsdp and s.dp > 1:
+            states *= (1.0 / s.dp + self.FSDP_BIAS)
+        local_bs = max(global_batch_size / (s.dp * s.pp), 1e-9)
+        acts = l.act_bytes_per_sample * local_bs / (s.tp * s.cp)
+        self.model_states = states
+        self.activation = acts
+        self.total = states + acts
+
+    def get_memory_cost(self):
+        return {"model_states": self.model_states,
+                "activation": self.activation, "total": self.total}
+
+
+class TimeCostModel:
+    """Per-layer step time (fwd+bwd+grad sync) under a strategy.
+
+    Reference behavior (TimeCostModel_with_overlap, cost_model.py:38-160):
+    compute scales 1/tp, bwd = 2x fwd, DP gradient allreduce partially
+    overlaps backward, TP adds 4 activation collectives/layer, fsdp adds a
+    param allgather each of fwd/bwd, pipeline amortizes by microbatching
+    ((pp + m - 1) / (pp * m), cost_model.py:124).  TPU re-derivation: one
+    overlap coefficient, per-axis ICI rings, cp adds a KV ppermute ring
+    whose volume is the attention KV stream."""
+
+    def __init__(self, strategy: ParallelStrategy, layer: LayerSpec,
+                 global_batch_size: int, cluster: ClusterSpec,
+                 num_microbatches: int | None = None,
+                 pp_boundary_share: float = 1.0):
+        s, l, c = strategy, layer, cluster
+        # per-device batch through a stage: gbs/dp (the /pp is carried by
+        # the bubble factor below, reference fct = fwd * bs * layer_num,
+        # cost_model.py:94 — bs = gbs/dp, NOT /pp)
+        local_bs = max(global_batch_size / s.dp, 1e-9)
+        m = num_microbatches or 4 * max(s.pp, 1)
+
+        # --- compute ---
+        if l.fwd_time_per_sample is not None:
+            fwd = l.fwd_time_per_sample * local_bs / (s.tp * s.cp)
+        else:
+            fwd = (l.flops_per_sample * local_bs
+                   / (s.tp * s.cp) / (c.flops_per_sec * c.mfu))
+        bwd = 2.0 * fwd
+        compute = fwd + bwd
+        # pipeline bubble amortization (reference pipe_with_microbatch,
+        # cost_model.py:124): x(pp+m-1)/(pp*m) = the 1/pp layer split plus
+        # the (pp-1)/m bubble
+        if s.pp > 1:
+            compute *= (s.pp + m - 1) / (s.pp * m)
+
+        # Axis placement follows mesh.AXIS_ORDER (tp/cp innermost): an
+        # axis rides DCN once the devices inside it span more than one
+        # ICI domain.
+        tp_over_dcn = s.tp > c.devices_per_host
+        cp_over_dcn = s.cp * s.tp > c.devices_per_host and s.cp > 1
+        dp_over_dcn = s.dp * s.cp * s.tp > c.devices_per_host and s.dp > 1
+
+        # --- gradient sync (dp axis) ---
+        grad_bytes = l.param_bytes / s.tp
+        if s.fsdp:
+            # reduce-scatter grads + allgather params twice (fwd+bwd)
+            dp_comm = (c.reduce_scatter_time(grad_bytes, s.dp,
+                                             dp_over_dcn)
+                       + 2.0 * c.allgather_time(grad_bytes, s.dp,
+                                                dp_over_dcn))
+        else:
+            dp_comm = c.allreduce_time(grad_bytes, s.dp, dp_over_dcn)
+
+        # --- tp activation collectives ---
+        act_cut = (local_bs * l.seq_len * l.hidden * c.bytes_per_act
+                   / s.cp)
+        tp_comm = l.tp_comm_factor * c.allreduce_time(act_cut, s.tp,
+                                                      tp_over_dcn)
+        # backward doubles activation-collective traffic
+        tp_comm *= 1.5
+
+        # --- cp KV rotation (ring attention ppermute per step) ---
+        kv_bytes = 2.0 * local_bs * l.seq_len * l.hidden * c.bytes_per_act \
+            / (s.tp * s.cp)
+        cp_comm = 0.0
+        if s.cp > 1:
+            cp_bw = c.dcn_bandwidth if cp_over_dcn else c.ici_bandwidth
+            cp_comm = (s.cp - 1) * kv_bytes / cp_bw * 1.5
+
+        # --- pp stage-boundary p2p (activation fwd + grad bwd); only
+        # boundary layers pay it, so the caller scales by its share of
+        # boundaries per layer (PlannerSearch passes pp/L) ---
+        pp_comm = 0.0
+        if s.pp > 1:
+            pp_over_dcn = s.n_devices > c.devices_per_host
+            pp_bw = c.dcn_bandwidth if pp_over_dcn else c.ici_bandwidth
+            boundary_bytes = (2.0 * local_bs * l.seq_len * l.hidden
+                              * c.bytes_per_act / (s.tp * s.cp))
+            pp_comm = pp_boundary_share * boundary_bytes / pp_bw
+
+        comm = dp_comm + tp_comm + cp_comm + pp_comm
+        hidden_comm = min(comm, compute) * c.overlap
+        self.compute = compute
+        self.comm = comm
+        self.total = compute + comm - hidden_comm
+
+    def gen_result(self):
+        return self.total
+
+
+def candidate_strategies(n_devices, max_pp=None, max_tp=None, max_cp=None,
+                         allow_fsdp=True, allow_cp=True):
+    """Enumerate all (pp, tp, dp, fsdp, cp) with pp*tp*dp*cp == n_devices,
+    powers of two per axis (reference enumerates the same lattice for 8
+    GPUs, dp_utils.py:41-46)."""
+    out = []
+
+    def pows(limit):
+        v, r = 1, []
+        while v <= limit:
+            r.append(v)
+            v *= 2
+        return r
+
+    for pp in pows(min(max_pp or n_devices, n_devices)):
+        if n_devices % pp:
+            continue
+        for tp in pows(min(max_tp or n_devices, n_devices // pp)):
+            if (n_devices // pp) % tp:
+                continue
+            rem = n_devices // (pp * tp)
+            cps = pows(min(max_cp or rem, rem)) if allow_cp else [1]
+            for cp in cps:
+                if rem % cp:
+                    continue
+                dp = rem // cp
+                out.append(ParallelStrategy(pp, tp, dp, False, cp))
+                if allow_fsdp and dp > 1:
+                    out.append(ParallelStrategy(pp, tp, dp, True, cp))
+    return out

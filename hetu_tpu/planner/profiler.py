@@ -1,0 +1,101 @@
+"""Planner profiler: measure what the cost models need.
+
+Galvatron profiles per-layer forward time and inter-GPU bandwidth with
+standalone scripts (tools/Galvatron/test_env, bert/profile_forward.py)
+whose outputs feed the cost models.  Here both probes are jax functions:
+
+- :func:`profile_matmul_throughput` — achieved bf16 matmul FLOP/s (the
+  ``flops_per_sec * mfu`` product).
+- :func:`profile_collective_bandwidth` — ring-allreduce bytes/s over a
+  mesh axis (ICI when the mesh spans real chips).
+- :func:`profile_layer` — measured per-sample forward seconds for a layer
+  callable, written into :class:`LayerSpec.fwd_time_per_sample`.
+- :func:`measure_cluster` — bundle everything into a ClusterSpec.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .cost_model import ClusterSpec
+
+
+def _timeit(fn, *args, warmup=2, iters=5):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def profile_matmul_throughput(dim=4096, dtype=jnp.bfloat16):
+    a = jnp.ones((dim, dim), dtype)
+    b = jnp.ones((dim, dim), dtype)
+    f = jax.jit(lambda x, y: x @ y)
+    t = _timeit(f, a, b)
+    return 2.0 * dim ** 3 / t
+
+
+def profile_collective_bandwidth(mesh, axis, size_mb=16):
+    """Achieved allreduce bandwidth (algorithm bytes/s) over one mesh
+    axis, via shard_map psum."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    k = mesh.shape[axis]
+    if k <= 1:
+        return float("inf")
+    n = int(size_mb * 1024 * 1024 / 4)
+    x = jnp.ones((n,), jnp.float32)
+
+    f = jax.jit(shard_map(lambda v: jax.lax.psum(v, axis), mesh=mesh,
+                          in_specs=P(axis), out_specs=P()))
+    t = _timeit(f, x)
+    nbytes = n * 4 / k  # per-device message size (input sharded over axis)
+    return 2.0 * (k - 1) / k * nbytes / t
+
+
+def profile_layer(layer_fn, sample_shape, batch=8, dtype=jnp.float32,
+                  seed=0):
+    """Measured per-sample forward time of ``layer_fn(batch_input)``."""
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(batch, *sample_shape).astype(
+        np.dtype(dtype.dtype.name if hasattr(dtype, "dtype") else "float32")))
+    f = jax.jit(layer_fn)
+    t = _timeit(f, x)
+    return t / batch
+
+
+def measure_cluster(mesh=None, n_devices=None, hbm_bytes=None):
+    """Build a ClusterSpec from live measurements (analytic defaults fill
+    anything unmeasurable on the current backend)."""
+    spec = ClusterSpec()
+    spec.n_devices = n_devices or (
+        int(np.prod(list(mesh.shape.values()))) if mesh is not None
+        else jax.device_count())
+    achieved = profile_matmul_throughput()
+    spec.flops_per_sec = achieved
+    spec.mfu = 1.0  # 'achieved' already folds utilization in
+    if hbm_bytes:
+        spec.hbm_bytes = hbm_bytes
+    else:
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                spec.hbm_bytes = float(stats["bytes_limit"])
+        except Exception:
+            pass
+    if mesh is not None:
+        for axis in mesh.shape:
+            if mesh.shape[axis] > 1:
+                bw = profile_collective_bandwidth(mesh, axis, size_mb=4)
+                spec.ici_bandwidth = min(spec.ici_bandwidth, bw) \
+                    if np.isfinite(bw) else spec.ici_bandwidth
+                break
+    return spec
