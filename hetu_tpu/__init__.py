@@ -40,6 +40,9 @@ from . import parallel
 from .parallel import distributed_strategies as dist
 from .profiler import HetuProfiler, NCCLProfiler, TPUProfiler
 from .cache import CacheSparseTable, EmbeddingCache
+from . import tokenizers
+from . import planner
+from . import onnx
 
 # MoE / communication op surface
 from .graph.ops_moe import (

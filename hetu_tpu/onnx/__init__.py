@@ -1,0 +1,15 @@
+"""ONNX interop (reference python/hetu/onnx/, 2,337 LoC).
+
+Self-contained: includes a minimal protobuf wire-format implementation of
+the public onnx.proto schema (proto.py) because the image ships no `onnx`
+package.  Export traces the inference subgraph to a jaxpr and maps XLA
+primitives to ONNX ops; import builds normal hetu_tpu graph nodes from
+ONNX nodes, so imported models can be trained and re-exported.
+"""
+
+from .hetu2onnx import export
+from .onnx2hetu import load_onnx
+from .proto import ModelProto, load_model, save_model
+
+__all__ = ["export", "load_onnx", "ModelProto", "load_model",
+           "save_model"]

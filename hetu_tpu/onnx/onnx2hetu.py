@@ -1,0 +1,551 @@
+"""Import ONNX models as hetu_tpu graphs (reference onnx2hetu.py).
+
+Each ONNX node maps to a SimpleOp built from a jax closure — the same
+mechanism the op factory surface uses — so an imported model is a normal
+graph: it can be jitted, sharded, trained (gradients flow through the
+imported ops via the vjp fallback), and re-exported.
+
+    outputs, placeholders, weights = load_onnx("model.onnx")
+    ex = Executor({"pred": outputs})
+    ex.run("pred", feed_dict={placeholders["x"]: batch})
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .proto import (AttributeProto, ModelProto, TensorProto, attr_value,
+                    load_model, tensor_to_numpy)
+from ..graph.ops_math import _simple
+from ..graph import ops_misc
+
+
+def _attrs(node):
+    return {a.name: attr_value(a) for a in node.attribute}
+
+
+class _Importer:
+    def __init__(self, graph):
+        self.graph = graph
+        self.values = {}     # onnx name -> Op node
+        self.consts = {}     # onnx name -> np.ndarray (initializers)
+        self.placeholders = {}
+
+    def const(self, name):
+        return self.consts.get(name)
+
+    def node(self, name):
+        if name in self.values:
+            return self.values[name]
+        if name in self.consts:
+            arr = self.consts[name]
+            v = ops_misc.Variable(f"onnx_{name}", value=arr,
+                                  trainable=np.issubdtype(
+                                      arr.dtype, np.floating))
+            self.values[name] = v
+            return v
+        raise KeyError(f"onnx value '{name}' is not defined yet")
+
+    # ------------------------------------------------------------ run
+    def run(self):
+        for t in self.graph.initializer:
+            self.consts[t.name] = tensor_to_numpy(t)
+        for vi in self.graph.input:
+            if vi.name in self.consts:
+                continue
+            ph = ops_misc.placeholder_op(vi.name)
+            self.placeholders[vi.name] = ph
+            self.values[vi.name] = ph
+        for n in self.graph.node:
+            handler = _HANDLERS.get(n.op_type)
+            if handler is None:
+                raise NotImplementedError(
+                    f"onnx import: unsupported op '{n.op_type}'")
+            outs = handler(self, n, _attrs(n))
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            for name, op in zip(n.output, outs):
+                if op is not None:
+                    self.values[name] = op
+        return [self.node(o.name) for o in self.graph.output]
+
+
+# ------------------------------------------------------------- handlers
+
+_HANDLERS = {}
+
+
+def handler(*op_types):
+    def deco(fn):
+        for t in op_types:
+            _HANDLERS[t] = fn
+        return fn
+    return deco
+
+
+def _in(imp, node, i):
+    return imp.node(node.input[i])
+
+
+@handler("Add", "Sub", "Mul", "Div", "Pow", "Max", "Min", "And", "Or",
+         "Xor", "Equal", "Less", "Greater", "LessOrEqual",
+         "GreaterOrEqual", "Mod")
+def _binary(imp, node, attrs):
+    fns = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+           "Div": jnp.divide, "Pow": jnp.power, "Max": jnp.maximum,
+           "Min": jnp.minimum, "And": jnp.logical_and,
+           "Or": jnp.logical_or, "Xor": jnp.logical_xor,
+           "Equal": lambda a, b: (a == b), "Less": lambda a, b: (a < b),
+           "Greater": lambda a, b: (a > b),
+           "LessOrEqual": lambda a, b: (a <= b),
+           "GreaterOrEqual": lambda a, b: (a >= b), "Mod": jnp.mod}
+    f = fns[node.op_type]
+    return _simple(node.op_type, f, _in(imp, node, 0), _in(imp, node, 1))
+
+
+@handler("Neg", "Exp", "Log", "Tanh", "Sigmoid", "Sqrt", "Abs", "Erf",
+         "Sin", "Cos", "Floor", "Ceil", "Sign", "Relu", "Reciprocal",
+         "Identity", "Not", "Softplus")
+def _unary(imp, node, attrs):
+    fns = {"Neg": jnp.negative, "Exp": jnp.exp, "Log": jnp.log,
+           "Tanh": jnp.tanh, "Sigmoid": jax.nn.sigmoid, "Sqrt": jnp.sqrt,
+           "Abs": jnp.abs, "Erf": jax.scipy.special.erf, "Sin": jnp.sin,
+           "Cos": jnp.cos, "Floor": jnp.floor, "Ceil": jnp.ceil,
+           "Sign": jnp.sign, "Relu": jax.nn.relu,
+           "Reciprocal": lambda x: 1.0 / x, "Identity": lambda x: x,
+           "Not": jnp.logical_not, "Softplus": jax.nn.softplus}
+    return _simple(node.op_type, fns[node.op_type], _in(imp, node, 0))
+
+
+@handler("IsNaN")
+def _isnan(imp, node, attrs):
+    return _simple("IsNaN", jnp.isnan, _in(imp, node, 0))
+
+
+@handler("IsInf")
+def _isinf(imp, node, attrs):
+    return _simple("IsInf", jnp.isinf, _in(imp, node, 0))
+
+
+@handler("Gelu")
+def _gelu(imp, node, attrs):
+    approx = attrs.get("approximate", "none") == "tanh"
+    return _simple("Gelu", lambda x: jax.nn.gelu(x, approximate=approx),
+                   _in(imp, node, 0))
+
+
+@handler("LeakyRelu")
+def _leaky(imp, node, attrs):
+    alpha = attrs.get("alpha", 0.01)
+    return _simple("LeakyRelu",
+                   lambda x: jax.nn.leaky_relu(x, negative_slope=alpha),
+                   _in(imp, node, 0))
+
+
+@handler("Clip")
+def _clip(imp, node, attrs):
+    # opset>=11: min/max as inputs (const or dynamic); opset 6: attributes
+    ins = [_in(imp, node, 0)]
+    consts = [None, None]
+    for slot, i in enumerate((1, 2)):
+        if len(node.input) > i and node.input[i]:
+            c = imp.const(node.input[i])
+            if c is not None:
+                consts[slot] = np.asarray(c).reshape(())
+            else:
+                ins.append(_in(imp, node, i))
+                consts[slot] = len(ins) - 1  # positional marker
+    if "min" in attrs:
+        consts[0] = attrs["min"]
+    if "max" in attrs:
+        consts[1] = attrs["max"]
+
+    def f(x, *dyn):
+        lo, hi = consts
+        lo = dyn[lo - 1] if isinstance(lo, int) else lo
+        hi = dyn[hi - 1] if isinstance(hi, int) else hi
+        return jnp.clip(x, lo, hi)
+    return _simple("Clip", f, *ins)
+
+
+@handler("Softmax")
+def _softmax(imp, node, attrs):
+    axis = attrs.get("axis", -1)
+    return _simple("Softmax", lambda x: jax.nn.softmax(x, axis=axis),
+                   _in(imp, node, 0))
+
+
+@handler("LogSoftmax")
+def _log_softmax(imp, node, attrs):
+    axis = attrs.get("axis", -1)
+    return _simple("LogSoftmax",
+                   lambda x: jax.nn.log_softmax(x, axis=axis),
+                   _in(imp, node, 0))
+
+
+@handler("MatMul")
+def _matmul(imp, node, attrs):
+    return _simple("MatMul", jnp.matmul, _in(imp, node, 0),
+                   _in(imp, node, 1))
+
+
+@handler("Gemm")
+def _gemm(imp, node, attrs):
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    ta, tb = attrs.get("transA", 0), attrs.get("transB", 0)
+
+    def f(a, b, *c):
+        if ta:
+            a = a.T
+        if tb:
+            b = b.T
+        out = alpha * (a @ b)
+        if c:
+            out = out + beta * c[0]
+        return out
+    ins = [_in(imp, node, i) for i in range(len(node.input))]
+    return _simple("Gemm", f, *ins)
+
+
+@handler("Einsum")
+def _einsum(imp, node, attrs):
+    eq = attrs["equation"]
+    ins = [_in(imp, node, i) for i in range(len(node.input))]
+    return _simple("Einsum", lambda *xs: jnp.einsum(eq, *xs), *ins)
+
+
+@handler("Conv")
+def _conv(imp, node, attrs):
+    strides = attrs.get("strides", [1, 1])
+    dil = attrs.get("dilations", [1, 1])
+    group = attrs.get("group", 1)
+    pads = attrs.get("pads")
+    if pads:
+        half = len(pads) // 2
+        padding = list(zip(pads[:half], pads[half:]))
+    else:
+        padding = "VALID" if attrs.get("auto_pad", "NOTSET") in (
+            "NOTSET", "VALID") else "SAME"
+
+    def f(x, w, *b):
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dil, feature_group_count=group,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+    ins = [_in(imp, node, i) for i in range(len(node.input))]
+    return _simple("Conv", f, *ins)
+
+
+def _pool_common(attrs):
+    ks = attrs["kernel_shape"]
+    strides = attrs.get("strides", [1] * len(ks))
+    pads = attrs.get("pads", [0] * (2 * len(ks)))
+    half = len(pads) // 2
+    padding = [(0, 0), (0, 0)] + list(zip(pads[:half], pads[half:]))
+    window = (1, 1) + tuple(ks)
+    stride = (1, 1) + tuple(strides)
+    return window, stride, padding
+
+
+@handler("MaxPool")
+def _maxpool(imp, node, attrs):
+    window, stride, padding = _pool_common(attrs)
+    return _simple("MaxPool", lambda x: jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, window, stride, padding),
+        _in(imp, node, 0))
+
+
+@handler("AveragePool")
+def _avgpool(imp, node, attrs):
+    window, stride, padding = _pool_common(attrs)
+    cip = attrs.get("count_include_pad", 0)
+
+    def f(x):
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
+                                  padding)
+        if cip:
+            return s / np.prod(window)
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                    stride, padding)
+        return s / cnt
+    return _simple("AveragePool", f, _in(imp, node, 0))
+
+
+@handler("GlobalAveragePool")
+def _gap(imp, node, attrs):
+    return _simple("GlobalAveragePool",
+                   lambda x: jnp.mean(x, axis=(2, 3), keepdims=True),
+                   _in(imp, node, 0))
+
+
+@handler("BatchNormalization")
+def _bn(imp, node, attrs):
+    eps = attrs.get("epsilon", 1e-5)
+
+    def f(x, scale, b, mean, var):
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mean.reshape(shape))
+                / jnp.sqrt(var.reshape(shape) + eps)
+                * scale.reshape(shape) + b.reshape(shape))
+    ins = [_in(imp, node, i) for i in range(5)]
+    return _simple("BatchNorm", f, *ins)
+
+
+@handler("LayerNormalization")
+def _ln(imp, node, attrs):
+    eps = attrs.get("epsilon", 1e-5)
+    axis = attrs.get("axis", -1)
+
+    def f(x, scale, *b):
+        # ONNX normalizes over all axes from `axis` through the last
+        axes = tuple(range(axis % x.ndim, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) / jnp.sqrt(var + eps) * scale
+        if b:
+            out = out + b[0]
+        return out
+    ins = [_in(imp, node, i) for i in range(len(node.input))]
+    return _simple("LayerNorm", f, *ins)
+
+
+@handler("Reshape")
+def _reshape(imp, node, attrs):
+    shape = imp.const(node.input[1])
+    assert shape is not None, "dynamic Reshape target unsupported"
+    shape = [int(s) for s in shape]
+    return _simple("Reshape", lambda x: jnp.reshape(x, shape),
+                   _in(imp, node, 0))
+
+
+@handler("Transpose")
+def _transpose(imp, node, attrs):
+    perm = attrs.get("perm")
+    return _simple("Transpose",
+                   lambda x: jnp.transpose(x, perm), _in(imp, node, 0))
+
+
+@handler("Expand")
+def _expand(imp, node, attrs):
+    shape = imp.const(node.input[1])
+    assert shape is not None, "dynamic Expand target unsupported"
+    shape = [int(s) for s in shape]
+
+    def f(x):
+        tgt = [x.shape[i - (len(shape) - x.ndim)] if s == 1 and
+               i >= len(shape) - x.ndim and
+               x.shape[i - (len(shape) - x.ndim)] != 1 else s
+               for i, s in enumerate(shape)]
+        return jnp.broadcast_to(x, tgt)
+    return _simple("Expand", f, _in(imp, node, 0))
+
+
+@handler("Concat")
+def _concat(imp, node, attrs):
+    axis = attrs.get("axis", 0)
+    ins = [_in(imp, node, i) for i in range(len(node.input))]
+    return _simple("Concat", lambda *xs: jnp.concatenate(xs, axis=axis),
+                   *ins)
+
+
+@handler("Split")
+def _split(imp, node, attrs):
+    axis = attrs.get("axis", 0)
+    splits = attrs.get("split")
+    if splits is None and len(node.input) > 1:
+        splits = [int(s) for s in imp.const(node.input[1])]
+    n_out = len(node.output)
+    x = _in(imp, node, 0)
+    outs = []
+    for i in range(n_out):
+        def f(v, i=i):
+            if splits is None:
+                return jnp.split(v, n_out, axis=axis)[i]
+            offs = np.cumsum([0] + list(splits))
+            sl = [slice(None)] * v.ndim
+            sl[axis] = slice(int(offs[i]), int(offs[i + 1]))
+            return v[tuple(sl)]
+        outs.append(_simple(f"Split{i}", f, x))
+    return outs
+
+
+@handler("Slice")
+def _slice(imp, node, attrs):
+    starts = imp.const(node.input[1])
+    ends = imp.const(node.input[2])
+    axes = imp.const(node.input[3]) if len(node.input) > 3 else None
+    steps = imp.const(node.input[4]) if len(node.input) > 4 else None
+    assert starts is not None and ends is not None, \
+        "dynamic Slice unsupported"
+
+    def f(x):
+        sl = [slice(None)] * x.ndim
+        ax = axes if axes is not None else np.arange(len(starts))
+        st = steps if steps is not None else np.ones(len(starts), int)
+        for a, s, e, p in zip(ax, starts, ends, st):
+            s, e, p = int(s), int(e), int(p)
+            e = None if e >= np.iinfo(np.int32).max else e
+            e = None if (p < 0 and e < -x.shape[int(a)]) else e
+            sl[int(a)] = slice(s, e, p)
+        return x[tuple(sl)]
+    return _simple("Slice", f, _in(imp, node, 0))
+
+
+@handler("Gather")
+def _gather(imp, node, attrs):
+    axis = attrs.get("axis", 0)
+    idx = imp.const(node.input[1])
+    if idx is not None:
+        return _simple("Gather",
+                       lambda x: jnp.take(x, jnp.asarray(idx), axis=axis),
+                       _in(imp, node, 0))
+    return _simple("Gather",
+                   lambda x, i: jnp.take(x, i.astype(jnp.int32),
+                                         axis=axis),
+                   _in(imp, node, 0), _in(imp, node, 1))
+
+
+@handler("Cast")
+def _cast(imp, node, attrs):
+    from .proto import _ONNX2NP
+    to = _ONNX2NP[attrs["to"]]
+    return _simple("Cast", lambda x: x.astype(to), _in(imp, node, 0))
+
+
+@handler("Where")
+def _where(imp, node, attrs):
+    return _simple("Where", jnp.where, _in(imp, node, 0),
+                   _in(imp, node, 1), _in(imp, node, 2))
+
+
+@handler("ReduceSum", "ReduceMax", "ReduceMin", "ReduceMean",
+         "ReduceProd")
+def _reduce(imp, node, attrs):
+    fns = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
+           "ReduceMin": jnp.min, "ReduceMean": jnp.mean,
+           "ReduceProd": jnp.prod}
+    f = fns[node.op_type]
+    keep = bool(attrs.get("keepdims", 1))
+    axes = attrs.get("axes")
+    if axes is None and len(node.input) > 1:
+        c = imp.const(node.input[1])
+        axes = [int(a) for a in c] if c is not None else None
+    axes_t = tuple(axes) if axes is not None else None
+    return _simple(node.op_type,
+                   lambda x: f(x, axis=axes_t, keepdims=keep),
+                   _in(imp, node, 0))
+
+
+@handler("ArgMax")
+def _argmax(imp, node, attrs):
+    axis = attrs.get("axis", 0)
+    keep = bool(attrs.get("keepdims", 1))
+
+    def f(x):
+        out = jnp.argmax(x, axis=axis)
+        if keep:
+            out = jnp.expand_dims(out, axis)
+        return out
+    return _simple("ArgMax", f, _in(imp, node, 0))
+
+
+@handler("CumSum")
+def _cumsum(imp, node, attrs):
+    ax = imp.const(node.input[1])
+    assert ax is not None
+    reverse = bool(attrs.get("reverse", 0))
+
+    def f(x):
+        a = int(ax)
+        if reverse:
+            return jnp.flip(jnp.cumsum(jnp.flip(x, a), axis=a), a)
+        return jnp.cumsum(x, axis=a)
+    return _simple("CumSum", f, _in(imp, node, 0))
+
+
+@handler("Squeeze")
+def _squeeze(imp, node, attrs):
+    axes = attrs.get("axes")
+    if axes is None and len(node.input) > 1:
+        axes = [int(a) for a in imp.const(node.input[1])]
+    axes_t = tuple(axes) if axes else None
+    return _simple("Squeeze", lambda x: jnp.squeeze(x, axis=axes_t),
+                   _in(imp, node, 0))
+
+
+@handler("Unsqueeze")
+def _unsqueeze(imp, node, attrs):
+    axes = attrs.get("axes")
+    if axes is None and len(node.input) > 1:
+        axes = [int(a) for a in imp.const(node.input[1])]
+
+    def f(x):
+        out = x
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return _simple("Unsqueeze", f, _in(imp, node, 0))
+
+
+@handler("Flatten")
+def _flatten(imp, node, attrs):
+    axis = attrs.get("axis", 1)
+    return _simple("Flatten",
+                   lambda x: x.reshape(
+                       int(np.prod(x.shape[:axis]) or 1), -1),
+                   _in(imp, node, 0))
+
+
+@handler("Constant")
+def _constant(imp, node, attrs):
+    for key in ("value", "value_float", "value_int", "value_floats",
+                "value_ints"):
+        if key in attrs:
+            arr = np.asarray(attrs[key])
+            imp.consts[node.output[0]] = arr
+            return None
+    raise NotImplementedError("Constant without tensor value")
+
+
+@handler("ConstantOfShape")
+def _cos_(imp, node, attrs):
+    shape = imp.const(node.input[0])
+    assert shape is not None
+    val = attrs.get("value", np.zeros(1, np.float32))
+    arr = np.full([int(s) for s in shape], np.asarray(val).reshape(-1)[0])
+    imp.consts[node.output[0]] = arr
+    return None
+
+
+@handler("Dropout")
+def _dropout(imp, node, attrs):
+    # inference: identity (reference onnx handlers do the same)
+    return _simple("Dropout", lambda x: x, _in(imp, node, 0))
+
+
+@handler("Shape")
+def _shape(imp, node, attrs):
+    return _simple("Shape",
+                   lambda x: jnp.asarray(x.shape, jnp.int64),
+                   _in(imp, node, 0))
+
+
+# --------------------------------------------------------------- entry
+
+def load_onnx(path):
+    """Parse an .onnx file -> (output nodes, placeholders, weights).
+
+    Mirrors reference onnx2hetu.load_onnx returning executor-ready graph
+    nodes (onnx2hetu.py)."""
+    model = load_model(path)
+    imp = _Importer(model.graph)
+    outputs = imp.run()
+    weights = {f"onnx_{k}": v for k, v in imp.consts.items()}
+    return outputs, imp.placeholders, weights

@@ -1,0 +1,388 @@
+"""Self-contained ONNX protobuf reader/writer (no `onnx` dependency).
+
+The image has no `onnx` package, so this module implements the protobuf
+wire format (varint / 64-bit / length-delimited / 32-bit fields) plus just
+enough of the public onnx.proto schema — ModelProto, GraphProto, NodeProto,
+AttributeProto, TensorProto, ValueInfoProto, TypeProto,
+OperatorSetIdProto — to emit and parse real `.onnx` files that other
+toolchains accept.  Field numbers follow the onnx.proto3 spec.
+
+Messages are plain Python objects with typed descriptors; `encode()`
+returns bytes, `decode(cls, data)` parses.  Reference counterpart:
+python/hetu/onnx/{hetu2onnx,onnx2hetu}.py build onnx graphs via the onnx
+package's helpers; here the helpers are ours.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+# ------------------------------------------------------------ wire format
+
+def _enc_varint(v):
+    v &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _dec_varint(data, pos):
+    result = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _zz(v):  # signed int64 -> two's complement varint domain
+    return v if v >= 0 else v + (1 << 64)
+
+
+def _unzz(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _tag(field, wire):
+    return _enc_varint((field << 3) | wire)
+
+
+def _enc_field(field, wire, payload):
+    if wire == 0:
+        return _tag(field, 0) + _enc_varint(payload)
+    if wire == 2:
+        return _tag(field, 2) + _enc_varint(len(payload)) + payload
+    if wire == 1:
+        return _tag(field, 1) + struct.pack("<d", payload)
+    if wire == 5:
+        return _tag(field, 5) + struct.pack("<f", payload)
+    raise ValueError(wire)
+
+
+# ------------------------------------------------------------ descriptors
+# kind: 'int' varint, 'sint' signed varint, 'float' 32-bit, 'double'
+# 64-bit, 'bytes'/'string' length-delimited, 'msg' nested message,
+# 'packed_int64'/'packed_float'/'packed_int32' packed repeated scalars.
+
+class Message:
+    FIELDS = {}  # field_number -> (name, kind, repeated, msg_cls_or_None)
+
+    def __init__(self, **kw):
+        for num, (name, kind, rep, _) in self.FIELDS.items():
+            default = [] if rep else (
+                0 if kind in ("int", "sint") else
+                0.0 if kind in ("float", "double") else
+                b"" if kind == "bytes" else
+                "" if kind == "string" else None)
+            setattr(self, name, kw.pop(name, default))
+        if kw:
+            raise TypeError(f"unknown fields {list(kw)}")
+
+    # ---- encode
+    def encode(self):
+        out = bytearray()
+        for num, (name, kind, rep, cls) in sorted(self.FIELDS.items()):
+            val = getattr(self, name)
+            if val is None or (rep and not val):
+                continue
+            if kind.startswith("packed_"):
+                if kind in ("packed_int64", "packed_int32"):
+                    payload = b"".join(_enc_varint(_zz(int(x)))
+                                       for x in val)
+                else:
+                    payload = b"".join(struct.pack("<f", float(x))
+                                       for x in val)
+                out += _enc_field(num, 2, payload)
+                continue
+            vals = val if rep else [val]
+            for v in vals:
+                if kind == "int":
+                    if v == 0 and not rep:
+                        continue
+                    out += _enc_field(num, 0, _zz(int(v)))
+                elif kind == "float":
+                    if v == 0.0 and not rep:
+                        continue
+                    out += _enc_field(num, 5, float(v))
+                elif kind == "double":
+                    if v == 0.0 and not rep:
+                        continue
+                    out += _enc_field(num, 1, float(v))
+                elif kind == "string":
+                    if not v and not rep:
+                        continue
+                    out += _enc_field(num, 2, v.encode("utf-8"))
+                elif kind == "bytes":
+                    if not v and not rep:
+                        continue
+                    out += _enc_field(num, 2, bytes(v))
+                elif kind == "msg":
+                    out += _enc_field(num, 2, v.encode())
+                else:
+                    raise ValueError(kind)
+        return bytes(out)
+
+    # ---- decode
+    @classmethod
+    def decode(cls, data, pos=0, end=None):
+        self = cls()
+        end = len(data) if end is None else end
+        while pos < end:
+            key, pos = _dec_varint(data, pos)
+            field, wire = key >> 3, key & 7
+            spec = cls.FIELDS.get(field)
+            if wire == 0:
+                raw, pos = _dec_varint(data, pos)
+                val = _unzz(raw)
+            elif wire == 2:
+                ln, pos = _dec_varint(data, pos)
+                val = data[pos:pos + ln]
+                pos += ln
+            elif wire == 5:
+                val = struct.unpack_from("<f", data, pos)[0]
+                pos += 4
+            elif wire == 1:
+                val = struct.unpack_from("<d", data, pos)[0]
+                pos += 8
+            else:
+                raise ValueError(f"wire type {wire}")
+            if spec is None:
+                continue  # unknown field: skip
+            name, kind, rep, mcls = spec
+            if kind == "msg":
+                val = mcls.decode(bytes(val))
+            elif kind == "string" and wire == 2:
+                val = val.decode("utf-8")
+            elif kind == "bytes" and wire == 2:
+                val = bytes(val)
+            elif kind in ("packed_int64", "packed_int32"):
+                if wire == 2:
+                    vals, p2 = [], 0
+                    buf = bytes(val)
+                    while p2 < len(buf):
+                        x, p2 = _dec_varint(buf, p2)
+                        vals.append(_unzz(x))
+                    getattr(self, name).extend(vals)
+                    continue
+                # non-packed encoding of a packed-declared field
+                getattr(self, name).append(val)
+                continue
+            elif kind == "packed_float":
+                if wire == 2:
+                    buf = bytes(val)
+                    vals = [struct.unpack_from("<f", buf, i)[0]
+                            for i in range(0, len(buf), 4)]
+                    getattr(self, name).extend(vals)
+                    continue
+                getattr(self, name).append(val)
+                continue
+            if rep:
+                getattr(self, name).append(val)
+            else:
+                setattr(self, name, val)
+        return self
+
+    def __repr__(self):
+        fields = {name: getattr(self, name)
+                  for _, (name, _, _, _) in self.FIELDS.items()
+                  if getattr(self, name)}
+        return f"{type(self).__name__}({fields})"
+
+
+# ------------------------------------------------------------ onnx schema
+
+class TensorShapeDim(Message):
+    FIELDS = {1: ("dim_value", "int", False, None),
+              2: ("dim_param", "string", False, None)}
+
+
+class TensorShape(Message):
+    FIELDS = {1: ("dim", "msg", True, TensorShapeDim)}
+
+
+class TensorTypeProto(Message):
+    FIELDS = {1: ("elem_type", "int", False, None),
+              2: ("shape", "msg", False, TensorShape)}
+
+
+class TypeProto(Message):
+    FIELDS = {1: ("tensor_type", "msg", False, TensorTypeProto)}
+
+
+class ValueInfoProto(Message):
+    FIELDS = {1: ("name", "string", False, None),
+              2: ("type", "msg", False, TypeProto),
+              3: ("doc_string", "string", False, None)}
+
+
+class TensorProto(Message):
+    # data_type enum values (onnx.proto3 TensorProto.DataType)
+    FLOAT, UINT8, INT8, INT32, INT64 = 1, 2, 3, 6, 7
+    BOOL, FLOAT16, DOUBLE, BFLOAT16 = 9, 10, 11, 16
+    FIELDS = {1: ("dims", "packed_int64", True, None),
+              2: ("data_type", "int", False, None),
+              4: ("float_data", "packed_float", True, None),
+              5: ("int32_data", "packed_int32", True, None),
+              7: ("int64_data", "packed_int64", True, None),
+              8: ("name", "string", False, None),
+              9: ("raw_data", "bytes", False, None)}
+
+
+class AttributeProto(Message):
+    # type enum
+    FLOAT, INT, STRING, TENSOR = 1, 2, 3, 4
+    GRAPH, FLOATS, INTS, STRINGS = 5, 6, 7, 8
+    FIELDS = {1: ("name", "string", False, None),
+              2: ("f", "float", False, None),
+              3: ("i", "int", False, None),
+              4: ("s", "bytes", False, None),
+              5: ("t", "msg", False, TensorProto),
+              7: ("floats", "packed_float", True, None),
+              8: ("ints", "packed_int64", True, None),
+              9: ("strings", "bytes", True, None),
+              20: ("type", "int", False, None)}
+
+
+class NodeProto(Message):
+    FIELDS = {1: ("input", "string", True, None),
+              2: ("output", "string", True, None),
+              3: ("name", "string", False, None),
+              4: ("op_type", "string", False, None),
+              5: ("attribute", "msg", True, AttributeProto),
+              6: ("doc_string", "string", False, None),
+              7: ("domain", "string", False, None)}
+
+
+class GraphProto(Message):
+    FIELDS = {1: ("node", "msg", True, NodeProto),
+              2: ("name", "string", False, None),
+              5: ("initializer", "msg", True, TensorProto),
+              10: ("doc_string", "string", False, None),
+              11: ("input", "msg", True, ValueInfoProto),
+              12: ("output", "msg", True, ValueInfoProto),
+              13: ("value_info", "msg", True, ValueInfoProto)}
+
+
+class OperatorSetIdProto(Message):
+    FIELDS = {1: ("domain", "string", False, None),
+              2: ("version", "int", False, None)}
+
+
+class ModelProto(Message):
+    FIELDS = {1: ("ir_version", "int", False, None),
+              2: ("producer_name", "string", False, None),
+              3: ("producer_version", "string", False, None),
+              4: ("domain", "string", False, None),
+              5: ("model_version", "int", False, None),
+              6: ("doc_string", "string", False, None),
+              7: ("graph", "msg", False, GraphProto),
+              8: ("opset_import", "msg", True, OperatorSetIdProto)}
+
+
+# ------------------------------------------------------------ helpers
+
+import numpy as np
+
+_NP2ONNX = {np.dtype("float32"): TensorProto.FLOAT,
+            np.dtype("float64"): TensorProto.DOUBLE,
+            np.dtype("float16"): TensorProto.FLOAT16,
+            np.dtype("int32"): TensorProto.INT32,
+            np.dtype("int64"): TensorProto.INT64,
+            np.dtype("uint8"): TensorProto.UINT8,
+            np.dtype("int8"): TensorProto.INT8,
+            np.dtype("bool"): TensorProto.BOOL}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def tensor_from_numpy(arr, name=""):
+    arr = np.asarray(arr)
+    t = TensorProto(name=name, dims=list(arr.shape),
+                    data_type=_NP2ONNX[arr.dtype],
+                    raw_data=arr.tobytes())
+    return t
+
+
+def tensor_to_numpy(t):
+    dtype = _ONNX2NP[t.data_type]
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = np.array(t.float_data, dtype=dtype)
+    elif t.int64_data:
+        arr = np.array(t.int64_data, dtype=dtype)
+    elif t.int32_data:
+        arr = np.array(t.int32_data, dtype=dtype)
+    else:
+        arr = np.zeros(0, dtype=dtype)
+    return arr.reshape(list(t.dims))
+
+
+def value_info(name, shape, elem_type=TensorProto.FLOAT):
+    dims = [TensorShapeDim(dim_param=d) if isinstance(d, str)
+            else TensorShapeDim(dim_value=int(d)) for d in (shape or [])]
+    return ValueInfoProto(name=name, type=TypeProto(
+        tensor_type=TensorTypeProto(elem_type=elem_type,
+                                    shape=TensorShape(dim=dims))))
+
+
+def attr(name, value):
+    """Build an AttributeProto from a python value."""
+    if isinstance(value, bool):
+        return AttributeProto(name=name, i=int(value),
+                              type=AttributeProto.INT)
+    if isinstance(value, int):
+        return AttributeProto(name=name, i=value, type=AttributeProto.INT)
+    if isinstance(value, float):
+        return AttributeProto(name=name, f=value,
+                              type=AttributeProto.FLOAT)
+    if isinstance(value, str):
+        return AttributeProto(name=name, s=value.encode("utf-8"),
+                              type=AttributeProto.STRING)
+    if isinstance(value, np.ndarray):
+        return AttributeProto(name=name, t=tensor_from_numpy(value),
+                              type=AttributeProto.TENSOR)
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(x, int) for x in value):
+            return AttributeProto(name=name, ints=list(value),
+                                  type=AttributeProto.INTS)
+        if all(isinstance(x, (int, float)) for x in value):
+            return AttributeProto(name=name,
+                                  floats=[float(x) for x in value],
+                                  type=AttributeProto.FLOATS)
+    raise TypeError(f"unsupported attribute {name}={value!r}")
+
+
+def attr_value(a):
+    """AttributeProto -> python value."""
+    if a.type == AttributeProto.INT:
+        return a.i
+    if a.type == AttributeProto.FLOAT:
+        return a.f
+    if a.type == AttributeProto.STRING:
+        return a.s.decode("utf-8")
+    if a.type == AttributeProto.INTS:
+        return list(a.ints)
+    if a.type == AttributeProto.FLOATS:
+        return list(a.floats)
+    if a.type == AttributeProto.TENSOR:
+        return tensor_to_numpy(a.t)
+    raise TypeError(f"unsupported attribute type {a.type}")
+
+
+def save_model(model, path):
+    with open(path, "wb") as f:
+        f.write(model.encode())
+
+
+def load_model(path):
+    with open(path, "rb") as f:
+        return ModelProto.decode(f.read())

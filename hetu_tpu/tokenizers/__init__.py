@@ -1,0 +1,8 @@
+"""Tokenizers (reference python/hetu/tokenizers/, 612 LoC)."""
+
+from .bert_tokenizer import (BasicTokenizer, BertTokenizer,
+                             WordpieceTokenizer, load_vocab,
+                             whitespace_tokenize)
+
+__all__ = ["BertTokenizer", "BasicTokenizer", "WordpieceTokenizer",
+           "load_vocab", "whitespace_tokenize"]

@@ -1,0 +1,202 @@
+"""ONNX interop tests (reference tests/onnx/test_nodes.py round-trips
+hetu->onnx->TF; here: hetu->onnx->hetu numerics, plus protobuf wire-format
+round-trips since the proto layer is ours)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.onnx import export, load_onnx, load_model
+from hetu_tpu.onnx import proto as P
+
+
+class TestProtoWire:
+    def test_varint_roundtrip(self):
+        for v in (0, 1, 127, 128, 300, 2 ** 40, -1, -42):
+            data = P._enc_varint(P._zz(v))
+            out, pos = P._dec_varint(data, 0)
+            assert P._unzz(out) == v and pos == len(data)
+
+    def test_tensor_roundtrip(self):
+        for arr in (np.random.randn(3, 4).astype(np.float32),
+                    np.arange(6, dtype=np.int64).reshape(2, 3),
+                    np.array([True, False])):
+            t = P.tensor_from_numpy(arr, "w")
+            t2 = P.TensorProto.decode(t.encode())
+            np.testing.assert_array_equal(P.tensor_to_numpy(t2), arr)
+            assert t2.name == "w"
+
+    def test_model_roundtrip(self):
+        g = P.GraphProto(
+            name="g",
+            node=[P.NodeProto(op_type="Relu", input=["x"], output=["y"],
+                              name="r")],
+            input=[P.value_info("x", [2, "batch"])],
+            output=[P.value_info("y", [2, 3])],
+            initializer=[P.tensor_from_numpy(np.zeros((2, 2), np.float32),
+                                             "w")])
+        m = P.ModelProto(ir_version=8, producer_name="t", graph=g,
+                         opset_import=[P.OperatorSetIdProto(version=17)])
+        m2 = P.ModelProto.decode(m.encode())
+        assert m2.graph.node[0].op_type == "Relu"
+        assert m2.graph.input[0].name == "x"
+        assert m2.graph.input[0].type.tensor_type.shape.dim[1].dim_param \
+            == "batch"
+        assert m2.opset_import[0].version == 17
+
+    def test_attribute_kinds(self):
+        for v in (3, 2.5, "hi", [1, 2, 3], [1.5, 2.5],
+                  np.ones((2,), np.float32)):
+            a = P.attr("a", v)
+            a2 = P.AttributeProto.decode(a.encode())
+            got = P.attr_value(a2)
+            if isinstance(v, np.ndarray):
+                np.testing.assert_array_equal(got, v)
+            elif isinstance(v, list):
+                assert list(got) == pytest.approx(v)
+            else:
+                assert got == pytest.approx(v) if isinstance(v, float) \
+                    else got == v
+
+
+def _roundtrip(outputs, inputs, feeds, rtol=1e-5):
+    """Export the graph, re-import, run both, compare numerics."""
+    ex = ht.Executor({"fwd": list(outputs)})
+    ref = ex.run("fwd", feed_dict={n: feeds[n.name] for n in inputs})
+
+    path = os.path.join(tempfile.mkdtemp(), "m.onnx")
+    export(ex, inputs, outputs, path,
+           feed_shapes={n.name: feeds[n.name].shape for n in inputs})
+
+    outs2, phs, _ = load_onnx(path)
+    ex2 = ht.Executor({"fwd": outs2})
+    got = ex2.run("fwd", feed_dict={
+        phs[n.name]: feeds[n.name] for n in inputs})
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(r), np.asarray(g),
+                                   rtol=rtol, atol=1e-5)
+    return path
+
+
+class TestRoundTrip:
+    def test_mlp(self):
+        rng = np.random.RandomState(0)
+        x = ht.placeholder_op("x")
+        w1 = ht.Variable("w1", value=rng.randn(16, 32).astype(np.float32))
+        b1 = ht.Variable("b1", value=np.zeros(32, np.float32))
+        w2 = ht.Variable("w2", value=rng.randn(32, 4).astype(np.float32))
+        h = ht.relu_op(ht.matmul_op(x, w1) + ht.broadcastto_op(
+            b1, ht.matmul_op(x, w1)))
+        y = ht.softmax_op(ht.matmul_op(h, w2))
+        path = _roundtrip([y], [x],
+                          {"x": rng.randn(8, 16).astype(np.float32)})
+        # the file is a real protobuf ModelProto
+        m = load_model(path)
+        assert m.producer_name == "hetu_tpu"
+        assert any(n.op_type == "Einsum" for n in m.graph.node)
+
+    def test_conv_pool_bn(self):
+        rng = np.random.RandomState(1)
+        x = ht.placeholder_op("x")
+        w = ht.Variable("w", value=(rng.randn(8, 3, 3, 3) * 0.1)
+                        .astype(np.float32))
+        c = ht.conv2d_op(x, w, padding=1, stride=1)
+        r = ht.relu_op(c)
+        p = ht.max_pool2d_op(r, 2, 2, stride=2)
+        _roundtrip([p], [x],
+                   {"x": rng.randn(2, 3, 8, 8).astype(np.float32)})
+
+    def test_elementwise_chain(self):
+        rng = np.random.RandomState(2)
+        x = ht.placeholder_op("x")
+        y = ht.tanh_op(ht.exp_op(ht.mul_byconst_op(x, 0.1)))
+        z = ht.sigmoid_op(y + y)
+        _roundtrip([z], [x],
+                   {"x": rng.randn(4, 5).astype(np.float32)})
+
+    def test_embedding_gather(self):
+        rng = np.random.RandomState(3)
+        ids = ht.placeholder_op("ids")
+        table = ht.Variable("table",
+                            value=rng.randn(50, 8).astype(np.float32))
+        emb = ht.embedding_lookup_op(table, ids)
+        out = ht.reduce_sum_op(emb, axes=[1])
+        ex = ht.Executor({"fwd": [out]})
+        feed = rng.randint(0, 50, (4, 6)).astype(np.int32)
+        ref = ex.run("fwd", feed_dict={ids: feed})
+
+        path = os.path.join(tempfile.mkdtemp(), "emb.onnx")
+        ex.config.feed_dtypes = {"ids": np.int32}
+        export(ex, [ids], [out], path, feed_shapes={"ids": feed.shape})
+        outs2, phs, _ = load_onnx(path)
+        ex2 = ht.Executor({"fwd": outs2})
+        got = ex2.run("fwd", feed_dict={phs["ids"]: feed})
+        np.testing.assert_allclose(np.asarray(ref[0]),
+                                   np.asarray(got[0]), rtol=1e-5)
+
+    def test_transformer_block(self):
+        rng = np.random.RandomState(4)
+        bs, seq, dim = 2, 8, 16
+        x = ht.placeholder_op("x")
+        attn = ht.layers.MultiHeadAttention(dim, 2, seq, bs, name="attn")
+        h = attn(x)
+        ln = ht.layers.LayerNorm(dim, name="ln")
+        out = ln(h + x)
+        _roundtrip([out], [x],
+                   {"x": rng.randn(bs * seq, dim).astype(np.float32)},
+                   rtol=1e-4)
+
+    def test_isfinite_clip_roundtrip(self):
+        # regression: is_finite must not export as bare IsInf; Clip with
+        # initializer bounds must import them
+        import jax.numpy as jnp
+        from hetu_tpu.graph.ops_math import _simple
+        x = ht.placeholder_op("x")
+        y = _simple("F", lambda a: jnp.where(
+            jnp.isfinite(a), jnp.clip(a, -2.0, 2.0), -1.0), x)
+        X = np.array([[1.5, -7.0, np.inf, np.nan]], np.float32)
+        _roundtrip([y], [x], {"x": X})
+
+    def test_avgpool_with_padding_roundtrip(self):
+        # regression: reduce_window_sum export must count included pads
+        rng = np.random.RandomState(7)
+        x = ht.placeholder_op("x")
+        p = ht.avg_pool2d_op(x, 3, 3, padding=1, stride=2)
+        _roundtrip([p], [x],
+                   {"x": rng.randn(2, 3, 9, 9).astype(np.float32)})
+
+    def test_equal_params_get_unique_names(self):
+        # regression: two identical param tensors must not collide
+        x = ht.placeholder_op("x")
+        b1 = ht.Variable("b1", value=np.zeros((4,), np.float32))
+        b2 = ht.Variable("b2", value=np.zeros((4,), np.float32))
+        y = (x + ht.broadcastto_op(b1, x)) * ht.broadcastto_op(b2, x)
+        ex = ht.Executor({"f": [y]})
+        path = os.path.join(tempfile.mkdtemp(), "dup.onnx")
+        export(ex, [x], [y], path, feed_shapes={"x": (2, 4)})
+        names = [t.name for t in load_model(path).graph.initializer]
+        assert len(names) == len(set(names)), names
+
+    def test_imported_model_is_trainable(self):
+        rng = np.random.RandomState(5)
+        x = ht.placeholder_op("x")
+        w = ht.Variable("w", value=rng.randn(4, 2).astype(np.float32))
+        y = ht.matmul_op(x, w)
+        ex = ht.Executor({"fwd": [y]})
+        path = os.path.join(tempfile.mkdtemp(), "t.onnx")
+        export(ex, [x], [y], path, feed_shapes={"x": (8, 4)})
+
+        outs, phs, _ = load_onnx(path)
+        y_ = ht.placeholder_op("y_")
+        loss = ht.reduce_mean_op(ht.reduce_sum_op(
+            ht.mul_op(outs[0] - y_, outs[0] - y_), [1]), [0])
+        train = ht.optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        ex2 = ht.Executor({"train": [loss, train]})
+        X = rng.randn(8, 4).astype(np.float32)
+        Y = X @ rng.randn(4, 2).astype(np.float32)
+        losses = [float(ex2.run("train", feed_dict={
+            phs["x"]: X, y_: Y})[0]) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.1

@@ -1,0 +1,98 @@
+"""BERT tokenizer tests (reference tokenizers/bert_tokenizer.py)."""
+
+import os
+import tempfile
+
+import pytest
+
+from hetu_tpu.tokenizers import (BasicTokenizer, BertTokenizer,
+                                 WordpieceTokenizer, load_vocab,
+                                 whitespace_tokenize)
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "quick",
+         "brown", "fox", "jump", "##ed", "##s", "over", "lazy", "dog",
+         "un", "##aff", "##able", "run", "##ning", ",", "."]
+
+
+@pytest.fixture(scope="module")
+def vocab_file():
+    d = tempfile.mkdtemp()
+    p = os.path.join(d, "vocab.txt")
+    with open(p, "w") as f:
+        f.write("\n".join(VOCAB) + "\n")
+    return p
+
+
+class TestBasic:
+    def test_lower_and_punct(self):
+        t = BasicTokenizer()
+        assert t.tokenize("The quick, brown FOX.") == \
+            ["the", "quick", ",", "brown", "fox", "."]
+
+    def test_accents_stripped(self):
+        assert BasicTokenizer().tokenize("Héllo") == ["hello"]
+
+    def test_chinese_chars_split(self):
+        assert BasicTokenizer().tokenize("ab一亍cd") == \
+            ["ab", "一", "亍", "cd"]
+
+    def test_never_split(self):
+        assert BasicTokenizer().tokenize("[CLS] hi [SEP]") == \
+            ["[CLS]", "hi", "[SEP]"]
+
+    def test_whitespace_tokenize(self):
+        assert whitespace_tokenize("  a  b\tc\n") == ["a", "b", "c"]
+
+
+class TestWordpiece:
+    def test_greedy_longest_match(self, vocab_file):
+        wp = WordpieceTokenizer(load_vocab(vocab_file))
+        assert wp.tokenize("unaffable") == ["un", "##aff", "##able"]
+        assert wp.tokenize("jumped") == ["jump", "##ed"]
+
+    def test_unknown_word(self, vocab_file):
+        wp = WordpieceTokenizer(load_vocab(vocab_file))
+        assert wp.tokenize("xyzzy") == ["[UNK]"]
+
+
+class TestBertTokenizer:
+    def test_roundtrip_ids(self, vocab_file):
+        tok = BertTokenizer(vocab_file)
+        tokens = tok.tokenize("The quick brown fox jumps.")
+        ids = tok.convert_tokens_to_ids(tokens)
+        assert tok.convert_ids_to_tokens(ids) == tokens
+        assert tokens == ["the", "quick", "brown", "fox", "jump", "##s",
+                          "."]
+
+    def test_encode_pair_with_padding(self, vocab_file):
+        tok = BertTokenizer(vocab_file)
+        enc = tok.encode("the fox", "lazy dog", max_length=12)
+        assert len(enc["input_ids"]) == 12
+        assert enc["input_ids"][0] == tok.vocab["[CLS]"]
+        assert enc["token_type_ids"][:4] == [0, 0, 0, 0]
+        assert 1 in enc["token_type_ids"]
+        assert enc["attention_mask"][-1] == 0  # padded tail
+
+    def test_encode_truncates(self, vocab_file):
+        tok = BertTokenizer(vocab_file)
+        enc = tok.encode("the quick brown fox jumped over the lazy dog",
+                         max_length=6)
+        assert len(enc["input_ids"]) == 6
+
+    def test_from_pretrained_dir(self, vocab_file):
+        tok = BertTokenizer.from_pretrained(os.path.dirname(vocab_file))
+        assert tok.tokenize("fox") == ["fox"]
+
+    def test_missing_vocab_raises(self):
+        with pytest.raises(ValueError):
+            BertTokenizer("/nonexistent/vocab.txt")
+
+    def test_crlf_vocab_and_sequential_ids(self):
+        # regression: CRLF endings must strip; blank lines must not shift
+        # ids relative to the embedding rows
+        d = tempfile.mkdtemp()
+        p = os.path.join(d, "v.txt")
+        with open(p, "wb") as f:
+            f.write(b"[PAD]\r\n[UNK]\r\n\r\nhello\r\n")
+        v = load_vocab(p)
+        assert v["[PAD]"] == 0 and v["[UNK]"] == 1 and v["hello"] == 3
