@@ -43,6 +43,8 @@ from .cache import CacheSparseTable, EmbeddingCache
 from . import tokenizers
 from . import planner
 from . import onnx
+from . import graphboard
+from . import launcher
 
 # MoE / communication op surface
 from .graph.ops_moe import (

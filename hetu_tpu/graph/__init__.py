@@ -52,3 +52,6 @@ from .ops_embed import (
     EmbeddingLookupOp, embedding_lookup_op, IndexedSlicesOp,
     unique_indices_op,
 )
+from .ops_gnn import (
+    DistGCN15dOp, distgcn_15d_op, gcn_layer_shard_specs,
+)

@@ -1,0 +1,72 @@
+"""GNN ops: 1.5-D partitioned GCN layer (reference
+gpu_ops/DistGCN_15d.py).
+
+The reference computes ``Z = (A @ H) @ W`` over a (size/replication) x
+replication process grid: features H row-partitioned across row groups,
+staged block broadcasts along columns, cuSPARSE csrmm per block, then an
+allreduce over each row group (DistGCN_15d.py:20-72 ``broad_func``).
+
+TPU-native mapping: the staged broadcasts + allreduce collapse into
+sharding annotations + one ``psum`` —
+
+    A : (N, N) sharded P(row_axis, col_axis)
+    H : (N, F) sharded P(col_axis, None)   (replicated over row_axis)
+    partial = A_blk @ H_blk                 (local MXU matmul)
+    Z = psum(partial, col_axis)             (N/row, F) sharded P(row_axis)
+
+which is the same 1.5-D communication volume (H replicated over the
+short axis, partial sums reduced over the long one) with XLA choosing
+the collective implementation.  Inside pjit (no explicit axis env) the
+op is the plain dense composition and XLA derives the collectives from
+the operand shardings.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .node import Op, TraceContext
+from .ops_math import _simple
+
+
+class DistGCN15dOp(Op):
+    """Z = (A @ H) @ W with 1.5-D sharding when mesh axes are present."""
+
+    def __init__(self, a, h, w, row_axis="dp", col_axis="tp", ctx=None):
+        super().__init__(a, h, w, name="DistGCN15d", ctx=ctx)
+        self.row_axis = row_axis
+        self.col_axis = col_axis
+
+    def compute(self, input_vals, tc: TraceContext):
+        a, h, w = input_vals
+        if tc.has_axis(self.col_axis):
+            partial = a @ h
+            z = jax.lax.psum(partial, self.col_axis)
+            return z @ w
+        return (a @ h) @ w
+
+    def gradient(self, output_grad):
+        from .node import vjp_gradient
+        return vjp_gradient(self, output_grad)
+
+
+def distgcn_15d_op(node_A, node_B, node_C, node_Count_Self=None,
+                   node_Count_All=None, size=None, replication=None,
+                   device_id=None, comm=None, comm_groups=None,
+                   need_W=True, row_axis="dp", col_axis="tp", ctx=None):
+    """Factory matching the reference op name/arg order
+    (DistGCN_15d.py:75: node_A=adjacency, node_B=features, node_C=weight).
+    The process-grid arguments (size/replication/device_id/comm*) are
+    accepted for API parity but subsumed by mesh axis names on TPU."""
+    if not need_W:
+        return _simple("DistGCN15dNoW", lambda a, h: a @ h, node_A,
+                       node_B, ctx=ctx)
+    return DistGCN15dOp(node_A, node_B, node_C, row_axis=row_axis,
+                        col_axis=col_axis, ctx=ctx)
+
+
+def gcn_layer_shard_specs(row_axis="dp", col_axis="tp"):
+    """The shardings to place on (A, H, W) for the 1.5-D layout."""
+    return (P(row_axis, col_axis), P(col_axis, None), P(None, None))

@@ -1,0 +1,164 @@
+"""Graphboard: dataflow-graph visualization (reference
+python/graphboard/graph2fig.py — renders the graph and serves it on a
+local HTTP port).
+
+TPU build renders the Op graph three ways:
+
+- ``to_dot(nodes)``   — Graphviz DOT text,
+- ``to_html(nodes)``  — standalone HTML page (embedded SVG-free force
+  layout, no external assets: the image has no egress),
+- ``show(executor, port)`` / ``close()`` — serve the HTML like the
+  reference's `show` (graph2fig.py:11-30).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+
+from .graph.node import Op
+from .graph.autodiff import find_topo_sort
+from .graph.ops_misc import PlaceholderOp
+from .optimizer import OptimizerOp
+
+_httpd = None
+
+
+def _collect(nodes_or_executor):
+    if hasattr(nodes_or_executor, "eval_node_dict"):
+        nodes = [n for ns in nodes_or_executor.eval_node_dict.values()
+                 for n in ns]
+    elif isinstance(nodes_or_executor, Op):
+        nodes = [nodes_or_executor]
+    else:
+        nodes = list(nodes_or_executor)
+    return find_topo_sort(nodes)
+
+
+def _kind(node):
+    if isinstance(node, OptimizerOp):
+        return "optimizer"
+    if isinstance(node, PlaceholderOp):
+        return "variable" if node.is_variable else "placeholder"
+    return "op"
+
+
+_COLORS = {"op": "#BFDFFF", "placeholder": "#C6F7D0",
+           "variable": "#FFE9A8", "optimizer": "#FFC4C4"}
+
+
+def to_dot(nodes_or_executor, name="hetu_graph"):
+    topo = _collect(nodes_or_executor)
+    lines = [f'digraph "{name}" {{', "  rankdir=TB;",
+             "  node [shape=box, style=filled, fontname=Helvetica];"]
+    for n in topo:
+        color = _COLORS[_kind(n)]
+        label = n.name.replace('"', "'")
+        shape = getattr(n, "shape", None)
+        if shape:
+            label += f"\\n{tuple(shape)}"
+        lines.append(f'  n{n.id} [label="{label}", fillcolor="{color}"];')
+    for n in topo:
+        for i in n.inputs:
+            lines.append(f"  n{i.id} -> n{n.id};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def to_html(nodes_or_executor, name="hetu_graph"):
+    """Self-contained HTML: nodes laid out by topological depth with a
+    tiny inline renderer (no CDN dependencies)."""
+    topo = _collect(nodes_or_executor)
+    depth = {}
+    for n in topo:
+        depth[n.id] = 1 + max((depth[i.id] for i in n.inputs), default=-1)
+    data = {
+        "name": name,
+        "nodes": [{"id": n.id, "label": n.name, "kind": _kind(n),
+                   "depth": depth[n.id]} for n in topo],
+        "edges": [{"from": i.id, "to": n.id}
+                  for n in topo for i in n.inputs],
+    }
+    payload = json.dumps(data)
+    return f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{html.escape(name)}</title>
+<style>
+body {{ font-family: Helvetica, sans-serif; margin: 0; }}
+svg {{ width: 100vw; height: 100vh; }}
+.node rect {{ stroke: #333; rx: 4; }}
+.node text {{ font-size: 11px; }}
+.edge {{ stroke: #999; fill: none; marker-end: url(#arr); }}
+</style></head><body>
+<svg id="g"><defs><marker id="arr" viewBox="0 0 10 10" refX="9" refY="5"
+ markerWidth="6" markerHeight="6" orient="auto-start-reverse">
+ <path d="M 0 0 L 10 5 L 0 10 z" fill="#999"/></marker></defs></svg>
+<script>
+const COLORS = {json.dumps(_COLORS)};
+const data = {payload};
+const byDepth = {{}};
+data.nodes.forEach(n => (byDepth[n.depth] ||= []).push(n));
+const W = 170, H = 46, pos = {{}};
+Object.entries(byDepth).forEach(([d, ns]) => ns.forEach((n, i) => {{
+  pos[n.id] = {{x: 40 + i * W, y: 40 + d * H * 1.6}};
+}}));
+const svg = document.getElementById('g');
+const NS = 'http://www.w3.org/2000/svg';
+data.edges.forEach(e => {{
+  const a = pos[e.from], b = pos[e.to];
+  const p = document.createElementNS(NS, 'path');
+  p.setAttribute('class', 'edge');
+  p.setAttribute('d', `M ${{a.x + 70}} ${{a.y + 30}} L ${{b.x + 70}} ${{b.y}}`);
+  svg.appendChild(p);
+}});
+data.nodes.forEach(n => {{
+  const g = document.createElementNS(NS, 'g');
+  g.setAttribute('class', 'node');
+  const r = document.createElementNS(NS, 'rect');
+  const p = pos[n.id];
+  r.setAttribute('x', p.x); r.setAttribute('y', p.y);
+  r.setAttribute('width', 140); r.setAttribute('height', 30);
+  r.setAttribute('fill', COLORS[n.kind]);
+  const t = document.createElementNS(NS, 'text');
+  t.setAttribute('x', p.x + 6); t.setAttribute('y', p.y + 19);
+  t.textContent = n.label.slice(0, 22);
+  g.appendChild(r); g.appendChild(t); svg.appendChild(g);
+}});
+const maxX = Math.max(...Object.values(pos).map(p => p.x)) + 220;
+const maxY = Math.max(...Object.values(pos).map(p => p.y)) + 120;
+svg.setAttribute('viewBox', `0 0 ${{maxX}} ${{maxY}}`);
+</script></body></html>"""
+
+
+def show(executor, port=9997):
+    """Serve the executor's graph on http://localhost:port (reference
+    graph2fig.show)."""
+    global _httpd
+    import http.server
+
+    page = to_html(executor).encode("utf-8")
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(page)))
+            self.end_headers()
+            self.wfile.write(page)
+
+        def log_message(self, *a):
+            pass
+
+    close()
+    _httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=_httpd.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{port}"
+
+
+def close():
+    """Stop the server started by show() (reference graph2fig.close)."""
+    global _httpd
+    if _httpd is not None:
+        _httpd.shutdown()
+        _httpd.server_close()
+        _httpd = None

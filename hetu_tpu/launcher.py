@@ -1,0 +1,251 @@
+"""Process launcher (reference bin/heturun + python/runner.py:150-260 +
+python/hetu/launcher.py).
+
+The reference spawns PS scheduler/server processes locally, starts remote
+processes over ssh/paramiko, and runs workers under mpirun with DMLC_*
+env vars.  The TPU build has no MPI and no scheduler role (the TCP PS
+server is self-contained): `heturun -c cluster.yml python train.py`
+
+- starts `servers:` PS processes per host (local ones directly; remote
+  ones via the system `ssh` when configured),
+- starts `workers:` worker processes per host with HETU_PS_* and
+  JAX_COORDINATOR_* env so workers reach the PS and, on TPU pods,
+  `jax.distributed.initialize()` finds the coordinator,
+- tears everything down on SIGINT like the reference runner
+  (runner.py:16-22).
+
+The python API `launch(target, args)` mirrors reference launcher.py:18:
+run a callable under a local PS "cluster" (used by the cache tests the
+same way hetu_cache_test.py:11-34 uses it).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import multiprocessing
+
+from .context import DistConfig
+
+_procs: list = []
+DEFAULT_PS_PORT = 23455
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_ps_process(port):
+    proc = multiprocessing.get_context("spawn").Process(
+        target=_ps_main, args=(port,), daemon=True)
+    proc.start()
+    _procs.append(proc)
+    return proc
+
+
+def _ps_main(port):
+    os.environ["HETU_PS_PORT"] = str(port)
+    from .ps.server import PSServer
+    PSServer.serve_from_env()
+
+
+def _wait_ps(host, port, timeout=20.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            s = socket.create_connection((host, port), timeout=1.0)
+            s.close()
+            return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"PS at {host}:{port} did not come up")
+
+
+def _worker_env(config, host, rank, nrank, ps_host, ps_port,
+                coordinator=None):
+    env = dict(os.environ)
+    # make hetu_tpu importable from any cwd (reference hetu.exp sets
+    # PYTHONPATH the same way)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    if ps_port is not None:
+        env["HETU_PS_ADDR"] = f"{ps_host}:{ps_port}"
+        env["HETU_PS_RANK"] = str(rank)
+        env["HETU_PS_NRANK"] = str(nrank)
+    if coordinator and nrank > 1:
+        # JAX_COORDINATOR_ADDRESS is read by jax.distributed.initialize();
+        # process counts are NOT read from env by jax, so workers call our
+        # distributed_init() helper (below) which passes them explicitly
+        env["JAX_COORDINATOR_ADDRESS"] = coordinator
+        env["HETU_NUM_PROCESSES"] = str(nrank)
+        env["HETU_PROCESS_ID"] = str(rank)
+    return env
+
+
+def distributed_init():
+    """Worker-side bring-up for multi-host meshes (replaces the
+    reference's wrapped_mpi_nccl_init, executor.py:60-71): call this at
+    the top of a worker script launched by heturun.  No-op single-host."""
+    import jax
+
+    nrank = int(os.environ.get("HETU_NUM_PROCESSES", "1"))
+    if nrank <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=nrank,
+        process_id=int(os.environ["HETU_PROCESS_ID"]))
+
+
+def _sigint(sig, frame):
+    for p in _procs:
+        try:
+            (p.kill if hasattr(p, "kill") else p.terminate)()
+        except Exception:
+            pass
+    sys.exit(0)
+
+
+def run_cluster(config: DistConfig, command, coordinator_port=6655):
+    """heturun main path: PS process(es) + worker subprocesses running
+    `command` (argv list).  Returns worker exit codes.
+
+    Multiple servers get sequential ports (our PS server is one process
+    per port, unlike ps-lite's key-sharded server group); workers see the
+    first as HETU_PS_ADDR and the full list as HETU_PS_ADDRS."""
+    signal.signal(signal.SIGINT, _sigint)
+    _procs.clear()
+    ps_port = None
+    local_names = ("localhost", "127.0.0.1", socket.gethostname())
+    # PS lives on the first host that configures servers (NOT necessarily
+    # the chief)
+    ps_host = next(iter(config.servers), config.chief or "localhost")
+    ps_addrs = []
+    if config.enable_PS:
+        base_port = int(os.environ.get("HETU_PS_PORT", DEFAULT_PS_PORT))
+        idx = 0
+        for host, n in config.servers.items():
+            for _ in range(n):
+                port = base_port + idx
+                idx += 1
+                if host in local_names:
+                    _start_ps_process(port)
+                else:
+                    _ssh_spawn(host, [
+                        sys.executable, "-m", "hetu_tpu.launcher",
+                        "--serve-ps", str(port)])
+                ps_addrs.append(f"{host}:{port}")
+        ps_host, ps_port = ps_addrs[0].rsplit(":", 1)
+        ps_port = int(ps_port)
+        _wait_ps("localhost" if ps_host in local_names else ps_host,
+                 ps_port)
+
+    nrank = config.num_workers
+    chief = config.chief or "localhost"
+    coordinator = f"{chief}:{coordinator_port}" if nrank > 1 else None
+    workers = []
+    rank = 0
+    for host, n in config.workers.items():
+        for _ in range(n):
+            env = _worker_env(config, host, rank, nrank, ps_host, ps_port,
+                              coordinator)
+            if ps_addrs:
+                env["HETU_PS_ADDRS"] = ",".join(ps_addrs)
+            if host in local_names:
+                p = subprocess.Popen(command, env=env)
+                _procs.append(p)
+            else:
+                p = _ssh_spawn(host, command, env={
+                    k: v for k, v in env.items()
+                    if k.startswith(("HETU_", "JAX_"))})
+            workers.append(p)
+            rank += 1
+    codes = [p.wait() for p in workers]
+    for p in _procs:
+        if hasattr(p, "poll") and p.poll() is None:
+            p.terminate()
+        elif hasattr(p, "is_alive") and p.is_alive():
+            p.terminate()
+    return codes
+
+
+def _ssh_spawn(host, command, env=None):
+    """Remote start over the system ssh (reference uses paramiko,
+    runner.py:36-148).  Untested without a cluster; kept narrow."""
+    import shlex
+
+    parts = [f"{k}={shlex.quote(str(v))}" for k, v in (env or {}).items()]
+    parts += [shlex.quote(str(c)) for c in command]
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+           " ".join(parts)]
+    p = subprocess.Popen(cmd)
+    _procs.append(p)
+    return p
+
+
+def launch(target, args=(), num_servers=1):
+    """Python-API launcher (reference launcher.py:18): run `target(args)`
+    with a freshly started local PS; tears the PS down after."""
+    port = _free_port()
+    proc = _start_ps_process(port)
+    _wait_ps("localhost", port)
+    old = os.environ.get("HETU_PS_ADDR")
+    os.environ["HETU_PS_ADDR"] = f"localhost:{port}"
+    try:
+        from .ps.client import PSClient
+        PSClient._instance = None  # re-resolve transport from env
+        return target(*args) if args else target()
+    finally:
+        if old is None:
+            os.environ.pop("HETU_PS_ADDR", None)
+        else:
+            os.environ["HETU_PS_ADDR"] = old
+        from .ps.client import PSClient
+        PSClient._instance = None
+        proc.terminate()
+        proc.join(timeout=5)
+        _procs.remove(proc) if proc in _procs else None
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="heturun",
+        description="hetu_tpu cluster launcher (reference bin/heturun)")
+    parser.add_argument("-c", "--config", default=None,
+                        help="cluster yaml (DistConfig format)")
+    parser.add_argument("-s", "--servers", type=int, default=0,
+                        help="local PS server count (no yaml)")
+    parser.add_argument("-w", "--workers", type=int, default=1,
+                        help="local worker count (no yaml)")
+    parser.add_argument("--serve-ps", type=int, default=None,
+                        help=argparse.SUPPRESS)  # internal: PS role
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="worker command, e.g. python train.py")
+    args = parser.parse_args(argv)
+
+    if args.serve_ps is not None:
+        _ps_main(args.serve_ps)
+        return 0
+    if not args.command:
+        parser.error("no worker command given")
+    if args.config:
+        config = DistConfig(file=args.config)
+    else:
+        config = DistConfig(num_servers=args.servers,
+                            num_workers=args.workers)
+    codes = run_cluster(config, args.command)
+    return max(codes) if codes else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,4 +11,6 @@ setup(
     package_data={"hetu_tpu.native": ["*.so", "*.cpp"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy"],
+    entry_points={"console_scripts":
+                  ["heturun=hetu_tpu.launcher:main"]},
 )

@@ -1,0 +1,44 @@
+"""Graphboard tests (reference python/graphboard/graph2fig.py)."""
+
+import urllib.request
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu import graphboard
+
+
+def _small_graph():
+    x = ht.placeholder_op("x")
+    w = ht.Variable("w", value=np.ones((4, 2), np.float32))
+    y = ht.relu_op(ht.matmul_op(x, w))
+    loss = ht.reduce_mean_op(ht.reduce_sum_op(y, [1]), [0])
+    train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return x, loss, train
+
+
+class TestGraphboard:
+    def test_dot_contains_nodes_and_edges(self):
+        x, loss, train = _small_graph()
+        dot = graphboard.to_dot([loss, train])
+        assert dot.startswith("digraph")
+        assert "Matmul" in dot and "->" in dot
+        # all four node kinds colored
+        assert "#C6F7D0" in dot     # placeholder
+        assert "#FFE9A8" in dot     # variable
+        assert "#FFC4C4" in dot     # optimizer
+
+    def test_html_self_contained(self):
+        x, loss, train = _small_graph()
+        page = graphboard.to_html([loss])
+        assert "<svg" in page
+        # no external assets (image has no egress): no src= or CDN links
+        assert "src=" not in page and "cdn" not in page.lower()
+
+    def test_show_serves_and_close_stops(self):
+        x, loss, _ = _small_graph()
+        ex = ht.Executor({"f": [loss]})
+        url = graphboard.show(ex, port=9941)
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert "Matmul" in body
+        graphboard.close()
