@@ -1,0 +1,196 @@
+// Native PS server core: fused server-side optimizer updates.
+//
+// Reference: ps-lite server optimizers (include/ps/server/optimizer.h:36-275
+// SGD/Momentum/Nesterov/AdaGrad/Adam, dense + per-row sparse) applied by
+// PSHandler on push (PSFHandle.h).  Here the same updates are C loops over
+// the server's numpy-owned buffers, called via ctypes from
+// hetu_tpu/ps/server.py; the Python implementations remain as fallback
+// when no compiler exists.
+//
+// Sparse pushes may carry duplicate ids; stateful optimizers must see each
+// row once (reference dedups via IndexedSlices deduplicate,
+// src/ops/IndexedSlices.cu), so sparse entry points first merge duplicate
+// ids' gradients, then apply per unique row.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+extern "C" {
+
+// ------------------------------------------------------------ dense
+
+void ps_dense_sgd(float* value, const float* grad, int64_t n, float lr) {
+    for (int64_t i = 0; i < n; ++i) value[i] -= lr * grad[i];
+}
+
+// Velocity convention matches the Python fallback (v carries -lr*g) so
+// slot state stays interchangeable between the two engines.
+void ps_dense_momentum(float* value, float* vel, const float* grad,
+                       int64_t n, float lr, float momentum, int nesterov) {
+    if (nesterov) {
+        for (int64_t i = 0; i < n; ++i) {
+            vel[i] = momentum * vel[i] - lr * grad[i];
+            value[i] += momentum * vel[i] - lr * grad[i];
+        }
+    } else {
+        for (int64_t i = 0; i < n; ++i) {
+            vel[i] = momentum * vel[i] - lr * grad[i];
+            value[i] += vel[i];
+        }
+    }
+}
+
+void ps_dense_adagrad(float* value, float* acc, const float* grad,
+                      int64_t n, float lr, float eps) {
+    for (int64_t i = 0; i < n; ++i) {
+        acc[i] += grad[i] * grad[i];
+        value[i] -= lr * grad[i] / (std::sqrt(acc[i]) + eps);
+    }
+}
+
+void ps_dense_adam(float* value, float* m, float* v, const float* grad,
+                   int64_t n, float lr, float b1, float b2, float eps,
+                   int64_t t) {
+    const float bc1 = 1.0f - std::pow(b1, (float)t);
+    const float bc2 = 1.0f - std::pow(b2, (float)t);
+    for (int64_t i = 0; i < n; ++i) {
+        m[i] = b1 * m[i] + (1.0f - b1) * grad[i];
+        v[i] = b2 * v[i] + (1.0f - b2) * grad[i] * grad[i];
+        value[i] -= lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + eps);
+    }
+}
+
+// ------------------------------------------------------------ sparse
+// ids: (k,) int64 row indices (may repeat); rows: (k, cols) gradients.
+// merge duplicates, then apply the optimizer row-wise.
+
+static void merge_rows(const int64_t* ids, const float* rows, int64_t k,
+                       int64_t cols, std::vector<int64_t>& uniq,
+                       std::vector<float>& merged) {
+    std::unordered_map<int64_t, int64_t> pos;
+    pos.reserve((size_t)k * 2);
+    for (int64_t i = 0; i < k; ++i) {
+        auto it = pos.find(ids[i]);
+        int64_t j;
+        if (it == pos.end()) {
+            j = (int64_t)uniq.size();
+            pos.emplace(ids[i], j);
+            uniq.push_back(ids[i]);
+            merged.insert(merged.end(), cols, 0.0f);
+        } else {
+            j = it->second;
+        }
+        float* dst = merged.data() + j * cols;
+        const float* src = rows + i * cols;
+        for (int64_t c = 0; c < cols; ++c) dst[c] += src[c];
+    }
+}
+
+void ps_sparse_sgd(float* value, const int64_t* ids, const float* rows,
+                   int64_t k, int64_t cols, float lr) {
+    // stateless: no dedup needed, updates are additive
+    for (int64_t i = 0; i < k; ++i) {
+        float* dst = value + ids[i] * cols;
+        const float* src = rows + i * cols;
+        for (int64_t c = 0; c < cols; ++c) dst[c] -= lr * src[c];
+    }
+}
+
+void ps_sparse_momentum(float* value, float* vel, const int64_t* ids,
+                        const float* rows, int64_t k, int64_t cols,
+                        float lr, float momentum, int nesterov) {
+    std::vector<int64_t> uniq;
+    std::vector<float> merged;
+    merge_rows(ids, rows, k, cols, uniq, merged);
+    for (size_t u = 0; u < uniq.size(); ++u) {
+        float* val = value + uniq[u] * cols;
+        float* vl = vel + uniq[u] * cols;
+        const float* g = merged.data() + u * cols;
+        if (nesterov) {
+            for (int64_t c = 0; c < cols; ++c) {
+                vl[c] = momentum * vl[c] - lr * g[c];
+                val[c] += momentum * vl[c] - lr * g[c];
+            }
+        } else {
+            for (int64_t c = 0; c < cols; ++c) {
+                vl[c] = momentum * vl[c] - lr * g[c];
+                val[c] += vl[c];
+            }
+        }
+    }
+}
+
+void ps_sparse_adagrad(float* value, float* acc, const int64_t* ids,
+                       const float* rows, int64_t k, int64_t cols,
+                       float lr, float eps) {
+    std::vector<int64_t> uniq;
+    std::vector<float> merged;
+    merge_rows(ids, rows, k, cols, uniq, merged);
+    for (size_t u = 0; u < uniq.size(); ++u) {
+        float* val = value + uniq[u] * cols;
+        float* a = acc + uniq[u] * cols;
+        const float* g = merged.data() + u * cols;
+        for (int64_t c = 0; c < cols; ++c) {
+            a[c] += g[c] * g[c];
+            val[c] -= lr * g[c] / (std::sqrt(a[c]) + eps);
+        }
+    }
+}
+
+void ps_sparse_adam(float* value, float* m, float* v, const int64_t* ids,
+                    const float* rows, int64_t k, int64_t cols, float lr,
+                    float b1, float b2, float eps, int64_t t) {
+    // lazy/per-row bias correction with the global step, matching the
+    // reference's sparse Adam (src/ops/OptimizersSparse.cu semantics)
+    std::vector<int64_t> uniq;
+    std::vector<float> merged;
+    merge_rows(ids, rows, k, cols, uniq, merged);
+    const float bc1 = 1.0f - std::pow(b1, (float)t);
+    const float bc2 = 1.0f - std::pow(b2, (float)t);
+    for (size_t u = 0; u < uniq.size(); ++u) {
+        float* val = value + uniq[u] * cols;
+        float* mm = m + uniq[u] * cols;
+        float* vv = v + uniq[u] * cols;
+        const float* g = merged.data() + u * cols;
+        for (int64_t c = 0; c < cols; ++c) {
+            mm[c] = b1 * mm[c] + (1.0f - b1) * g[c];
+            vv[c] = b2 * vv[c] + (1.0f - b2) * g[c] * g[c];
+            val[c] -= lr * (mm[c] / bc1) / (std::sqrt(vv[c] / bc2) + eps);
+        }
+    }
+}
+
+// plain accumulate (no optimizer): value[ids] += rows, dup-safe
+void ps_sparse_accum(float* value, const int64_t* ids, const float* rows,
+                     int64_t k, int64_t cols) {
+    for (int64_t i = 0; i < k; ++i) {
+        float* dst = value + ids[i] * cols;
+        const float* src = rows + i * cols;
+        for (int64_t c = 0; c < cols; ++c) dst[c] += src[c];
+    }
+}
+
+// gather rows: out[i] = value[ids[i]]
+void ps_sparse_gather(const float* value, const int64_t* ids, float* out,
+                      int64_t k, int64_t cols) {
+    for (int64_t i = 0; i < k; ++i) {
+        std::memcpy(out + i * cols, value + ids[i] * cols,
+                    (size_t)cols * sizeof(float));
+    }
+}
+
+// bump version counters for the unique ids (HET cache bookkeeping,
+// src/hetu_cache embedding.h Line::version)
+void ps_bump_versions(int64_t* versions, const int64_t* ids, int64_t k) {
+    std::unordered_set<int64_t> seen;
+    seen.reserve((size_t)k * 2);
+    for (int64_t i = 0; i < k; ++i) {
+        if (seen.insert(ids[i]).second) versions[ids[i]] += 1;
+    }
+}
+
+}  // extern "C"

@@ -18,6 +18,7 @@ reference does in C++ loops.
 
 from __future__ import annotations
 
+import ctypes
 import os
 import pickle
 import socket
@@ -27,6 +28,87 @@ import threading
 import time
 
 import numpy as np
+
+
+# ----------------------------------------------------------------- #
+# native core: fused C++ update loops (hetu_tpu/native/ps_core.cpp),
+# mirroring the reference's C++ server optimizers (server/optimizer.h).
+# Numpy paths below remain the fallback when no compiler exists.
+# ----------------------------------------------------------------- #
+
+def _load_native():
+    from ..native import build_and_load
+
+    lib = build_and_load("ps_core.cpp", "libps_core.so")
+    if lib is None:
+        return None
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i64 = ctypes.c_int64
+    f32 = ctypes.c_float
+    lib.ps_dense_sgd.argtypes = [f32p, f32p, i64, f32]
+    lib.ps_dense_momentum.argtypes = [f32p, f32p, f32p, i64, f32, f32,
+                                      ctypes.c_int]
+    lib.ps_dense_adagrad.argtypes = [f32p, f32p, f32p, i64, f32, f32]
+    lib.ps_dense_adam.argtypes = [f32p, f32p, f32p, f32p, i64, f32, f32,
+                                  f32, f32, i64]
+    lib.ps_sparse_sgd.argtypes = [f32p, i64p, f32p, i64, i64, f32]
+    lib.ps_sparse_momentum.argtypes = [f32p, f32p, i64p, f32p, i64, i64,
+                                       f32, f32, ctypes.c_int]
+    lib.ps_sparse_adagrad.argtypes = [f32p, f32p, i64p, f32p, i64, i64,
+                                      f32, f32]
+    lib.ps_sparse_adam.argtypes = [f32p, f32p, f32p, i64p, f32p, i64,
+                                   i64, f32, f32, f32, f32, i64]
+    lib.ps_sparse_accum.argtypes = [f32p, i64p, f32p, i64, i64]
+    lib.ps_sparse_gather.argtypes = [f32p, i64p, f32p, i64, i64]
+    lib.ps_bump_versions.argtypes = [i64p, i64p, i64]
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+def _fp(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _ip(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32_ready(*arrays):
+    """Arrays safe to hand to the float32 C loops (dtype + layout)."""
+    return _NATIVE is not None and all(
+        a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+        for a in arrays)
+
+
+def _dense_ready(value, grad, *state):
+    """Dense fast path: exact shape match (the numpy fallback also
+    supports broadcastable grads; those take the fallback)."""
+    return value.shape == grad.shape and _f32_ready(value, grad, *state)
+
+
+def _check_ids(ids, nrows):
+    """Bounds-check before raw pointer arithmetic — preserves the
+    IndexError the numpy paths raised for bad ids (the C loops would
+    corrupt server memory instead)."""
+    if len(ids) and (int(ids.min()) < 0 or int(ids.max()) >= nrows):
+        raise IndexError(
+            f"sparse ids out of range for table with {nrows} rows")
+
+
+def _sparse_ready(value, ids, rows, *state):
+    """Sparse fast path: 2D table, float32 everywhere, int64 contiguous
+    ids within bounds, rows shaped (k, cols)."""
+    if value.ndim != 2 or not _f32_ready(value, rows, *state):
+        return False
+    if ids.dtype != np.int64 or not ids.flags["C_CONTIGUOUS"]:
+        return False
+    if rows.shape != (len(ids), value.shape[1]):
+        return False
+    _check_ids(ids, value.shape[0])
+    return True
 
 
 # --------------------------------------------------------------------- #
@@ -57,7 +139,18 @@ class ServerOptimizer:
 
 class ServerSGD(ServerOptimizer):
     def apply_dense(self, value, grad, state):
+        if _dense_ready(value, grad):
+            _NATIVE.ps_dense_sgd(_fp(value), _fp(grad), value.size,
+                                 self.lr)
+            return
         value -= self.lr * grad
+
+    def apply_sparse(self, value, ids, rows, state):
+        if _sparse_ready(value, ids, rows):
+            _NATIVE.ps_sparse_sgd(_fp(value), _ip(ids), _fp(rows),
+                                  len(ids), value.shape[-1], self.lr)
+            return
+        super().apply_sparse(value, ids, rows, state)
 
 
 class ServerMomentum(ServerOptimizer):
@@ -70,6 +163,11 @@ class ServerMomentum(ServerOptimizer):
         return {"v": np.zeros(shape, np.float32)}
 
     def apply_dense(self, value, grad, state):
+        if _dense_ready(value, grad, state["v"]):
+            _NATIVE.ps_dense_momentum(_fp(value), _fp(state["v"]),
+                                      _fp(grad), value.size, self.lr,
+                                      self.momentum, int(self.nesterov))
+            return
         v = state["v"]
         v *= self.momentum
         v -= self.lr * grad
@@ -78,10 +176,22 @@ class ServerMomentum(ServerOptimizer):
         else:
             value += v
 
+    def apply_sparse(self, value, ids, rows, state):
+        if _sparse_ready(value, ids, rows, state["v"]):
+            _NATIVE.ps_sparse_momentum(
+                _fp(value), _fp(state["v"]), _ip(ids), _fp(rows),
+                len(ids), value.shape[-1], self.lr, self.momentum,
+                int(self.nesterov))
+            return
+        super().apply_sparse(value, ids, rows, state)
+
     def _sparse_rows(self, value, uniq, merged, state):
         v = state["v"]
         v[uniq] = self.momentum * v[uniq] - self.lr * merged
-        value[uniq] += v[uniq]
+        if self.nesterov:
+            value[uniq] += self.momentum * v[uniq] - self.lr * merged
+        else:
+            value[uniq] += v[uniq]
 
 
 class ServerNesterov(ServerMomentum):
@@ -100,8 +210,21 @@ class ServerAdaGrad(ServerOptimizer):
         return {"acc": np.full(shape, self.init_acc, np.float32)}
 
     def apply_dense(self, value, grad, state):
+        if _dense_ready(value, grad, state["acc"]):
+            _NATIVE.ps_dense_adagrad(_fp(value), _fp(state["acc"]),
+                                     _fp(grad), value.size, self.lr,
+                                     self.eps)
+            return
         state["acc"] += grad * grad
         value -= self.lr * grad / (np.sqrt(state["acc"]) + self.eps)
+
+    def apply_sparse(self, value, ids, rows, state):
+        if _sparse_ready(value, ids, rows, state["acc"]):
+            _NATIVE.ps_sparse_adagrad(
+                _fp(value), _fp(state["acc"]), _ip(ids), _fp(rows),
+                len(ids), value.shape[-1], self.lr, self.eps)
+            return
+        super().apply_sparse(value, ids, rows, state)
 
     def _sparse_rows(self, value, uniq, merged, state):
         acc = state["acc"]
@@ -121,8 +244,13 @@ class ServerAdam(ServerOptimizer):
 
     def apply_dense(self, value, grad, state):
         state["t"] += 1
-        t = float(state["t"])
+        t = int(state["t"])
         m, v = state["m"], state["v"]
+        if _dense_ready(value, grad, m, v):
+            _NATIVE.ps_dense_adam(_fp(value), _fp(m), _fp(v), _fp(grad),
+                                  value.size, self.lr, self.beta1,
+                                  self.beta2, self.eps, t)
+            return
         m *= self.beta1
         m += (1 - self.beta1) * grad
         v *= self.beta2
@@ -130,6 +258,16 @@ class ServerAdam(ServerOptimizer):
         mhat = m / (1 - self.beta1 ** t)
         vhat = v / (1 - self.beta2 ** t)
         value -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def apply_sparse(self, value, ids, rows, state):
+        if _sparse_ready(value, ids, rows, state["m"], state["v"]):
+            state["t"] += 1
+            _NATIVE.ps_sparse_adam(
+                _fp(value), _fp(state["m"]), _fp(state["v"]), _ip(ids),
+                _fp(rows), len(ids), value.shape[-1], self.lr,
+                self.beta1, self.beta2, self.eps, int(state["t"]))
+            return
+        super().apply_sparse(value, ids, rows, state)
 
     def _sparse_rows(self, value, uniq, merged, state):
         state["t"] += 1
@@ -303,21 +441,37 @@ class PSServer:
 
     def sparse_pull(self, key, ids):
         p = self.params[key]
-        ids = np.asarray(ids, np.int64).reshape(-1)
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
         with p.lock:
+            if p.value.ndim == 2 and _f32_ready(p.value):
+                _check_ids(ids, p.value.shape[0])
+                out = np.empty((len(ids), p.value.shape[1]), np.float32)
+                _NATIVE.ps_sparse_gather(_fp(p.value), _ip(ids), _fp(out),
+                                         len(ids), p.value.shape[1])
+                return out
             return p.value[ids]
 
     def sparse_push(self, key, ids, rows):
         p = self.params[key]
-        ids = np.asarray(ids, np.int64).reshape(-1)
-        rows = np.asarray(rows, np.float32).reshape(len(ids), -1)
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        rows = np.ascontiguousarray(
+            np.asarray(rows, np.float32).reshape(len(ids), -1))
         with p.lock:
             if p.optimizer is not None:
                 p.optimizer.apply_sparse(p.value, ids, rows, p.state)
+            elif _sparse_ready(p.value, ids, rows):
+                _NATIVE.ps_sparse_accum(_fp(p.value), _ip(ids), _fp(rows),
+                                        len(ids), p.value.shape[1])
             else:
                 np.add.at(p.value, ids, rows)
             if p.versions is not None:
-                p.versions[np.unique(ids)] += 1
+                if _NATIVE is not None and \
+                        p.versions.flags["C_CONTIGUOUS"]:
+                    _check_ids(ids, len(p.versions))
+                    _NATIVE.ps_bump_versions(_ip(p.versions), _ip(ids),
+                                             len(ids))
+                else:
+                    p.versions[np.unique(ids)] += 1
 
     def sd_pushpull(self, key, ids, rows, pull_ids=None):
         self.sparse_push(key, ids, rows)
