@@ -175,6 +175,10 @@ class SubExecutor:
             opt_sh = _opt_sharding_like(ex, ex.opt_states)
             jit_kwargs["in_shardings"] = (
                 param_sh, opt_sh, rep, rep, feed_sh)
+            # pin updated params/opt states to their input shardings —
+            # otherwise GSPMD may pick a different output layout and the
+            # next step's in_shardings check fails
+            jit_kwargs["out_shardings"] = (param_sh, opt_sh, rep, None)
         return jax.jit(step_fn, **jit_kwargs)
 
     @property
@@ -224,8 +228,13 @@ class SubExecutor:
 
 
 def _opt_sharding_like(ex, opt_states):
+    """Optimizer slot states inherit their parameter's sharding (they are
+    created with zeros_like(param)), so declare whatever each leaf
+    actually has; replicated otherwise."""
     rep = NamedSharding(ex.mesh, P())
-    return jax.tree_util.tree_map(lambda _: rep, opt_states)
+    return jax.tree_util.tree_map(
+        lambda x: x.sharding if isinstance(x, jax.Array)
+        and hasattr(x, "sharding") else rep, opt_states)
 
 
 class Executor:

@@ -10,6 +10,7 @@ derives every collective from those.
 from __future__ import annotations
 
 import jax
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .mesh import make_mesh
@@ -98,6 +99,42 @@ class PipelineParallel4LM(Strategy):
         executor.config.pipeline = executor.config.pipeline or "gpipe"
         if self.num_microbatches:
             executor.config.num_microbatches = self.num_microbatches
+
+
+class FSDP(Strategy):
+    """ZeRO-3-style parameter sharding over the 'dp' axis (SURVEY.md §2.5:
+    absent in the reference core, a strategy dimension only in Galvatron's
+    search space — first-class here because pjit makes it nearly free).
+
+    Each variable's largest divisible dim is sharded over 'dp'; XLA
+    all-gathers params into fwd/bwd and reduce-scatters gradients.
+    Variables smaller than `min_size` replicate (gather overhead beats the
+    memory win)."""
+
+    def __init__(self, dp=None, min_size=1024):
+        self.dp = dp
+        self.min_size = min_size
+
+    def configure(self, executor):
+        if executor.config.mesh is None:
+            dp = self.dp or jax.device_count()
+            executor.config.mesh = make_mesh({"dp": dp})
+        dp = executor.config.mesh.shape.get("dp", 1)
+        if dp <= 1:
+            return  # pre-existing mesh without a usable 'dp' axis
+        for name, node in executor.variables.items():
+            if node.sharding_spec is not None or not node.shape:
+                continue
+            if int(np.prod(node.shape)) < self.min_size:
+                continue
+            dims = len(node.shape)
+            free = [d for d in range(dims) if node.shape[d] % dp == 0]
+            if not free:
+                continue
+            d = max(free, key=lambda d: node.shape[d])
+            spec = [None] * dims
+            spec[d] = "dp"
+            node.sharding_spec = P(*spec)
 
 
 class BaseSearchingStrategy(Strategy):

@@ -1,0 +1,96 @@
+"""Partial reduce — straggler-tolerant data parallelism (SIGMOD'21).
+
+Reference: python/hetu/preduce.py:8-43 `PartialReduce`: a PS-side
+matchmaker (`kPReduceGetPartner`, ps/psf/preduce.h, preduce_handler.cc)
+returns a dynamic subgroup of currently-ready workers; the group then
+allreduce-averages gradients over a cached per-group NCCL communicator.
+
+TPU mapping (SURVEY.md §2.5): inside one synchronous SPMD program there
+are no stragglers, so partial reduce matters at the *process* level
+(multi-host / multi-process CPU workers).  This is the host-coordinated
+variant: the same PS matchmaker forms the group and stamps it with a
+server-assigned match sequence (the shared scratch-key namespace — a
+local round counter would diverge when membership varies), and the
+average rides the PS as an accumulate + pull.  Semantics match the
+reference: the result is the mean over the matched subgroup only.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class PartialReduce:
+    """Reference API: get_partner() -> ranks; preduce(arr, partner) ->
+    subgroup mean (preduce.py:8-43)."""
+
+    def __init__(self, reduce_key=0, max_worker=-1, wait_time=1.0,
+                 client=None):
+        from ..ps.client import PSClient
+
+        self.client = client or PSClient.get()
+        self.reduce_key = reduce_key
+        self.max_worker = max_worker if max_worker > 0 \
+            else self.client.nrank
+        self.wait_time = wait_time
+        self._last_seq = 0
+
+    def get_partner(self, sync=True):
+        """Ask the matchmaker for the current ready subgroup (ranks,
+        sorted).  `sync` kept for reference API parity (async variant
+        returns immediately after registering)."""
+        ranks, seq = self.client.preduce_get_partner(
+            self.reduce_key, self.max_worker, self.wait_time)
+        self._last_seq = seq
+        return tuple(sorted(ranks))
+
+    def preduce(self, array, partner=None, timeout=30.0):
+        """Average `array` over the matched subgroup via the PS."""
+        if partner is None:
+            partner = self.get_partner()
+        if len(partner) <= 1:
+            return np.asarray(array, np.float32)
+        arr = np.asarray(array, np.float32)
+        group_id = "_".join(map(str, partner))
+        key = f"__preduce_{self.reduce_key}_{group_id}_{self._last_seq}"
+        count_key = key + "_n"
+        self.client.parameter_init(key, arr.shape, init_type="constant",
+                                   arg1=0.0)
+        self.client.parameter_init(count_key, (1,), init_type="constant",
+                                   arg1=0.0)
+        # raw accumulate (no server optimizer on the scratch keys); the
+        # data push strictly precedes the count bump, so count==len means
+        # all contributions have landed
+        self.client.push(key, arr)
+        self.client.push(count_key, np.ones(1, np.float32))
+        deadline = time.time() + timeout
+        try:
+            while time.time() < deadline:
+                n = float(np.asarray(self.client.pull(count_key))[0])
+                if n >= len(partner):
+                    break
+                time.sleep(0.005)
+            else:
+                raise TimeoutError("preduce: group members missing")
+            total = np.asarray(self.client.pull(key))
+        except TimeoutError:
+            # best-effort cleanup so incomplete rounds don't leak arrays
+            # on the PS (other members hitting the same timeout race to
+            # the same clears; param_clear is idempotent)
+            self.client.clear(key)
+            self.client.clear(count_key)
+            raise
+        # second count bump marks "read done"; the lowest rank clears the
+        # scratch keys once everyone has read (best-effort, bounded wait)
+        self.client.push(count_key, np.ones(1, np.float32))
+        if min(partner) == self.client.rank:
+            while time.time() < deadline:
+                n = float(np.asarray(self.client.pull(count_key))[0])
+                if n >= 2 * len(partner):
+                    self.client.clear(key)
+                    self.client.clear(count_key)
+                    break
+                time.sleep(0.005)
+        return total / len(partner)

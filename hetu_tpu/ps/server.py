@@ -317,6 +317,8 @@ class PSServer:
         self.ssp_cv = threading.Condition()
         # preduce matchmaking (reference preduce_handler.cc)
         self._preduce_groups = {}
+        self._preduce_seq = 0
+        self._preduce_last = {}   # (key, rank) -> last match seq
         self._preduce_cv = threading.Condition()
         # barrier for BSP (reference PSFHandle BarrierWorker)
         self._barrier_count = {}
@@ -546,8 +548,10 @@ class PSServer:
 
     def preduce_get_partner(self, key, rank, max_worker, wait_time):
         """kPReduceGetPartner (preduce_handler.cc): batch arriving workers
-        into a group; return the member ranks once the group fills or
-        ``wait_time`` (seconds) elapses."""
+        into a group; return (member ranks, match seq) once the group
+        fills or ``wait_time`` (seconds) elapses.  The server-assigned
+        sequence number gives all members a shared scratch-key namespace
+        (local counters diverge when group membership varies)."""
         with self._preduce_cv:
             group = self._preduce_groups.setdefault(key, [])
             group.append(rank)
@@ -559,10 +563,16 @@ class PSServer:
                     break
                 self._preduce_cv.wait(remaining)
             members = sorted(group)
-            # first member to wake clears the batch for the next round
+            # first member to wake stamps the match and clears the batch
             if self._preduce_groups.get(key) is group:
+                self._preduce_seq += 1
                 self._preduce_groups[key] = []
-            return members
+                seq = self._preduce_seq
+                for m in members:
+                    self._preduce_last[(key, m)] = seq
+            else:
+                seq = self._preduce_last.get((key, rank), 0)
+            return members, seq
 
     # ---------------- introspection ---------------- #
 

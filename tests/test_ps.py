@@ -59,10 +59,11 @@ def test_ssp_and_barrier(local_client):
 
 
 def test_preduce_partner_timeout(local_client):
-    # single worker, wait_time elapses -> group of one
-    members = local_client.preduce_get_partner("k", max_worker=4,
-                                               wait_time=0.05)
+    # single worker, wait_time elapses -> group of one + a match seq
+    members, seq = local_client.preduce_get_partner("k", max_worker=4,
+                                                    wait_time=0.05)
     assert members == [0]
+    assert seq >= 1
 
 
 def test_tcp_transport_roundtrip():
