@@ -44,7 +44,8 @@ def main():
         ht.softmaxcrossentropy_sparse_op(
             logits, ht.array_reshape_op(labels, [batch * seq])), axes=0)
     train = ht.optim.AdamOptimizer(learning_rate=1e-4).minimize(loss)
-    ex = ht.Executor({"train": [loss, train]})
+    # bf16 compute / fp32 masters: the MXU path
+    ex = ht.Executor({"train": [loss, train]}, mixed_precision="bf16")
 
     rng = np.random.RandomState(0)
     feed = {
@@ -52,15 +53,17 @@ def main():
         labels: rng.randint(0, vocab, (batch, seq)).astype(np.int32),
     }
 
-    # warmup (compile)
-    out = ex.run("train", feed_dict=feed)
-    jax.block_until_ready(out[0])
+    # warmup (compile) — materialize to host: block_until_ready does not
+    # reliably wait on the tunneled TPU platform in this image
+    float(np.asarray(ex.run("train", feed_dict=feed)[0]))
 
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         out = ex.run("train", feed_dict=feed)
-    jax.block_until_ready(out[0])
+    # the final loss depends on every prior step's params (donated chain),
+    # so materializing it forces the full sequence
+    float(np.asarray(out[0]))
     dt = (time.perf_counter() - t0) / iters
 
     n_chips = max(1, jax.device_count())

@@ -65,7 +65,8 @@ class HetuConfig:
                  log_path=None, my_eval_nodes=None, dist_strategy=None,
                  pipeline=None, overlap=True, use_preduce=False,
                  use_nccl_collectives=True, seed=0, mesh=None,
-                 num_microbatches=None, dtype=jnp.float32):
+                 num_microbatches=None, dtype=jnp.float32,
+                 mixed_precision=None):
         self.comm_mode = comm_mode
         self.use_sparse_pull = use_sparse_pull
         self.cstable_policy = cstable_policy
@@ -83,6 +84,14 @@ class HetuConfig:
         self.mesh = mesh
         self.num_microbatches = num_microbatches
         self.dtype = dtype
+        # compute dtype policy: None = full precision; "bf16"/jnp.bfloat16
+        # casts params+float feeds at graph entry, keeps fp32 master
+        # weights in the optimizer (MXU wants bf16 matmuls)
+        if mixed_precision in ("bf16", "bfloat16"):
+            mixed_precision = jnp.bfloat16
+        elif mixed_precision in ("fp16", "float16"):
+            mixed_precision = jnp.float16
+        self.mixed_precision = mixed_precision
         self.ps_comm = None
 
 
@@ -125,15 +134,25 @@ class SubExecutor:
         tc.extra_outputs = _ExtraOutputs()
         vals = {}
         new_opt_states = dict(opt_states)
+        mp = self.executor.config.mixed_precision
+
+        def _cast_in(v):
+            # graph entry: float params/feeds compute in the policy dtype;
+            # masters stay fp32 in `params` (optimizer reads those)
+            if mp is not None and hasattr(v, "dtype") \
+                    and jnp.issubdtype(v.dtype, jnp.floating):
+                return v.astype(mp)
+            return v
+
         from .dataloader import DataloaderOp
         for node in self.topo:
             if isinstance(node, DataloaderOp):
-                vals[id(node)] = feeds[node.name]
+                vals[id(node)] = _cast_in(feeds[node.name])
             elif isinstance(node, PlaceholderOp):
                 if node.name in params:
-                    vals[id(node)] = params[node.name]
+                    vals[id(node)] = _cast_in(params[node.name])
                 else:
-                    vals[id(node)] = feeds[node.name]
+                    vals[id(node)] = _cast_in(feeds[node.name])
             elif isinstance(node, OptimizerOp):
                 grad_vals = []
                 for i, g in enumerate(node.inputs):
@@ -151,8 +170,19 @@ class SubExecutor:
                 vals[id(node)] = node.compute(
                     [vals[id(i)] for i in node.inputs], tc)
         outputs = [vals[id(n)] for n in self.eval_nodes]
+        if mp is not None:
+            # report losses/metrics in fp32
+            outputs = [o.astype(jnp.float32) if hasattr(o, "dtype")
+                       and jnp.issubdtype(o.dtype, jnp.floating) else o
+                       for o in outputs]
         new_params = dict(params)
-        new_params.update(tc.extra_outputs)
+        for k, v in tc.extra_outputs.items():
+            if k in params and hasattr(v, "dtype") \
+                    and v.dtype != params[k].dtype:
+                # state written from a bf16 trace (e.g. BN running stats)
+                # must not narrow the fp32 master copy
+                v = v.astype(params[k].dtype)
+            new_params[k] = v
         return new_params, new_opt_states, outputs
 
     def _compile(self, feed_sig):

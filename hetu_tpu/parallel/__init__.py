@@ -24,3 +24,6 @@ from .distributed_strategies import (
 )
 from . import preduce
 from .preduce import PartialReduce
+from . import collective_check
+from .collective_check import check_collective_order, \
+    CollectiveOrderError

@@ -1,0 +1,101 @@
+"""Collective-ordering validator for hand-written shard_map programs.
+
+SURVEY.md §5.2: the reference has no sanitizer; stream races are prevented
+structurally by events, and the deadlock risk lives in hand-paired
+send/recv choreography (pipedream_subexecutor.py:257-290 group-call
+deadlock avoidance).  On TPU the pjit path is safe by construction, but a
+*hand-written* shard_map program can still deadlock or corrupt data when
+different devices disagree on the collective sequence — the realistic way
+that happens under SPMD is a ``lax.cond`` whose predicate depends on
+``axis_index`` with a collective inside only one branch.
+
+``check_collective_order(fn, mesh, in_specs, out_specs, example_args)``
+traces the shard_map program (no execution) and
+
+1. records the sequence of collective primitives with their axis/shape
+   signatures, and
+2. raises :class:`CollectiveOrderError` if any ``lax.cond`` branches
+   disagree on the collectives they issue.
+
+Run it in tests for every hand-written shard_map pipeline; it is cheap
+(one trace, no compile).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# primitives that synchronize a mesh axis (includes the *_invariant
+# spellings jax uses inside shard_map traces); pvary/replication markers
+# are not synchronizing and are ignored
+_COLLECTIVE_PRIMS = {
+    "psum", "psum_invariant", "pmax", "pmin", "pmean", "all_gather",
+    "all_gather_invariant", "all_to_all", "ppermute", "reduce_scatter",
+    "psum_scatter", "pbroadcast",
+}
+
+
+class CollectiveOrderError(AssertionError):
+    pass
+
+
+def _axes_of(eqn):
+    for key in ("axes", "axis_name", "axis_index_groups"):
+        v = eqn.params.get(key)
+        if v is not None:
+            return str(v)
+    return "?"
+
+
+def _collect(closed_or_open, seq):
+    """DFS a jaxpr recording collective signatures; verifies cond
+    branches agree and recurses into scan/while/call bodies."""
+    jaxpr = getattr(closed_or_open, "jaxpr", closed_or_open)
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "cond":
+            subseqs = []
+            for br in eqn.params["branches"]:
+                s = []
+                _collect(br, s)
+                subseqs.append(s)
+            for i, s in enumerate(subseqs[1:], 1):
+                if s != subseqs[0]:
+                    raise CollectiveOrderError(
+                        "lax.cond branches disagree on collectives: "
+                        f"branch 0 issues {subseqs[0] or 'none'}, "
+                        f"branch {i} issues {s or 'none'} — a device "
+                        "taking a different branch deadlocks the axis")
+            seq.extend(subseqs[0])
+            continue
+        for key, v in eqn.params.items():
+            if key == "branches":
+                continue
+            if hasattr(v, "jaxpr") or type(v).__name__ == "Jaxpr":
+                _collect(v, seq)
+        if prim in _COLLECTIVE_PRIMS:
+            shapes = tuple(tuple(v.aval.shape) for v in eqn.invars
+                           if hasattr(v, "aval"))
+            seq.append((prim, _axes_of(eqn), shapes))
+    return seq
+
+
+def check_collective_order(fn, mesh, in_specs, out_specs, example_args):
+    """Trace ``shard_map(fn)`` and validate its collective ordering.
+    Returns the collective sequence [(prim, axes, shapes), ...] on
+    success; raises CollectiveOrderError on cond-branch divergence."""
+    from jax import shard_map
+
+    args = [
+        a if isinstance(a, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(np.shape(a),
+                                  getattr(a, "dtype", jnp.float32))
+        for a in example_args
+    ]
+    f = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    closed = jax.make_jaxpr(f)(*args)
+    seq = []
+    _collect(closed, seq)
+    return seq

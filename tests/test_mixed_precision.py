@@ -1,0 +1,80 @@
+"""Mixed-precision (bf16 compute, fp32 masters) tests.
+
+TPU-first feature with no reference counterpart (Hetu trains fp32; the
+MXU wants bf16 matmuls — task brief 'keep them large, batched, bfloat16').
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import hetu_tpu as ht
+
+
+def _model(tag):
+    x = ht.placeholder_op(f"x_{tag}")
+    y = ht.placeholder_op(f"y_{tag}")
+    w1 = ht.Variable(f"w1_{tag}", value=np.linspace(
+        -0.5, 0.5, 32 * 64).reshape(32, 64).astype(np.float32))
+    w2 = ht.Variable(f"w2_{tag}", value=np.linspace(
+        0.5, -0.5, 64 * 4).reshape(64, 4).astype(np.float32))
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    logits = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y), axes=0)
+    train = ht.optim.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    return x, y, loss, train
+
+
+class TestMixedPrecision:
+    def test_masters_stay_fp32_loss_reports_fp32(self):
+        x, y, loss, train = _model("a")
+        ex = ht.Executor({"train": [loss, train]}, mixed_precision="bf16")
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 32).astype(np.float32)
+        Y = np.eye(4)[rng.randint(0, 4, 16)].astype(np.float32)
+        out = ex.run("train", feed_dict={x: X, y: Y})
+        assert np.asarray(out[0]).dtype == np.float32
+        assert ex.var_values["w1_a"].dtype == jnp.float32
+
+    def test_bf16_trains_close_to_fp32(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(64, 32).astype(np.float32)
+        Y = np.eye(4)[rng.randint(0, 4, 64)].astype(np.float32)
+
+        x1, y1, l1, t1 = _model("fp32")
+        ex1 = ht.Executor({"train": [l1, t1]})
+        x2, y2, l2, t2 = _model("bf16")
+        ex2 = ht.Executor({"train": [l2, t2]}, mixed_precision="bf16")
+        tr1 = [float(ex1.run("train", feed_dict={x1: X, y1: Y})[0])
+               for _ in range(30)]
+        tr2 = [float(ex2.run("train", feed_dict={x2: X, y2: Y})[0])
+               for _ in range(30)]
+        # both converge; trajectories agree loosely (bf16 rounding)
+        assert tr2[-1] < tr2[0] * 0.8
+        assert abs(tr1[-1] - tr2[-1]) < 0.15 * max(tr1[0], 1.0)
+
+    def test_int_feeds_untouched(self):
+        ids = ht.placeholder_op("mp_ids")
+        table = ht.Variable("mp_table",
+                            value=np.random.RandomState(2)
+                            .randn(20, 8).astype(np.float32))
+        emb = ht.embedding_lookup_op(table, ids)
+        out = ht.reduce_sum_op(ht.reduce_sum_op(emb, [2]), [1])
+        ex = ht.Executor({"f": [out]}, mixed_precision="bf16")
+        res = ex.run("f", feed_dict={
+            ids: np.array([[1, 2], [3, 4]], np.int32)})
+        assert np.asarray(res[0]).dtype == np.float32
+
+    def test_batchnorm_running_stats_stay_fp32(self):
+        x = ht.placeholder_op("mp_bn_x")
+        bn = ht.layers.BatchNorm(4, name="mp_bn")
+        h = bn(x)
+        loss = ht.reduce_mean_op(ht.reduce_sum_op(ht.mul_op(h, h), [1]),
+                                 [0])
+        train = ht.optim.SGDOptimizer(learning_rate=0.01).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]}, mixed_precision="bf16")
+        X = np.random.RandomState(3).randn(8, 4).astype(np.float32)
+        ex.run("train", feed_dict={x: X})
+        for name, v in ex.var_values.items():
+            if "mp_bn" in name:
+                assert v.dtype == jnp.float32, (name, v.dtype)
