@@ -1,28 +1,92 @@
 """Benchmark: BERT-style transformer training throughput, samples/sec/chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-BASELINE config 2 (BERT-base-ish DP); runs on whatever devices exist
-(1 real TPU chip under the driver).  vs_baseline is measured/target where
-target comes from BASELINE.json-derived expectations; with no published
-reference numbers (BASELINE.md) we report vs_baseline=1.0 at the defined
-target throughput and track our own trajectory across rounds.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+BASELINE config 2 (BERT-base-ish DP).  Robustness contract (round-2 fix for
+the r1 rc=1): TPU backend bring-up is probed with retries before any graph
+is built; on persistent backend failure the bench falls back to CPU and
+says so in the "platform" field rather than dying with rc=1.  The flash
+attention path is benchmarked by default, with automatic fallback to the
+unfused chain if the Pallas kernel fails to compile on the local chip.
+
+Extras reported: step_time_ms, achieved TFLOP/s/chip, MFU vs the chip's
+bf16 peak (when the device kind is recognized), host-side feed fraction,
+platform, device count.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
+# bf16 peak TFLOP/s per chip by device kind substring (public specs)
+_PEAK_TFLOPS = [
+    ("v6", 918.0),          # Trillium / v6e
+    ("v5p", 459.0),
+    ("v5", 197.0),          # v5e / "TPU v5 lite"
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
 
-def main():
+
+def _peak_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+_PROBE_SRC = """
+import jax, numpy as np, jax.numpy as jnp
+jax.devices()
+np.asarray(jnp.zeros((8, 8)) + 1.0)  # forces backend bring-up + compile
+print(jax.default_backend())
+"""
+
+
+def _bring_up_backend(retries=2, probe_timeout=150.0):
+    """Probe the default backend in a SUBPROCESS with a hard timeout.
+
+    Two TPU failure modes observed (r1 rc=1 and the wedged-tunnel case from
+    the verify notes): backend init raises RuntimeError(UNAVAILABLE), or
+    jax.devices() simply HANGS when the axon tunnel is down.  An in-process
+    probe cannot recover from the hang, so we probe out-of-process; only a
+    clean probe lets this process touch the default backend.  On failure we
+    force CPU via jax.config (the axon plugin ignores the JAX_PLATFORMS env
+    var, so the config call is the only reliable override).
+    """
+    import subprocess
+    import sys
+
     import jax
-    import hetu_tpu as ht
 
-    # BERT-base-ish block stack scaled to fit one chip quickly:
-    # hidden 768, 12 heads, 4 layers (1/3 of BERT-base depth), seq 128
-    batch, seq, hidden, heads, layers_n, vocab = 32, 128, 768, 12, 4, 30522
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu", None
+
+    last_err = None
+    for attempt in range(retries):
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                               capture_output=True, text=True,
+                               timeout=probe_timeout)
+            if r.returncode == 0:
+                return r.stdout.strip().splitlines()[-1], last_err
+            last_err = (r.stderr.strip().splitlines() or ["?"])[-1][:200]
+        except subprocess.TimeoutExpired:
+            last_err = f"backend probe hung >{probe_timeout}s (tunnel down?)"
+        if attempt < retries - 1:
+            time.sleep(10.0 * (attempt + 1))
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu-fallback", last_err
+
+
+def _build(batch, seq, hidden, heads, layers_n, vocab, use_flash, mesh):
+    import hetu_tpu as ht
 
     ids = ht.placeholder_op("input_ids")
     labels = ht.placeholder_op("labels")
@@ -33,6 +97,7 @@ def main():
     h = ht.array_reshape_op(h, [batch * seq, hidden])
     for i in range(layers_n):
         attn = ht.layers.MultiHeadAttention(hidden, heads, seq, batch,
+                                            use_flash=use_flash,
                                             name=f"l{i}_attn")
         h = ht.layers.LayerNorm(hidden, name=f"l{i}_ln1")(h + attn(h))
         wi = ht.layers.Linear(hidden, hidden * 4, name=f"l{i}_ffn_wi")
@@ -45,7 +110,32 @@ def main():
             logits, ht.array_reshape_op(labels, [batch * seq])), axes=0)
     train = ht.optim.AdamOptimizer(learning_rate=1e-4).minimize(loss)
     # bf16 compute / fp32 masters: the MXU path
-    ex = ht.Executor({"train": [loss, train]}, mixed_precision="bf16")
+    ex = ht.Executor({"train": [loss, train]}, mixed_precision="bf16",
+                     mesh=mesh)
+    return ids, labels, ex
+
+
+def _run_once(use_flash, platform):
+    import jax
+    import hetu_tpu as ht  # noqa: F401  (import checked before timing)
+    from hetu_tpu.parallel.mesh import make_mesh
+
+    n_chips = max(1, jax.device_count())
+    # BERT-base-ish proxy scaled to bench quickly: hidden 768, 12 heads,
+    # 4 layers (1/3 of BERT-base depth), seq 128; DP over all chips.
+    per_chip_batch, seq, hidden, heads, layers_n, vocab = \
+        32, 128, 768, 12, 4, 30522
+    iters = 30
+    if os.environ.get("HETU_BENCH_SMALL"):
+        # CPU-verification scale: exercises every code path cheaply
+        per_chip_batch, seq, hidden, heads, layers_n, vocab = \
+            4, 64, 128, 4, 2, 1000
+        iters = 3
+    batch = per_chip_batch * n_chips
+    mesh = make_mesh({"dp": n_chips}) if n_chips > 1 else None
+
+    ids, labels, ex = _build(batch, seq, hidden, heads, layers_n, vocab,
+                             use_flash, mesh)
 
     rng = np.random.RandomState(0)
     feed = {
@@ -57,27 +147,91 @@ def main():
     # reliably wait on the tunneled TPU platform in this image
     float(np.asarray(ex.run("train", feed_dict=feed)[0]))
 
-    iters = 20
+    t_host = 0.0
     t0 = time.perf_counter()
     for _ in range(iters):
+        # ex.run returns after host-side feed prep (numpy casts,
+        # device_put) + async dispatch — outputs are not materialized
+        # until after the loop, so its duration IS the per-step host work
+        tf0 = time.perf_counter()
         out = ex.run("train", feed_dict=feed)
+        t_host += time.perf_counter() - tf0
     # the final loss depends on every prior step's params (donated chain),
     # so materializing it forces the full sequence
     float(np.asarray(out[0]))
     dt = (time.perf_counter() - t0) / iters
 
-    n_chips = max(1, jax.device_count())
-    samples_per_sec_chip = batch / dt / n_chips
-    # target: BASELINE.json north star scaled to this 4-layer proxy —
-    # no published reference number exists (BASELINE.md), so the target is
-    # our own round-1 figure; vs_baseline tracks improvement across rounds.
+    # FLOPs from the XLA cost model of the compiled step when available;
+    # analytic 6*P*T estimate otherwise
+    flops = None
+    try:
+        sub = ex.subexecutor["train"]
+        fn = next(iter(sub._compiled.values()))
+        feeds_np = {(k.name if hasattr(k, "name") else k): np.asarray(v)
+                    for k, v in feed.items()}
+        lowered = fn.lower(ex.var_values, ex.opt_states, ex.step, ex.rng,
+                           feeds_np)
+        ca = lowered.compile().cost_analysis()
+        if ca and ca.get("flops", 0) > 0:
+            flops = float(ca["flops"])
+    except Exception:
+        flops = None
+    if flops is None:
+        n_params = sum(int(np.prod(v.shape)) for v in ex.var_values.values())
+        flops = 6.0 * n_params * (batch * seq)  # fwd+bwd matmul estimate
+
+    kind = jax.devices()[0].device_kind
+    peak = _peak_tflops(kind) if platform not in ("cpu", "cpu-fallback") \
+        else None
+    tflops_chip = flops / dt / n_chips / 1e12
+    mfu = round(tflops_chip / peak, 4) if peak else None
+
+    return {
+        "samples_per_sec_chip": batch / dt / n_chips,
+        "step_time_ms": round(dt * 1e3, 3),
+        "tflops_per_sec_chip": round(tflops_chip, 2),
+        "mfu": mfu,
+        "host_fraction": round(t_host / (dt * iters), 4),
+        "device_kind": kind,
+        "n_chips": n_chips,
+        "flash_attention": use_flash,
+    }
+
+
+def main():
+    platform, bringup_err = _bring_up_backend()
+
+    # flash is the TPU path; in interpret mode (CPU fallback) it is
+    # orders-of-magnitude slower than the fused XLA chain, so don't bench it
+    # there except at verification scale
+    want_flash = platform == "tpu" or bool(os.environ.get("HETU_BENCH_SMALL"))
+    stats, flash_err = None, None
+    if want_flash:
+        try:
+            stats = _run_once(use_flash=True, platform=platform)
+        except Exception as e:  # Pallas kernel may fail on an untested chip
+            flash_err = f"{type(e).__name__}: {e}"[:300]
+    if stats is None:
+        stats = _run_once(use_flash=False, platform=platform)
+
+    # target: BASELINE.json north star for this 4-layer proxy — no
+    # published reference numbers exist (BASELINE.md), so the target is the
+    # driver-defined 100 samples/sec/chip; vs_baseline tracks rounds.
     target = 100.0
-    print(json.dumps({
+    out = {
         "metric": "bert4L_seq128_train_throughput",
-        "value": round(samples_per_sec_chip, 2),
+        "value": round(stats.pop("samples_per_sec_chip"), 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(samples_per_sec_chip / target, 3),
-    }))
+        "vs_baseline": None,
+        "platform": platform,
+        **stats,
+    }
+    out["vs_baseline"] = round(out["value"] / target, 3)
+    if bringup_err:
+        out["bringup_retried"] = bringup_err
+    if flash_err:
+        out["flash_fallback"] = flash_err
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

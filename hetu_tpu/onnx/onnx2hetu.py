@@ -26,12 +26,28 @@ def _attrs(node):
     return {a.name: attr_value(a) for a in node.attribute}
 
 
+# our exporter (hetu2onnx) names constant-folded initializers with these
+# prefixes (iota tables, eps scalars, shape/slice index vectors, folded
+# subgraphs); they are NOT parameters and must not be trained on re-import
+_FOLDED_PREFIXES = ("const_", "fold_", "iota_", "cc_")
+
+
 class _Importer:
-    def __init__(self, graph):
+    def __init__(self, graph, trainable_names=None):
         self.graph = graph
         self.values = {}     # onnx name -> Op node
         self.consts = {}     # onnx name -> np.ndarray (initializers)
         self.placeholders = {}
+        # None = heuristic (float and not a folded-constant name);
+        # otherwise an explicit allowlist of initializer names to train
+        self.trainable_names = (set(trainable_names)
+                                if trainable_names is not None else None)
+
+    def _is_trainable(self, name, arr):
+        if self.trainable_names is not None:
+            return name in self.trainable_names
+        return (np.issubdtype(arr.dtype, np.floating)
+                and not name.startswith(_FOLDED_PREFIXES))
 
     def const(self, name):
         return self.consts.get(name)
@@ -42,8 +58,7 @@ class _Importer:
         if name in self.consts:
             arr = self.consts[name]
             v = ops_misc.Variable(f"onnx_{name}", value=arr,
-                                  trainable=np.issubdtype(
-                                      arr.dtype, np.floating))
+                                  trainable=self._is_trainable(name, arr))
             self.values[name] = v
             return v
         raise KeyError(f"onnx value '{name}' is not defined yet")
@@ -339,11 +354,14 @@ def _expand(imp, node, attrs):
     shape = [int(s) for s in shape]
 
     def f(x):
-        tgt = [x.shape[i - (len(shape) - x.ndim)] if s == 1 and
-               i >= len(shape) - x.ndim and
-               x.shape[i - (len(shape) - x.ndim)] != 1 else s
-               for i, s in enumerate(shape)]
-        return jnp.broadcast_to(x, tgt)
+        # ONNX Expand is bidirectional broadcast: the shape tensor may have
+        # lower rank than the input, so left-pad both to a common rank with
+        # 1s before resolving dims (a target dim of 1 keeps the input dim)
+        rank = max(len(shape), x.ndim)
+        tshape = [1] * (rank - len(shape)) + list(shape)
+        xshape = (1,) * (rank - x.ndim) + x.shape
+        tgt = [xs if s == 1 else s for s, xs in zip(tshape, xshape)]
+        return jnp.broadcast_to(jnp.reshape(x, xshape), tgt)
     return _simple("Expand", f, _in(imp, node, 0))
 
 
@@ -539,13 +557,16 @@ def _shape(imp, node, attrs):
 
 # --------------------------------------------------------------- entry
 
-def load_onnx(path):
+def load_onnx(path, trainable_names=None):
     """Parse an .onnx file -> (output nodes, placeholders, weights).
 
     Mirrors reference onnx2hetu.load_onnx returning executor-ready graph
-    nodes (onnx2hetu.py)."""
+    nodes (onnx2hetu.py).  ``trainable_names`` optionally restricts which
+    initializers import as trainable Variables; by default all float
+    initializers except exporter-folded constants (const_/fold_/iota_/cc_
+    names) are trainable."""
     model = load_model(path)
-    imp = _Importer(model.graph)
+    imp = _Importer(model.graph, trainable_names=trainable_names)
     outputs = imp.run()
     weights = {f"onnx_{k}": v for k, v in imp.consts.items()}
     return outputs, imp.placeholders, weights

@@ -68,13 +68,25 @@ class PartialReduce:
         deadline = time.time() + timeout
         try:
             while time.time() < deadline:
-                n = float(np.asarray(self.client.pull(count_key))[0])
+                try:
+                    n = float(np.asarray(self.client.pull(count_key))[0])
+                except (KeyError, RuntimeError):
+                    # a faster member timed out and cleared the scratch
+                    # keys: this round is abandoned for everyone
+                    raise TimeoutError(
+                        "preduce: round abandoned (scratch keys cleared "
+                        "by a timed-out member)")
                 if n >= len(partner):
                     break
                 time.sleep(0.005)
             else:
                 raise TimeoutError("preduce: group members missing")
-            total = np.asarray(self.client.pull(key))
+            try:
+                total = np.asarray(self.client.pull(key))
+            except (KeyError, RuntimeError):
+                raise TimeoutError(
+                    "preduce: round abandoned (scratch keys cleared by a "
+                    "timed-out member)")
         except TimeoutError:
             # best-effort cleanup so incomplete rounds don't leak arrays
             # on the PS (other members hitting the same timeout race to
@@ -87,7 +99,12 @@ class PartialReduce:
         self.client.push(count_key, np.ones(1, np.float32))
         if min(partner) == self.client.rank:
             while time.time() < deadline:
-                n = float(np.asarray(self.client.pull(count_key))[0])
+                try:
+                    n = float(np.asarray(self.client.pull(count_key))[0])
+                except (KeyError, RuntimeError):
+                    # a slower member timed out and already cleared the
+                    # scratch keys; our mean is in hand — nothing to do
+                    break
                 if n >= 2 * len(partner):
                     self.client.clear(key)
                     self.client.clear(count_key)

@@ -21,9 +21,10 @@ from ..parallel.distributed_strategies import BaseSearchingStrategy
 class AutoParallel(BaseSearchingStrategy):
     """Executor dist_strategy driven by a planner result.
 
-    ``layer_of(name)`` maps a variable name to a layer index (default: the
-    first integer in the name, the `l{i}_` convention used across
-    hetu_tpu.models).  Column/row split patterns follow ModelParallel4LM.
+    ``layer_of(name)`` maps a variable name to a layer index (default:
+    matches the `l{i}_` and `_layer{i}_` conventions used across
+    hetu_tpu.models; unmatched names fall back to strategies[0]).
+    Column/row split patterns follow ModelParallel4LM.
     """
 
     def __init__(self, plan, layer_of=None,
@@ -38,7 +39,11 @@ class AutoParallel(BaseSearchingStrategy):
 
     @staticmethod
     def _default_layer_of(name):
-        m = re.search(r"(\d+)", name)
+        # anchored to the `l{i}_` / `_layer{i}_` layer-name conventions
+        # used across hetu_tpu.models — a bare digit inside e.g.
+        # 'fc1'/'wi2' is a sublayer index, not a layer index, and must
+        # not match
+        m = re.search(r"(?:^|[._])l(?:ayer)?(\d+)(?:[._]|$)", name)
         return int(m.group(1)) if m else None
 
     def _strategy_for(self, name):

@@ -87,7 +87,9 @@ class TestCheck:
     def test_scan_bodies_walked(self, mesh):
         def body(x):
             def tick(c, _):
-                return jax.lax.psum(c, "dp"), None
+                # psum output is axis-invariant; pvary restores the carry's
+                # varying-axes type so scan's carry typing is stable
+                return jax.lax.pvary(jax.lax.psum(c, "dp"), "dp"), None
             out, _ = jax.lax.scan(tick, x, jnp.arange(3))
             return out
 
