@@ -161,24 +161,14 @@ def _run_once(use_flash, platform):
     float(np.asarray(out[0]))
     dt = (time.perf_counter() - t0) / iters
 
-    # FLOPs from the XLA cost model of the compiled step when available;
-    # analytic 6*P*T estimate otherwise
-    flops = None
-    try:
-        sub = ex.subexecutor["train"]
-        fn = next(iter(sub._compiled.values()))
-        feeds_np = {(k.name if hasattr(k, "name") else k): np.asarray(v)
-                    for k, v in feed.items()}
-        lowered = fn.lower(ex.var_values, ex.opt_states, ex.step, ex.rng,
-                           feeds_np)
-        ca = lowered.compile().cost_analysis()
-        if ca and ca.get("flops", 0) > 0:
-            flops = float(ca["flops"])
-    except Exception:
-        flops = None
-    if flops is None:
-        n_params = sum(int(np.prod(v.shape)) for v in ex.var_values.values())
-        flops = 6.0 * n_params * (batch * seq)  # fwd+bwd matmul estimate
+    # Analytic FLOPs (XLA cost_analysis would require re-lowering and
+    # RE-COMPILING the whole step just to read a number — minutes on TPU).
+    # 6*P*T covers the parameter matmuls fwd+bwd; the attention
+    # score/context matmuls add 12*B*S^2*H per layer (2*2*B*S^2*H fwd, x3
+    # with bwd).
+    n_params = sum(int(np.prod(v.shape)) for v in ex.var_values.values())
+    flops = 6.0 * n_params * (batch * seq) \
+        + layers_n * 12.0 * batch * seq * seq * hidden
 
     kind = jax.devices()[0].device_kind
     peak = _peak_tflops(kind) if platform not in ("cpu", "cpu-fallback") \

@@ -104,6 +104,10 @@ def main():
                     y_true = y_true[:, 1]
                 msg = " auc=%.4f" % ht.metrics.auc_score(
                     y_score.reshape(-1), y_true.reshape(-1))
+            if executor.cstables:
+                perf = executor.ps_perf_summary()
+                hr = np.mean([p["hit_rate"] for p in perf.values()])
+                msg += " cache_hit=%.3f" % hr
             logger.info("step %d loss=%.4f (%.1f samples/s)%s", step,
                         float(np.asarray(out[0]).reshape(-1)[0]),
                         (step + 1) * args.batch_size / dt, msg)

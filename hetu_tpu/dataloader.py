@@ -81,6 +81,20 @@ class Dataloader:
             self.seq = rng.permutation(self.samples_num)
 
     def get_arr(self):
+        if getattr(self, "_peeked", None) is not None:
+            batch, self._peeked = self._peeked, None
+            return batch
+        return self._next_batch()
+
+    def peek_arr(self):
+        """The batch the next get_arr() will return, without consuming it
+        (the executor's PS-embedding prefetch looks ahead one batch,
+        reference dataloader.py ring lookahead)."""
+        if getattr(self, "_peeked", None) is None:
+            self._peeked = self._next_batch()
+        return self._peeked
+
+    def _next_batch(self):
         self.init_states()
         remaining = self.samples_num - self.index
         if remaining < self.batch_size and not (
@@ -130,6 +144,9 @@ class DataloaderOp(Op):
 
     def get_arr(self, name):
         return self.dataloaders[name].get_arr()
+
+    def peek_arr(self, name):
+        return self.dataloaders[name].peek_arr()
 
     def get_cur_shape(self, name):
         self.dataloaders[name].init_states()

@@ -55,9 +55,31 @@ class _ExtraOutputs(dict):
 
 
 class HetuConfig:
-    """Runtime config (reference executor.py:134-211 slot list).  Most
-    reference knobs exist for API parity; stream/overlap knobs are no-ops
-    under XLA and documented as such."""
+    """Runtime config (reference executor.py:134-211 slot list).
+
+    Knob semantics here:
+      comm_mode      None/'AllReduce' = pure jit path (gradient reduction
+                     comes from shardings); 'Hybrid' = embedding tables
+                     live on the PS (with the HET cache when
+                     cstable_policy is set) while dense grads stay on
+                     device; 'PS' = dense params also round-trip the PS
+                     with server-side optimizers.
+      cstable_policy 'LRU'/'LFU'/'LFUOpt' — cache-enabled embedding path.
+      cache_bound    cache capacity in rows per embedding table.
+      bsp            -1 async, 0 per-step barrier, >0 SSP staleness bound
+                     (multi-worker PS training).
+      prefetch       overlap next batch's PS embedding lookup with the
+                     current step (dataloader-fed ids only).
+      use_sparse_pull sparse row pull vs full-table pull in PS mode.
+      enable_lazy / overlap / use_nccl_collectives — no-ops by design:
+                     everything is lazily traced into one jitted program,
+                     XLA overlaps collectives, and collectives are always
+                     XLA's (documented, accepted for API parity).
+      pipeline / use_preduce — raise until wired; see
+                     parallel.pipeline.PipelineTrainer and
+                     parallel.preduce.PartialReduce for the standalone
+                     implementations.
+    """
 
     def __init__(self, eval_node_list=None, train_name=None, val_name=None,
                  comm_mode=None, use_sparse_pull=True, cstable_policy=None,
@@ -66,9 +88,15 @@ class HetuConfig:
                  pipeline=None, overlap=True, use_preduce=False,
                  use_nccl_collectives=True, seed=0, mesh=None,
                  num_microbatches=None, dtype=jnp.float32,
-                 mixed_precision=None):
+                 mixed_precision=None, ps_comm=None):
+        if comm_mode not in (None, "AllReduce", "PS", "Hybrid"):
+            raise ValueError(f"comm_mode must be None/'AllReduce'/'PS'/"
+                             f"'Hybrid', got {comm_mode!r}")
         self.comm_mode = comm_mode
         self.use_sparse_pull = use_sparse_pull
+        if cstable_policy is not None and comm_mode not in ("PS", "Hybrid"):
+            raise ValueError("cstable_policy requires comm_mode='PS' or "
+                             "'Hybrid' (the cache fronts the PS)")
         self.cstable_policy = cstable_policy
         self.bsp = bsp
         self.prefetch = prefetch
@@ -76,8 +104,14 @@ class HetuConfig:
         self.cache_bound = cache_bound
         self.log_path = log_path
         self.dist_strategy = dist_strategy
+        if pipeline not in (None, "gpipe", "pipedream", "hetpipe"):
+            raise ValueError(f"unknown pipeline mode {pipeline!r}")
         self.pipeline = pipeline
         self.overlap = overlap
+        if use_preduce:
+            raise NotImplementedError(
+                "use_preduce: drive parallel.preduce.PartialReduce "
+                "directly (host-coordinated subgroup mean over the PS)")
         self.use_preduce = use_preduce
         self.use_nccl_collectives = use_nccl_collectives
         self.seed = seed
@@ -92,7 +126,7 @@ class HetuConfig:
         elif mixed_precision in ("fp16", "float16"):
             mixed_precision = jnp.float16
         self.mixed_precision = mixed_precision
-        self.ps_comm = None
+        self.ps_comm = ps_comm
 
 
 class SubExecutor:
@@ -123,6 +157,30 @@ class SubExecutor:
                 cons = consumers.get(id(n), [])
                 if cons and all(isinstance(c, OptimizerOp) for c in cons):
                     self.skip_dense.add(id(n))
+        # PS-managed embedding lookups: their rows are gathered host-side
+        # (from the PS / HET cache) before the jitted step and fed in; the
+        # table itself never materializes on device
+        from .graph.ops_embed import EmbeddingLookupOp
+        self.ps_lookups = []     # EmbeddingLookupOp nodes on PS tables
+        self.ps_var_names = frozenset(executor.ps_sparse_vars) \
+            | frozenset(executor.ps_dense_vars)
+        if executor.ps_sparse_vars:
+            for n in self.topo:
+                if isinstance(n, EmbeddingLookupOp) and \
+                        n.inputs[0].name in executor.ps_sparse_vars:
+                    src = n.inputs[1]
+                    from .dataloader import DataloaderOp
+                    if not (isinstance(src, DataloaderOp) or
+                            (isinstance(src, PlaceholderOp)
+                             and not src.is_variable)):
+                        raise NotImplementedError(
+                            f"PS embedding lookup ids must come straight "
+                            f"from a feed or dataloader (got "
+                            f"{type(src).__name__} feeding {n.name}); the "
+                            f"host gather needs concrete ids pre-step")
+                    self.ps_lookups.append(n)
+        self._ps_lookup_ids = set(id(n) for n in self.ps_lookups)
+        self._prefetched = {}    # lookup node name -> (ids, Future)
         self._compiled = {}
 
     # ------------------------------------------------------------------ #
@@ -134,6 +192,7 @@ class SubExecutor:
         tc.extra_outputs = _ExtraOutputs()
         vals = {}
         new_opt_states = dict(opt_states)
+        side_outputs = {}
         mp = self.executor.config.mixed_precision
 
         def _cast_in(v):
@@ -146,10 +205,15 @@ class SubExecutor:
 
         from .dataloader import DataloaderOp
         for node in self.topo:
-            if isinstance(node, DataloaderOp):
+            if id(node) in self._ps_lookup_ids:
+                # PS-managed embedding: rows pre-gathered host-side
+                vals[id(node)] = _cast_in(feeds["__psrows__" + node.name])
+            elif isinstance(node, DataloaderOp):
                 vals[id(node)] = _cast_in(feeds[node.name])
             elif isinstance(node, PlaceholderOp):
-                if node.name in params:
+                if node.name in self.executor.ps_sparse_vars:
+                    vals[id(node)] = None  # table lives on the PS
+                elif node.name in params:
                     vals[id(node)] = _cast_in(params[node.name])
                 else:
                     vals[id(node)] = _cast_in(feeds[node.name])
@@ -162,7 +226,8 @@ class SubExecutor:
                     else:
                         grad_vals.append(vals[id(g)])
                 new_opt_states[node.name] = node.apply(
-                    grad_vals, tc, opt_states[node.name])
+                    grad_vals, tc, opt_states[node.name],
+                    ps_vars=self.ps_var_names, side_outputs=side_outputs)
                 vals[id(node)] = None
             elif id(node) in self.skip_dense:
                 vals[id(node)] = None
@@ -183,18 +248,18 @@ class SubExecutor:
                 # must not narrow the fp32 master copy
                 v = v.astype(params[k].dtype)
             new_params[k] = v
-        return new_params, new_opt_states, outputs
+        return new_params, new_opt_states, outputs, side_outputs
 
     def _compile(self, feed_sig):
         ex = self.executor
 
         def step_fn(params, opt_states, step, rng, feeds):
-            new_params, new_opt, outputs = self._trace(
+            new_params, new_opt, outputs, side = self._trace(
                 params, opt_states, step, rng, feeds)
             # only optimizer steps advance the counter — eval passes must
             # not skew Adam bias correction / LR schedules
             new_step = step + 1 if self.training else step
-            return new_params, new_opt, new_step, outputs
+            return new_params, new_opt, new_step, outputs, side
 
         jit_kwargs = dict(donate_argnums=(0, 1))
         if ex.mesh is not None:
@@ -208,7 +273,7 @@ class SubExecutor:
             # pin updated params/opt states to their input shardings —
             # otherwise GSPMD may pick a different output layout and the
             # next step's in_shardings check fails
-            jit_kwargs["out_shardings"] = (param_sh, opt_sh, rep, None)
+            jit_kwargs["out_shardings"] = (param_sh, opt_sh, rep, None, None)
         return jax.jit(step_fn, **jit_kwargs)
 
     @property
@@ -236,6 +301,7 @@ class SubExecutor:
             if arr.dtype == np.int64:
                 arr = arr.astype(np.int32)
             feeds[name] = arr
+        ps_ids = self._ps_phase_a(feeds)
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feeds.items()))
         if feed_sig not in self._compiled:
@@ -244,8 +310,11 @@ class SubExecutor:
         if ex.mesh is not None:
             feeds = {k: ex.device_put_feed(k, v) for k, v in feeds.items()}
         ex.rng, sub = jax.random.split(ex.rng)
-        ex.var_values, ex.opt_states, ex.step, outputs = fn(
+        ex.var_values, ex.opt_states, ex.step, outputs, side = fn(
             ex.var_values, ex.opt_states, ex.step, sub, feeds)
+        if self.ps_var_names and self.training:
+            self._ps_phase_b(side, ps_ids)
+        self._ps_prefetch()
         results = []
         for n, o in zip(self.eval_nodes, outputs):
             if o is None:
@@ -255,6 +324,72 @@ class SubExecutor:
             else:
                 results.append(o)
         return results
+
+    # ------------------------------------------------------------------ #
+    # Hybrid/PS host phases (reference ParameterServerCommunicate.py:38-57
+    # push-pull compute, :193-204 prefetch; executor.py:253-258 cache
+    # wiring).  Phase A gathers embedding rows for the batch from the PS /
+    # HET cache; phase B pushes the step's grads back; prefetch overlaps
+    # the NEXT batch's lookup with everything after dispatch.
+    # ------------------------------------------------------------------ #
+
+    def _ps_phase_a(self, feeds):
+        """Gather rows for every PS-managed lookup; returns {var: ids}."""
+        ex = self.executor
+        ps_ids = {}
+        for lk in self.ps_lookups:
+            var_name = lk.inputs[0].name
+            src = lk.inputs[1]
+            ids = np.asarray(feeds[src.name])
+            pre = self._prefetched.pop(lk.name, None)
+            if pre is not None and np.array_equal(pre[0], ids):
+                rows = pre[1].result()
+            else:
+                rows = ex.ps_lookup(var_name, ids)
+            feeds["__psrows__" + lk.name] = np.asarray(rows, np.float32)
+            ps_ids[var_name] = ids
+        # dense-PS params ('PS' mode): refresh from the server so other
+        # workers' pushes are visible (BSP/SSP pacing via config.bsp)
+        for name in ex.ps_dense_vars:
+            if ex.ps_dense_dirty.pop(name, False):
+                val = ex.ps_comm.pull(name)
+                arr = jnp.asarray(val)
+                if ex.mesh is not None:
+                    arr = jax.device_put(arr, ex.param_sharding(name))
+                ex.var_values[name] = arr
+        return ps_ids
+
+    def _ps_phase_b(self, side, ps_ids):
+        """Push grads: sparse rows -> cache/PS, dense grads -> PS."""
+        ex = self.executor
+        for var_name, g in side.items():
+            g = np.asarray(g, np.float32)
+            if var_name in ex.ps_sparse_vars:
+                ex.ps_update(var_name, ps_ids[var_name], g)
+            else:
+                ex.ps_comm.push(var_name, g)
+                ex.ps_dense_dirty[var_name] = True
+        ex.ps_step_sync()
+
+    def _ps_prefetch(self):
+        """Overlap the next batch's embedding lookup (dataloader ids only:
+        the next feed is peekable without advancing the loader)."""
+        ex = self.executor
+        if not ex.config.prefetch or not self.ps_lookups:
+            return
+        from .dataloader import DataloaderOp
+        for lk in self.ps_lookups:
+            src = lk.inputs[1]
+            if not isinstance(src, DataloaderOp):
+                continue
+            try:
+                ids = np.asarray(src.peek_arr(self.name))
+            except Exception:
+                continue
+            var_name = lk.inputs[0].name
+            fut = ex.ps_lookup_async(var_name, ids)
+            if fut is not None:
+                self._prefetched[lk.name] = (ids, fut)
 
 
 def _opt_sharding_like(ex, opt_states):
@@ -275,6 +410,11 @@ class Executor:
             eval_node_dict = {"default": eval_node_dict}
         self.eval_node_dict = eval_node_dict
         self.config = config if config is not None else HetuConfig(**kargs)
+        if self.config.pipeline is not None:
+            raise NotImplementedError(
+                "Executor(pipeline=...) lands with the graph partitioner; "
+                "until then drive parallel.pipeline.PipelineTrainer / "
+                "spmd_pipeline directly")
         self.mesh = self.config.mesh
         self.rng = jax.random.PRNGKey(self.config.seed)
         self.step = jnp.zeros((), jnp.int32)
@@ -298,8 +438,24 @@ class Executor:
             self.config.dist_strategy.configure(self)
             self.mesh = self.config.mesh
 
+        # Hybrid/PS comm modes: embedding tables move to the PS (with the
+        # HET cache when cstable_policy is set); in 'PS' mode dense params
+        # are server-optimized too.  Must run before device init so the
+        # big tables never materialize in HBM.
+        self.ps_comm = None
+        self.ps_sparse_vars = {}
+        self.ps_dense_vars = {}
+        self.ps_dense_dirty = {}
+        self.cstables = {}
+        self.ps_var_opt = {}
+        self._ps_opt_specs = {}
+        self._ssp_inited = False
+        if self.config.comm_mode in ("PS", "Hybrid"):
+            self._setup_ps(all_nodes)
+
         self.var_values = {name: n.init_value(self.config.seed)
-                           for name, n in self.variables.items()}
+                           for name, n in self.variables.items()
+                           if name not in self.ps_sparse_vars}
         if self.mesh is not None:
             self.var_values = {
                 k: jax.device_put(v, self.param_sharding(k))
@@ -313,7 +469,166 @@ class Executor:
             for opt_op in sub.optimizer_ops:
                 if opt_op.name not in self.opt_states:
                     self.opt_states[opt_op.name] = opt_op.init_state(
-                        _ParamView(self.var_values))
+                        _ParamView(self.var_values),
+                        skip=sub.ps_var_names)
+
+    # ------------------------------------------------------------------ #
+    # Hybrid/PS setup + host-side embedding API
+    # (reference executor.py:253-258 cache wiring, optimizer.py:145-164
+    # comm-mode routing, ParameterServerCommunicate.py push-pull)
+    # ------------------------------------------------------------------ #
+
+    def _setup_ps(self, all_nodes):
+        from .ps.client import PSClient
+        from .graph.ops_embed import EmbeddingLookupOp, IndexedSlicesOp
+        from .optimizer import SGDOptimizer
+
+        cfg = self.config
+        self.ps_comm = cfg.ps_comm or PSClient.get()
+        cfg.ps_comm = self.ps_comm
+
+        consumers = {}
+        for n in all_nodes:
+            for i in n.inputs:
+                consumers.setdefault(id(i), []).append(n)
+        for op in all_nodes:
+            if isinstance(op, OptimizerOp):
+                for v in op.var_list:
+                    self.ps_var_opt[v.name] = op.optimizer
+
+        for name, node in self.variables.items():
+            if not node.trainable:
+                continue
+            cons = consumers.get(id(node), [])
+            # a table can live on the PS iff its device value is only ever
+            # needed row-wise: lookups and sparse adjoints.  Exactly ONE
+            # lookup: with two, autodiff sums the IndexedSlices adjoints
+            # through a dense SumOp (needs the device table) and the
+            # id<->grad pairing per lookup is lost — multi-lookup tables
+            # stay on device (Hybrid) / go dense-PS ('PS' mode).
+            n_lookups = sum(1 for c in cons
+                            if isinstance(c, EmbeddingLookupOp)
+                            and c.inputs[0] is node)
+            sparse_ok = getattr(node, "is_embed", False) and \
+                n_lookups == 1 and all(
+                (isinstance(c, (EmbeddingLookupOp, IndexedSlicesOp))
+                 and c.inputs[0] is node) or isinstance(c, OptimizerOp)
+                for c in cons)
+            if sparse_ok:
+                self.ps_sparse_vars[name] = node
+            elif cfg.comm_mode == "PS":
+                self.ps_dense_vars[name] = node
+
+        def _spec_for(name, opt):
+            if opt is None:
+                return None
+            if getattr(opt, "l2reg", 0.0):
+                raise NotImplementedError(
+                    f"l2reg on PS-managed var '{name}': the server applies "
+                    f"the update and has no l2 term")
+            spec = opt.server_opt_spec()
+            if spec is None:
+                raise NotImplementedError(
+                    f"{type(opt).__name__} (or an LR schedule) has no PS "
+                    f"server-side counterpart for var '{name}'; use the "
+                    f"cache path (cstable_policy) or SGD/Momentum/"
+                    f"AdaGrad/Adam with a scalar LR")
+            return spec
+
+        for name, node in self.ps_sparse_vars.items():
+            val = np.asarray(node.init_value(cfg.seed), np.float32)
+            opt = self.ps_var_opt.get(name)
+            if cfg.cstable_policy:
+                # HET cache: the worker applies SGD scaling locally and the
+                # server raw-accumulates the pushed deltas (hetu_cache
+                # write-back semantics) — other optimizers would need their
+                # slot state inside every cache line
+                if opt is not None and (type(opt) is not SGDOptimizer
+                                        or opt.l2reg
+                                        or hasattr(opt.learning_rate,
+                                                   "value")):
+                    raise NotImplementedError(
+                        "the HET cache path accumulates -lr*grad deltas; "
+                        "only plain SGD with a scalar LR is supported on "
+                        "cached embeddings (reference hetu_cache ditto)")
+                self.ps_comm.param_set(name, val)
+                self._ps_opt_specs[name] = None
+                from .cache.cstable import CacheSparseTable
+                self.cstables[name] = CacheSparseTable(
+                    cfg.cache_bound, val.shape[0], val.shape[1], key=name,
+                    comm=self.ps_comm, policy=cfg.cstable_policy)
+            else:
+                spec = _spec_for(name, opt)
+                self._ps_opt_specs[name] = spec
+                self.ps_comm.param_set(
+                    name, val, opt=spec and spec[0],
+                    opt_args=spec and spec[1])
+
+        for name, node in self.ps_dense_vars.items():
+            val = np.asarray(node.init_value(cfg.seed), np.float32)
+            spec = _spec_for(name, self.ps_var_opt.get(name))
+            self._ps_opt_specs[name] = spec
+            self.ps_comm.param_set(name, val, opt=spec and spec[0],
+                                   opt_args=spec and spec[1])
+
+    def ps_lookup(self, name, ids):
+        """Rows for `ids` from the HET cache or the PS (phase A)."""
+        ids = np.asarray(ids)
+        ct = self.cstables.get(name)
+        if ct is not None:
+            return ct.embedding_lookup(ids)
+        if self.config.use_sparse_pull:
+            flat = ids.reshape(-1).astype(np.int64)
+            uniq, inv = np.unique(flat, return_inverse=True)
+            rows = np.asarray(self.ps_comm.sparse_pull(name, uniq),
+                              np.float32)
+            return rows[inv].reshape(*ids.shape, rows.shape[-1])
+        table = np.asarray(self.ps_comm.pull(name), np.float32)
+        return table[ids.reshape(-1)].reshape(*ids.shape, table.shape[-1])
+
+    def ps_lookup_async(self, name, ids):
+        ct = self.cstables.get(name)
+        if ct is not None:
+            return ct.embedding_lookup_async(ids)
+        pool = getattr(self.ps_comm, "_pool", None)
+        if pool is None:
+            return None
+        return pool.submit(self.ps_lookup, name, ids)
+
+    def ps_update(self, name, ids, rows):
+        """Push one step's embedding grads (phase B).  Cache path: the
+        worker scales to -lr*grad deltas (write-back accumulate); direct
+        path: raw grads, the server optimizer applies the update."""
+        rows = np.asarray(rows, np.float32)
+        rows = rows.reshape(-1, rows.shape[-1])
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        ct = self.cstables.get(name)
+        if ct is not None:
+            opt = self.ps_var_opt[name]
+            # the device step already advanced self.step; the update being
+            # pushed used the pre-increment step's LR
+            lr = float(np.asarray(opt.lr_value(
+                jnp.asarray(max(int(self.step) - 1, 0), jnp.int32))))
+            ct.embedding_update(flat, -lr * rows)
+        else:
+            self.ps_comm.sparse_push(name, flat, rows)
+
+    def ps_step_sync(self):
+        """BSP/SSP pacing after each training step (config.bsp)."""
+        bsp = self.config.bsp
+        if self.ps_comm is None or bsp is None or bsp < 0:
+            return
+        if bsp == 0:
+            self.ps_comm.BarrierWorker()
+        else:
+            if not self._ssp_inited:
+                self.ps_comm.ssp_init(0, bsp)
+                self._ssp_inited = True
+            self.ps_comm.ssp_sync(0)
+
+    def ps_perf_summary(self):
+        """Cache counters per table (reference cstable perf counters)."""
+        return {name: ct.perf_summary() for name, ct in self.cstables.items()}
 
     # ------------------------------------------------------------------ #
     # sharding helpers
@@ -355,8 +670,20 @@ class Executor:
     def save(self, path, file=None, varlist=None):
         os.makedirs(path, exist_ok=True)
         fname = os.path.join(path, file or "checkpoint.pkl")
-        params = {k: np.asarray(v) for k, v in self.var_values.items()
+        # copy=True: np.asarray over jax CPU arrays is zero-copy and the
+        # buffers are donated to the next step — a view would rot
+        params = {k: np.array(v, copy=True)
+                  for k, v in self.var_values.items()
                   if varlist is None or k in varlist}
+        # PS-managed vars: the server (after a cache flush) is the source
+        # of truth, not the device copy
+        for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
+            if varlist is not None and name not in varlist:
+                continue
+            ct = self.cstables.get(name)
+            if ct is not None:
+                ct.flush()
+            params[name] = np.asarray(self.ps_comm.pull(name))
         opt = jax.tree_util.tree_map(lambda x: np.asarray(x), self.opt_states)
         with open(fname, "wb") as f:
             pickle.dump({"params": params, "opt_states": opt,
@@ -394,7 +721,29 @@ class Executor:
             self.rng = jnp.asarray(ckpt["rng"], jnp.uint32)
 
     def load_dict(self, state_dict):
+        from .cache.cstable import CacheSparseTable
         for k, v in state_dict.items():
+            if k in self.ps_sparse_vars or k in self.ps_dense_vars:
+                spec = self._ps_opt_specs.get(k)
+                self.ps_comm.param_set(k, np.asarray(v, np.float32),
+                                       opt=spec and spec[0],
+                                       opt_args=spec and spec[1])
+                ct = self.cstables.get(k)
+                if ct is not None:
+                    # drop cached lines; they refer to pre-load values
+                    self.cstables[k] = CacheSparseTable(
+                        ct.cache.limit if hasattr(ct.cache, "limit")
+                        else self.config.cache_bound,
+                        ct.vocab, ct.width, key=k, comm=self.ps_comm,
+                        policy=self.config.cstable_policy,
+                        pull_bound=ct.pull_bound, push_bound=ct.push_bound)
+                if k in self.ps_dense_vars:
+                    arr = jnp.asarray(v)
+                    if self.mesh is not None:
+                        arr = jax.device_put(arr, self.param_sharding(k))
+                    self.var_values[k] = arr
+                    self.ps_dense_dirty.pop(k, None)
+                continue
             if k in self.var_values:
                 arr = jnp.asarray(v)
                 if self.mesh is not None:
@@ -405,7 +754,17 @@ class Executor:
         self.rng = jax.random.PRNGKey(seed)
 
     def return_tensor_values(self):
-        return {k: np.asarray(v) for k, v in self.var_values.items()}
+        # copies, not views: the underlying buffers are donated next step
+        out = {k: np.array(v, copy=True)
+               for k, v in self.var_values.items()}
+        # PS-managed vars: the server (post cache-flush) is authoritative;
+        # the device copy of a dense-PS var lags by one step
+        for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
+            ct = self.cstables.get(name)
+            if ct is not None:
+                ct.flush()
+            out[name] = np.asarray(self.ps_comm.pull(name))
+        return out
 
     def profile(self, feed_shapes=None, log_file=None, profiler="gpu"):
         from .profiler import HetuProfiler

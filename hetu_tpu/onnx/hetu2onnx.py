@@ -393,6 +393,12 @@ def export(executor, inputs, outputs, path, name="hetu_tpu",
     from ..graph.node import TraceContext, Op
 
     in_names = [n.name if isinstance(n, Op) else n for n in inputs]
+    if getattr(executor, "ps_sparse_vars", None) or \
+            getattr(executor, "ps_dense_vars", None):
+        raise NotImplementedError(
+            "ONNX export of a PS/Hybrid executor: embedding tables live "
+            "on the parameter server; rebuild the graph with a dense "
+            "executor (load weights via executor.return_tensor_values())")
     sub = SubExecutor("__onnx__", list(outputs), executor)
     assert not sub.training, "export expects an inference subgraph"
 
@@ -411,7 +417,7 @@ def export(executor, inputs, outputs, path, name="hetu_tpu",
     params = {k: np.asarray(v) for k, v in executor.var_values.items()}
 
     def fwd(feeds):
-        _, _, outs = sub._trace(executor.var_values, executor.opt_states,
+        _, _, outs, _ = sub._trace(executor.var_values, executor.opt_states,
                                 0, None, feeds)
         return outs
 

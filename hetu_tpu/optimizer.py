@@ -99,6 +99,14 @@ class Optimizer:
             return g + self.l2reg * p
         return g
 
+    def server_opt_spec(self):
+        """(name, kwargs) of the matching PS server-side optimizer
+        (ps/server.py SERVER_OPTIMIZERS), or None when no server
+        counterpart exists (AdamW/Lamb).  Used by the executor's PS/Hybrid
+        comm modes: the worker pushes raw grads and the server applies this
+        optimizer (reference server/optimizer.h:36-275 semantics)."""
+        return None
+
 
 class SGDOptimizer(Optimizer):
     """reference optimizer.py:171."""
@@ -112,6 +120,11 @@ class SGDOptimizer(Optimizer):
         if self.l2reg > 0:
             rows = rows + self.l2reg * p[ids]
         return p.at[ids].set(p[ids] - lr * rows), s
+
+    def server_opt_spec(self):
+        if hasattr(self.learning_rate, "value"):   # schedules stay local
+            return None
+        return "sgd", {"learning_rate": float(self.learning_rate)}
 
 
 class MomentumOptimizer(Optimizer):
@@ -133,6 +146,27 @@ class MomentumOptimizer(Optimizer):
         else:
             p = p + v
         return p, {"v": v}
+
+    def sparse_update_one(self, p, ids, rows, s, lr, step):
+        """Lazy momentum: velocity advances only for touched rows
+        (reference OptimizersSparse.cu semantics; also what the PS
+        server-side momentum does)."""
+        if self.l2reg > 0:
+            rows = rows + self.l2reg * p[ids]
+        v_rows = self.momentum * s["v"][ids] - lr * rows
+        v = s["v"].at[ids].set(v_rows)
+        if self.nesterov:
+            upd = self.momentum * v_rows - lr * rows
+        else:
+            upd = v_rows
+        return p.at[ids].set(p[ids] + upd), {"v": v}
+
+    def server_opt_spec(self):
+        if hasattr(self.learning_rate, "value"):
+            return None
+        return ("momentum", {"learning_rate": float(self.learning_rate),
+                             "momentum": self.momentum,
+                             "nesterov": self.nesterov})
 
 
 class AdaGradOptimizer(Optimizer):
@@ -158,6 +192,14 @@ class AdaGradOptimizer(Optimizer):
         acc = s["acc"].at[ids].set(s["acc"][ids] + rows * rows)
         denom = jnp.sqrt(acc[ids]) + self.eps
         return p.at[ids].set(p[ids] - lr * rows / denom), {"acc": acc}
+
+    def server_opt_spec(self):
+        if hasattr(self.learning_rate, "value"):
+            return None
+        return ("adagrad", {"learning_rate": float(self.learning_rate),
+                            "initial_accumulator_value":
+                                self.initial_accumulator_value,
+                            "eps": self.eps})
 
 
 class AdamOptimizer(Optimizer):
@@ -210,6 +252,13 @@ class AdamOptimizer(Optimizer):
         upd = -lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
         return p.at[ids].set(p[ids] + upd), ns
 
+    def server_opt_spec(self):
+        if hasattr(self.learning_rate, "value") or self.amsgrad:
+            return None
+        return ("adam", {"learning_rate": float(self.learning_rate),
+                         "beta1": self.beta1, "beta2": self.beta2,
+                         "epsilon": self.epsilon})
+
 
 class AdamWOptimizer(AdamOptimizer):
     """reference optimizer.py:429 — decoupled weight decay."""
@@ -244,6 +293,9 @@ class AdamWOptimizer(AdamOptimizer):
                      + self.weight_decay * p[ids])
         return p.at[ids].set(p[ids] + upd), {"m": m, "v": v}
 
+    def server_opt_spec(self):
+        return None  # decoupled decay has no server-side counterpart
+
 
 class LambOptimizer(AdamOptimizer):
     """reference optimizer.py:493 — layerwise trust-ratio Adam."""
@@ -276,6 +328,9 @@ class LambOptimizer(AdamOptimizer):
         ratio = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
         return p.at[ids].set(p[ids] - lr * ratio * upd), {"m": m, "v": v}
 
+    def server_opt_spec(self):
+        return None  # trust-ratio needs whole-param norms; stays local
+
 
 class OptimizerOp(Op):
     """Terminal graph node applying parameter updates.
@@ -298,12 +353,33 @@ class OptimizerOp(Op):
     def compute(self, input_vals, tc: TraceContext):
         raise AssertionError("OptimizerOp is handled by the executor")
 
-    def apply(self, grad_vals, tc: TraceContext, opt_state, grad_scale=None):
-        """grad_vals[i] is either a dense array or (ids, rows) for sparse."""
+    def apply(self, grad_vals, tc: TraceContext, opt_state, grad_scale=None,
+              ps_vars=frozenset(), side_outputs=None):
+        """grad_vals[i] is either a dense array or (ids, rows) for sparse.
+
+        Vars named in ``ps_vars`` are parameter-server-managed (Hybrid/PS
+        comm modes): their update is NOT applied here; the (scaled) grad is
+        emitted through ``side_outputs`` and the executor pushes it to the
+        PS after the jitted step (reference optimizer.py:145-164
+        backward_hook routing, ParameterServerCommunicate.py:38-57)."""
         opt = self.optimizer
         lr = opt.lr_value(tc.step)
         new_state = dict(opt_state)
         for i, var in enumerate(self.var_list):
+            if var.name in ps_vars:
+                if i in self.sparse_inputs:
+                    rows = grad_vals[i][1]
+                    rows = rows.reshape(-1, rows.shape[-1])
+                    if grad_scale is not None:
+                        rows = rows * grad_scale
+                    side_outputs[var.name] = rows.astype(jnp.float32)
+                else:
+                    g = grad_vals[i]
+                    if grad_scale is not None:
+                        g = g * grad_scale
+                    side_outputs[var.name] = g.astype(jnp.float32)
+                new_state[var.name] = opt_state.get(var.name)
+                continue
             p = tc.params[var]
             s = opt_state.get(var.name)
             if i in self.sparse_inputs:
@@ -326,6 +402,9 @@ class OptimizerOp(Op):
     def gradient(self, output_grad):
         raise NotImplementedError
 
-    def init_state(self, params):
-        return {var.name: self.optimizer.init_state_one(params[var])
+    def init_state(self, params, skip=()):
+        """``skip``: PS-managed var names whose slot state lives on the
+        server (ps/server.py ServerOptimizer.init_state), not here."""
+        return {var.name: (None if var.name in skip
+                           else self.optimizer.init_state_one(params[var]))
                 for var in self.var_list}

@@ -100,6 +100,11 @@ class PSClient:
         return self.t.call("param_init", key, tuple(shape), init_type, arg1,
                            arg2, seed, opt, opt_args, param_type)
 
+    def param_set(self, key, value, opt=None, opt_args=None):
+        """Create-or-overwrite with an explicit value (executor bridge)."""
+        return self.t.call("param_set", key, np.asarray(value, np.float32),
+                           opt, opt_args)
+
     def pull(self, key, async_=False):
         if async_:
             return self._pool.submit(self.t.call, "pull", key)

@@ -403,6 +403,24 @@ class PSServer:
             self.params[key] = _Param(value, optimizer)
             return True
 
+    def param_set(self, key, value, opt=None, opt_args=None):
+        """Create-or-overwrite a param with an explicit value array.
+
+        The executor's Hybrid/PS bridge: exact-value parity with the
+        device-side initializer (param_init's distribution types can't
+        reproduce a jax-PRNG init bit-for-bit).  Overwriting resets
+        optimizer slot state and row versions.
+
+        Always copies: np.asarray over a jax CPU array is zero-copy, and a
+        donated step buffer would silently corrupt the stored table."""
+        value = np.array(value, np.float32, order="C", copy=True)
+        optimizer = None
+        if opt is not None:
+            optimizer = SERVER_OPTIMIZERS[opt](**(opt_args or {}))
+        with self.lock:
+            self.params[key] = _Param(value, optimizer)
+            return True
+
     def param_clear(self, key):
         with self.lock:
             self.params.pop(key, None)

@@ -1,0 +1,278 @@
+"""Hybrid/PS training through the Executor (the reference's headline
+capability: comm_mode routing, optimizer.py:145-164 backward_hook;
+ParameterServerCommunicate.py:38-57 push-pull; executor.py:253-258 cache
+wiring).  The trajectory contract: at staleness 0 every PS/Hybrid mode
+must reproduce the dense single-device run exactly."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.ps.server import PSServer
+import hetu_tpu.ps.client as psc
+
+
+def fresh_ps():
+    PSServer._instance = None
+    psc.PSClient._instance = None
+
+
+def build_model(optimizer=None):
+    ids = ht.placeholder_op("ids")
+    y = ht.placeholder_op("y")
+    emb = ht.init.random_normal((50, 8), stddev=0.1, name="emb_table")
+    emb.is_embed = True
+    e = ht.array_reshape_op(ht.embedding_lookup_op(emb, ids), [-1, 16])
+    w = ht.init.xavier_uniform((16, 2), name="w")
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(e, w), y), axes=0)
+    opt = optimizer or ht.optim.SGDOptimizer(learning_rate=0.1)
+    train = opt.minimize(loss)
+    return ids, y, loss, train
+
+
+def make_batches(n=8, batch=16, vocab=50, seed=0, learnable=False):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        a = rng.randint(0, vocab, (batch, 2)).astype(np.int32)
+        if learnable:   # label linear in the first id's row: loss can drop
+            lab = (a[:, 0] % 2).astype(np.int64)
+        else:
+            lab = rng.randint(0, 2, batch)
+        out.append((a, np.eye(2, dtype=np.float32)[lab]))
+    return out
+
+
+def run_trajectory(executor, ids, y, batches):
+    return [float(np.asarray(
+        executor.run("train", feed_dict={ids: a, y: c})[0]))
+        for a, c in batches]
+
+
+@pytest.fixture()
+def dense_baseline():
+    ids, y, loss, train = build_model()
+    ex = ht.Executor({"train": [loss, train]})
+    w0 = ex.return_tensor_values()
+    batches = make_batches()
+    base = run_trajectory(ex, ids, y, batches)
+    return w0, batches, base
+
+
+class TestHybridEquivalence:
+    @pytest.mark.parametrize("kwargs", [
+        dict(comm_mode="Hybrid"),
+        dict(comm_mode="Hybrid", cstable_policy="LFUOpt", cache_bound=64),
+        dict(comm_mode="Hybrid", cstable_policy="LRU", cache_bound=8),
+        dict(comm_mode="PS"),
+        dict(comm_mode="PS", use_sparse_pull=False),
+    ], ids=["hybrid", "hybrid+lfuopt", "hybrid+lru-tiny", "ps", "ps-full"])
+    def test_trajectory_matches_dense(self, dense_baseline, kwargs):
+        w0, batches, base = dense_baseline
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, **kwargs)
+        ex.load_dict(w0)
+        tr = run_trajectory(ex, ids, y, batches)
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_adam_embeddings_via_server(self, dense_baseline):
+        """Server-side Adam on sparse grads == device lazy Adam... not
+        exactly: server Adam merges rows and keeps a global t; the device
+        path is lazy per-row.  The reference has the same split
+        (OptimizersSparse.cu vs server/optimizer.h), so assert the hybrid
+        run *trains* (loss drops) rather than bitwise parity."""
+        fresh_ps()
+        ids, y, loss, train = build_model(
+            ht.optim.AdamOptimizer(learning_rate=0.05))
+        ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid")
+        batches = make_batches(n=40, learnable=True)
+        tr = run_trajectory(ex, ids, y, batches)
+        assert np.mean(tr[-5:]) < np.mean(tr[:5]) - 0.02
+
+    def test_momentum_dense_ps_matches(self):
+        """PS mode with Momentum: server-side dense momentum must equal the
+        device update exactly."""
+        opt = ht.optim.MomentumOptimizer(learning_rate=0.05, momentum=0.9)
+        ids, y, loss, train = build_model(opt)
+        ex = ht.Executor({"train": [loss, train]})
+        w0 = ex.return_tensor_values()
+        batches = make_batches()
+        base = run_trajectory(ex, ids, y, batches)
+
+        fresh_ps()
+        opt = ht.optim.MomentumOptimizer(learning_rate=0.05, momentum=0.9)
+        ids, y, loss, train = build_model(opt)
+        ex2 = ht.Executor({"train": [loss, train]}, comm_mode="PS")
+        ex2.load_dict(w0)
+        tr = run_trajectory(ex2, ids, y, batches)
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+
+class TestCacheBehavior:
+    def test_cache_hit_rate_counted(self):
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
+                         cstable_policy="LFUOpt", cache_bound=64)
+        batches = make_batches(n=6)
+        run_trajectory(ex, ids, y, batches)
+        perf = ex.ps_perf_summary()["emb_table"]
+        assert perf["lookups"] == 6
+        # vocab 50 fits in 64 lines: after warm-up everything hits
+        assert perf["hit_rate"] > 0.3
+        assert perf["pushed_rows"] > 0
+
+    def test_tiny_cache_evicts_correctly(self, dense_baseline):
+        """Eviction write-back must not lose updates (trajectory already
+        covered above; here assert evictions actually happened)."""
+        w0, batches, base = dense_baseline
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
+                         cstable_policy="LFU", cache_bound=4)
+        ex.load_dict(w0)
+        tr = run_trajectory(ex, ids, y, batches)
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+        assert ex.ps_perf_summary()["emb_table"]["evictions"] > 0
+
+    def test_cache_rejects_non_sgd(self):
+        fresh_ps()
+        ids, y, loss, train = build_model(
+            ht.optim.AdamOptimizer(learning_rate=0.01))
+        with pytest.raises(NotImplementedError):
+            ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
+                        cstable_policy="LFUOpt")
+
+
+class TestPrefetch:
+    def test_dataloader_prefetch_trajectory(self, dense_baseline):
+        """Prefetched (overlapped) lookups must not change the math."""
+        w0, batches, base = dense_baseline
+        id_data = np.concatenate([a for a, _ in batches])
+        y_data = np.concatenate([c for _, c in batches])
+
+        def build_dl():
+            dl_ids = ht.dataloader_op([ht.Dataloader(id_data, 16, "train")])
+            dl_y = ht.dataloader_op([ht.Dataloader(y_data, 16, "train")])
+            emb = ht.init.random_normal((50, 8), stddev=0.1,
+                                        name="emb_table")
+            emb.is_embed = True
+            e = ht.array_reshape_op(
+                ht.embedding_lookup_op(emb, dl_ids), [-1, 16])
+            w = ht.init.xavier_uniform((16, 2), name="w")
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(ht.matmul_op(e, w), dl_y),
+                axes=0)
+            train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            return loss, train
+
+        for prefetch in (False, True):
+            fresh_ps()
+            loss, train = build_dl()
+            ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
+                             cstable_policy="LFUOpt", cache_bound=64,
+                             prefetch=prefetch)
+            ex.load_dict(w0)
+            tr = [float(np.asarray(ex.run("train")[0]))
+                  for _ in range(len(batches))]
+            np.testing.assert_allclose(tr, base, atol=1e-5)
+
+
+class TestCheckpointAndKnobs:
+    def test_checkpoint_roundtrip_with_ps(self, tmp_path, dense_baseline):
+        w0, batches, base = dense_baseline
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
+                         cstable_policy="LFUOpt", cache_bound=64)
+        ex.load_dict(w0)
+        run_trajectory(ex, ids, y, batches[:4])
+        ex.save(str(tmp_path), "ckpt.pkl")
+
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex2 = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
+                          cstable_policy="LFUOpt", cache_bound=64)
+        ex2.load(str(tmp_path), "ckpt.pkl")
+        tr = run_trajectory(ex2, ids, y, batches[4:])
+        np.testing.assert_allclose(tr, base[4:], atol=1e-5)
+
+    def test_bsp_barrier_single_worker(self, dense_baseline):
+        w0, batches, base = dense_baseline
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
+                         bsp=0)
+        ex.load_dict(w0)
+        tr = run_trajectory(ex, ids, y, batches)
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_bad_knobs_raise(self):
+        ids, y, loss, train = build_model()
+        with pytest.raises(ValueError):
+            ht.Executor({"train": [loss, train]}, comm_mode="nccl")
+        ids, y, loss, train = build_model()
+        with pytest.raises(ValueError):
+            ht.Executor({"train": [loss, train]},
+                        cstable_policy="LFUOpt")  # needs PS/Hybrid
+        ids, y, loss, train = build_model()
+        with pytest.raises(NotImplementedError):
+            ht.Executor({"train": [loss, train]}, use_preduce=True)
+        ids, y, loss, train = build_model()
+        with pytest.raises(NotImplementedError):
+            ht.Executor({"train": [loss, train]}, pipeline="gpipe")
+
+    def test_shared_table_multi_lookup_stays_on_device(self):
+        """A table consumed by two lookups cannot live on the PS (summed
+        IndexedSlices adjoints densify); it must silently stay a device
+        var and training must still work."""
+        fresh_ps()
+        ids1 = ht.placeholder_op("ids1")
+        ids2 = ht.placeholder_op("ids2")
+        y = ht.placeholder_op("y")
+        emb = ht.init.random_normal((20, 4), stddev=0.1, name="emb_shared")
+        emb.is_embed = True
+        e1 = ht.array_reshape_op(ht.embedding_lookup_op(emb, ids1), [-1, 8])
+        e2 = ht.array_reshape_op(ht.embedding_lookup_op(emb, ids2), [-1, 8])
+        w = ht.init.xavier_uniform((16, 2), name="w")
+        h = ht.concat_op(e1, e2, axis=1)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(h, w), y), axes=0)
+        train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid")
+        assert "emb_shared" not in ex.ps_sparse_vars
+        rng = np.random.RandomState(0)
+        out = ex.run("train", feed_dict={
+            ids1: rng.randint(0, 20, (8, 2)).astype(np.int32),
+            ids2: rng.randint(0, 20, (8, 2)).astype(np.int32),
+            y: np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]})
+        assert np.isfinite(float(np.asarray(out[0])))
+
+    def test_return_tensor_values_includes_ps_tables(self, dense_baseline):
+        w0, batches, base = dense_baseline
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, comm_mode="PS")
+        ex.load_dict(w0)
+        run_trajectory(ex, ids, y, batches[:2])
+        vals = ex.return_tensor_values()
+        assert "emb_table" in vals and "w" in vals
+        # dense-PS var must be the server's (post-step) value, not the
+        # stale device copy
+        np.testing.assert_allclose(
+            vals["w"], np.asarray(ex.ps_comm.pull("w")), atol=0)
+
+    def test_save_returns_copies_not_views(self):
+        """Regression: np.asarray over a donated jax CPU buffer is a view;
+        checkpoints and return_tensor_values must deep-copy or they rot
+        when the next step reuses the buffer."""
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]})
+        snap = ex.return_tensor_values()
+        before = {k: v.copy() for k, v in snap.items()}
+        for a, c in make_batches(n=3):
+            ex.run("train", feed_dict={ids: a, y: c})
+        for k in snap:
+            np.testing.assert_array_equal(snap[k], before[k])
