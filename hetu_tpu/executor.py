@@ -642,11 +642,18 @@ class Executor:
         return NamedSharding(self.mesh, spec if spec is not None else P())
 
     def feed_sharding(self, name, shape):
-        """Feeds shard along the batch dim over the 'dp' axis if present."""
+        """Feeds shard along the batch dim over the 'dp' axis if present;
+        on a pure expert-parallel mesh tokens are data-parallel over the
+        expert group (reference MoE: DP and EP share the same devices)."""
         if self.mesh is None:
             return None
-        if "dp" in self.mesh.axis_names and len(shape) >= 1:
-            return NamedSharding(self.mesh, P("dp"))
+        axes = ["dp"]
+        if "dp" not in self.mesh.axis_names:
+            axes.append("ep")   # pure-EP mesh: tokens are DP over 'ep'
+        for ax in axes:
+            if ax in self.mesh.axis_names and len(shape) >= 1 \
+                    and shape[0] % self.mesh.shape[ax] == 0:
+                return NamedSharding(self.mesh, P(ax))
         return NamedSharding(self.mesh, P())
 
     def device_put_feed(self, name, value):

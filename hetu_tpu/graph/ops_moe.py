@@ -248,6 +248,25 @@ def reverse_layout_transform_no_gate_op(inp, indices_s, location_s, capacity,
     return _NoGate()
 
 
+def _pin_dim0(x, mesh, axes):
+    """pjit-mode a2a marker: constrain dim 0 over the given mesh axes
+    (those present), ordered as the MESH orders them (outer-major — the
+    device-order truth), so the constraint matches the expert-weight
+    sharding convention and GSPMD materializes the token exchange at this
+    site.  Returns x unchanged when no named axis is usable."""
+    present = tuple(ax for ax in mesh.axis_names if ax in axes)
+    total = 1
+    for ax in present:
+        total *= mesh.shape[ax]
+    if not present or x.shape[0] % total:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = [None] * x.ndim
+    spec[0] = present if len(present) > 1 else present[0]
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
 class AllToAllOp(Op):
     """Expert-parallel all-to-all (gpu_ops/AllToAll.py:8-50; NCCL send/recv
     loop mpi_nccl_communication.cu:245-275).
@@ -270,6 +289,11 @@ class AllToAllOp(Op):
             out = jax.lax.all_to_all(parts, self.axis, split_axis=0,
                                      concat_axis=0, tiled=False)
             return out.reshape(x.shape)
+        if tc.mesh is not None:
+            # pjit mode: pin the expert-major dim to the 'ep' axis so GSPMD
+            # must materialize the redistribution (the actual all-to-all)
+            # between the token-sharded dispatch and the expert compute
+            return _pin_dim0(x, tc.mesh, (self.axis,))
         return x
 
     def gradient(self, output_grad):
@@ -292,12 +316,30 @@ class HAllToAllOp(Op):
 
     def compute(self, input_vals, tc: TraceContext):
         (x,) = input_vals
-        for ax in self.axes:
-            if tc.has_axis(ax):
-                n = jax.lax.axis_size(ax)
-                parts = x.reshape(n, x.shape[0] // n, *x.shape[1:])
-                x = jax.lax.all_to_all(parts, ax, split_axis=0,
-                                       concat_axis=0).reshape(x.shape)
+        present = [ax for ax in self.axes if tc.has_axis(ax)]
+        if len(present) == 2:
+            # Two-stage exchange equal to one flat all-to-all over the
+            # (outer, inner) superaxis: view local rows as
+            # [outer_dest, inner_dest, r, ...] and exchange each stage over
+            # its OWN destination dim — splitting dim 0 twice (naive
+            # composition) interleaves blocks wrongly.
+            a_inner, a_outer = self.axes
+            n_in = jax.lax.axis_size(a_inner)
+            n_out = jax.lax.axis_size(a_outer)
+            r = x.shape[0] // (n_in * n_out)
+            parts = x.reshape(n_out, n_in, r, *x.shape[1:])
+            parts = jax.lax.all_to_all(parts, a_inner, split_axis=1,
+                                       concat_axis=1)
+            parts = jax.lax.all_to_all(parts, a_outer, split_axis=0,
+                                       concat_axis=0)
+            return parts.reshape(x.shape)
+        for ax in present:
+            n = jax.lax.axis_size(ax)
+            parts = x.reshape(n, x.shape[0] // n, *x.shape[1:])
+            x = jax.lax.all_to_all(parts, ax, split_axis=0,
+                                   concat_axis=0).reshape(x.shape)
+        if not present and tc.mesh is not None:
+            x = _pin_dim0(x, tc.mesh, self.axes)
         return x
 
     def gradient(self, output_grad):

@@ -9,6 +9,6 @@ from .activations import Relu, Gelu, Tanh, Sigmoid
 from .embedding import Embedding
 from .pooling import MaxPool2d, AvgPool2d
 from .reshape import Reshape
-from .moe import Expert, MoELayer, TopKGate, HashGate, KTop1Gate, SAMGate, \
-    BalanceGate
+from .moe import Expert, MoELayer, StackedExperts, TopKGate, HashGate, \
+    KTop1Gate, SAMGate, BalanceGate
 from .attention import MultiHeadAttention

@@ -230,9 +230,44 @@ class Expert(BaseLayer):
         return x
 
 
+class StackedExperts(BaseLayer):
+    """All experts as stacked weights [E, D, F] — the expert-parallel
+    formulation: one batched einsum instead of a per-expert python loop,
+    with the leading expert dim sharded over the 'ep' mesh axis
+    (ExpertParallel matches the '*expert*' names + leading dim).  GSPMD
+    partitions the expert matmuls by expert and materializes the token
+    redistribution (all-to-all) at the alltoall_op markers.
+
+    Mirrors the math of reference moe_layer.py:6-44 Expert (two matmuls,
+    optional activation) batched over experts."""
+
+    def __init__(self, num_experts, embed_dim, ffn_dim, activation=None,
+                 initializer=None, name="experts"):
+        self.num_experts = int(num_experts)
+        self.embed_dim = embed_dim
+        self.ffn_dim = ffn_dim
+        if isinstance(activation, str):
+            activation = {"relu": relu_op, "gelu": gelu_op}[activation]
+        self.activation = activation
+        ini = initializer or init.GenXavierUniform()
+        self.w1 = ini(shape=(self.num_experts, embed_dim, ffn_dim),
+                      name=name + "_expert_stack_w1")
+        self.w2 = ini(shape=(self.num_experts, ffn_dim, embed_dim),
+                      name=name + "_expert_stack_w2")
+
+    def __call__(self, x):
+        """x: [E, cap, D] -> [E, cap, D]."""
+        from ..graph import batch_matmul_op
+        h = batch_matmul_op(x, self.w1)
+        if self.activation is not None:
+            h = self.activation(h)
+        return batch_matmul_op(h, self.w2)
+
+
 class MoELayer(BaseLayer):
     """reference moe_layer.py:45-133 (both 'MoELayer' and
-    'BalanceAssignmentLayer' modes)."""
+    'BalanceAssignmentLayer' modes).  Pass ``experts=StackedExperts(...)``
+    for the expert-parallel (mesh-shardable) formulation."""
 
     def __init__(self, gate=None, experts=None, num_tokens=None,
                  embed_dim=None, all2all_size=None, name="MoELayer",
@@ -240,7 +275,15 @@ class MoELayer(BaseLayer):
         self.name = name
         self.gate = gate
         self.experts = experts
-        self.num_local_experts = len(experts)
+        self.stacked = experts if isinstance(experts, StackedExperts) \
+            else None
+        if self.stacked is not None:
+            assert all2all_size in (None, 1), (
+                "StackedExperts already hold the GLOBAL expert set; "
+                "all2all_size only applies to the per-local-expert list "
+                "formulation")
+        self.num_local_experts = (self.stacked.num_experts
+                                  if self.stacked else len(experts))
         self.num_tokens = num_tokens
         self.embed_dim = embed_dim
         self.all2all_size = all2all_size or 1
@@ -260,6 +303,8 @@ class MoELayer(BaseLayer):
     def __call__(self, x):
         if self.name == "BalanceAssignmentLayer":
             return self._balance_forward(x)
+        if self.stacked is not None:
+            return self._stacked_forward(x)
         reshaped = array_reshape_op(x, [-1, self.embed_dim])
         l_aux, indices_s, location_s, gates_s, capacity = self.gate(reshaped)
         total_experts = self.num_local_experts * self.all2all_size
@@ -277,6 +322,28 @@ class MoELayer(BaseLayer):
         expert_output = concatenate_op(outputs, axis=0)
         expert_output = self._a2a(expert_output)
         expert_output = array_reshape_op(expert_output, [-1, self.embed_dim])
+        combined = reverse_layout_transform_op(
+            expert_output, indices_s, location_s, gates_s, capacity,
+            total_experts)
+        return combined, l_aux
+
+    def _stacked_forward(self, x):
+        """Expert-parallel path: dispatch -> a2a -> batched expert FFN ->
+        a2a -> combine.  The a2a markers pin expert-major sharding over
+        'ep' (or ('ici','dcn') when hierarchical), forcing GSPMD to emit
+        the token exchange there; under shard_map they run lax.all_to_all
+        (reference moe_layer.py:74 alltoall placement)."""
+        reshaped = array_reshape_op(x, [-1, self.embed_dim])
+        l_aux, indices_s, location_s, gates_s, capacity = self.gate(reshaped)
+        total_experts = self.stacked.num_experts
+        dispatched = layout_transform_op(
+            reshaped, indices_s, location_s, capacity, total_experts)
+        d = array_reshape_op(
+            dispatched, [total_experts, capacity, self.embed_dim])
+        d = self._a2a(d)
+        h = self.stacked(d)                       # [E, cap, D]
+        h = self._a2a(h)
+        expert_output = array_reshape_op(h, [-1, self.embed_dim])
         combined = reverse_layout_transform_op(
             expert_output, indices_s, location_s, gates_s, capacity,
             total_experts)

@@ -20,7 +20,7 @@ from .context_parallel import (
 from . import distributed_strategies
 from .distributed_strategies import (
     DataParallel, ModelParallel4LM, ExpertParallel, PipelineParallel4LM,
-    FSDP, BaseSearchingStrategy,
+    FSDP, BaseSearchingStrategy, ShardingPlan,
 )
 from . import preduce
 from .preduce import PartialReduce

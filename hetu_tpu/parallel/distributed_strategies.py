@@ -38,22 +38,55 @@ class DataParallel(Strategy):
         # params replicated (default spec None -> P())
 
 
+class ShardingPlan(Strategy):
+    """Explicit per-variable PartitionSpecs — the unambiguous spec API.
+
+    ``specs``: {var_name: PartitionSpec}; unlisted vars replicate.
+    ``mesh_axes``: {'dp': 2, 'tp': 2} built into a Mesh when the executor
+    has none.  Unknown var names raise (catches typos that name-pattern
+    matching would silently ignore)."""
+
+    def __init__(self, specs, mesh_axes=None, strict=True):
+        self.specs = dict(specs)
+        self.mesh_axes = mesh_axes
+        self.strict = strict
+
+    def configure(self, executor):
+        if executor.config.mesh is None and self.mesh_axes:
+            executor.config.mesh = make_mesh(self.mesh_axes)
+        unknown = set(self.specs) - set(executor.variables)
+        if unknown and self.strict:
+            raise KeyError(f"ShardingPlan names unknown variables: "
+                           f"{sorted(unknown)}; known: "
+                           f"{sorted(executor.variables)[:20]}...")
+        for name, spec in self.specs.items():
+            if name in executor.variables:
+                executor.variables[name].sharding_spec = spec
+
+
 class ModelParallel4LM(Strategy):
     """Megatron-style tensor parallel over 'tp': column-split attention/MLP
-    in-projections, row-split out-projections.  Variables matching the
-    naming patterns get 2D shardings; everything else replicates."""
+    in-projections, row-split out-projections.
+
+    Preferred: pass ``specs`` ({var_name: PartitionSpec}) for explicit,
+    typo-checked assignment.  Fallback: name-pattern matching (reference
+    parity; patterns over variable names)."""
 
     def __init__(self, tp=None, dp=1, col_patterns=("qkv", "wi", "fc1", "expand"),
-                 row_patterns=("proj", "wo", "fc2", "reduce")):
+                 row_patterns=("proj", "wo", "fc2", "reduce"), specs=None):
         self.tp = tp
         self.dp = dp
         self.col_patterns = col_patterns
         self.row_patterns = row_patterns
+        self.specs = specs
 
     def configure(self, executor):
         if executor.config.mesh is None:
             tp = self.tp or (jax.device_count() // self.dp)
             executor.config.mesh = make_mesh({"dp": self.dp, "tp": tp})
+        if self.specs is not None:
+            ShardingPlan(self.specs).configure(executor)
+            return
         for name, node in executor.variables.items():
             if node.sharding_spec is not None:
                 continue

@@ -61,6 +61,9 @@ def make_mesh(axes=None, devices=None, **kwargs):
         if v == -1:
             sizes[k] = n // known
     names = [a for a in AXIS_ORDER if sizes.get(a, 1) > 1]
+    # axes outside the canonical set (e.g. 'ici' for hierarchical A2A)
+    # append innermost in caller order
+    names += [a for a in sizes if a not in AXIS_ORDER and sizes[a] > 1]
     if not names:
         names = [next(iter(sizes))] if sizes else ["dp"]
     dims = [sizes.get(a, 1) for a in names]

@@ -1,0 +1,221 @@
+"""Expert parallelism actually running over an expert mesh.
+
+Reference behavior being matched: MoE dispatch runs all-to-all across
+devices (python/hetu/layers/moe_layer.py:45-93, gpu_ops/AllToAll.py:8-50);
+hierarchical A2A composes intra- then inter-node exchanges
+(src/communication/mpi_nccl_communication.cu:152-243).
+
+TPU-native: expert weights stacked [E, D, F] and sharded over 'ep'
+(StackedExperts); alltoall_op pins expert-major sharding so GSPMD emits
+the exchange inside the one jitted step.  Tests assert (a) numerical
+equivalence with the single-device run, (b) the compiled HLO actually
+partitions the expert compute and contains a cross-device exchange, and
+(c) the shard_map execution path runs real lax.all_to_all, flat and
+hierarchical."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+from hetu_tpu.parallel.mesh import make_mesh
+
+
+E, D, F, B = 4, 8, 16, 32
+
+
+def build_moe(num_tokens):
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("y")
+    gate = ht.layers.TopKGate(D, num_tokens, E, k=1, capacity_factor=1.0)
+    experts = ht.layers.StackedExperts(E, D, F, activation="relu")
+    moe = ht.layers.MoELayer(gate=gate, experts=experts, num_tokens=num_tokens,
+                             embed_dim=D)
+    out, l_aux = moe(x)
+    head = ht.init.xavier_uniform((D, 2), name="moe_head")
+    logits = ht.matmul_op(out, head)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(logits, y), axes=0) \
+        + ht.mul_byconst_op(l_aux, 0.01)
+    train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return x, y, loss, train
+
+
+def batches(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        xb = rng.randn(B, D).astype(np.float32)
+        yb = np.eye(2, dtype=np.float32)[(xb[:, 0] > 0).astype(int)]
+        out.append((xb, yb))
+    return out
+
+
+class TestExpertParallelExecutor:
+    def test_ep_trajectory_matches_single_device(self):
+        x, y, loss, train = build_moe(B)
+        ex = ht.Executor({"train": [loss, train]})
+        w0 = ex.return_tensor_values()
+        bs = batches()
+        base = [float(np.asarray(ex.run("train", feed_dict={x: a, y: b})[0]))
+                for a, b in bs]
+
+        x, y, loss, train = build_moe(B)
+        ex2 = ht.Executor({"train": [loss, train]},
+                          dist_strategy=ht.dist.ExpertParallel(ep=4, dp=1))
+        ex2.load_dict(w0)
+        tr = [float(np.asarray(ex2.run("train", feed_dict={x: a, y: b})[0]))
+              for a, b in bs]
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_ep_times_dp_trajectory(self):
+        x, y, loss, train = build_moe(B)
+        ex = ht.Executor({"train": [loss, train]})
+        w0 = ex.return_tensor_values()
+        bs = batches()
+        base = [float(np.asarray(ex.run("train", feed_dict={x: a, y: b})[0]))
+                for a, b in bs]
+
+        x, y, loss, train = build_moe(B)
+        ex2 = ht.Executor({"train": [loss, train]},
+                          dist_strategy=ht.dist.ExpertParallel(ep=2, dp=4))
+        ex2.load_dict(w0)
+        tr = [float(np.asarray(ex2.run("train", feed_dict={x: a, y: b})[0]))
+              for a, b in bs]
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_expert_weights_actually_sharded(self):
+        x, y, loss, train = build_moe(B)
+        ex = ht.Executor({"train": [loss, train]},
+                         dist_strategy=ht.dist.ExpertParallel(ep=4, dp=1))
+        w1 = None
+        for name, v in ex.var_values.items():
+            if "expert_stack_w1" in name:
+                w1 = v
+        assert w1 is not None
+        # leading expert dim split 4 ways: each shard holds E/4 experts
+        shard_shapes = {s.data.shape for s in w1.addressable_shards}
+        assert shard_shapes == {(E // 4, D, F)}
+
+    def test_compiled_hlo_partitions_expert_compute(self):
+        """The proof the EP path is real: compiled HLO of the executor step
+        must (a) run expert matmuls at per-shard size E/ep and (b) contain
+        a cross-partition exchange feeding them (all-to-all, or
+        collective-permute when XLA lowers the reshard that way)."""
+        x, y, loss, train = build_moe(B)
+        ex = ht.Executor({"train": [loss, train]},
+                         dist_strategy=ht.dist.ExpertParallel(ep=4, dp=1))
+        bs = batches(1)
+        a, b = bs[0]
+        ex.run("train", feed_dict={x: a, y: b})   # compile
+        sub = ex.subexecutor["train"]
+        fn = next(iter(sub._compiled.values()))
+        feeds = {"x": a, "y": b}
+        txt = fn.lower(ex.var_values, ex.opt_states, ex.step, ex.rng,
+                       {k: np.asarray(v) for k, v in feeds.items()}
+                       ).compile().as_text()
+        assert "all-to-all" in txt or "collective-permute" in txt or \
+            "all-gather" in txt, "no cross-device exchange in HLO"
+        # expert batched matmul appears at per-shard expert count (dim E/4)
+        per_shard = f"f32[{E // 4},{B // E},{F}]"
+        assert per_shard in txt.replace(" ", ""), (
+            f"expected per-shard expert activation {per_shard} in HLO")
+
+
+class TestShardMapA2A:
+    def test_flat_alltoall_executes(self):
+        mesh = make_mesh({"ep": 4})
+        from hetu_tpu.graph.ops_moe import alltoall_op
+        from hetu_tpu.graph.node import TraceContext
+        from jax import shard_map
+
+        node = ht.placeholder_op("t")
+        a2a = alltoall_op(node, axis="ep")
+        xs = jnp.arange(16 * 3, dtype=jnp.float32).reshape(16, 3)
+
+        def body(x):
+            tc = TraceContext(axis_env=("ep",))
+            return a2a.compute([x], tc)
+
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=P("ep"),
+                                out_specs=P("ep")))(xs)
+        # all_to_all over blocks: involution — applying twice restores
+        out2 = jax.jit(shard_map(body, mesh=mesh, in_specs=P("ep"),
+                                 out_specs=P("ep")))(out)
+        np.testing.assert_array_equal(np.asarray(out2), np.asarray(xs))
+        # and it is NOT the identity (devices exchanged rows)
+        assert not np.array_equal(np.asarray(out), np.asarray(xs))
+
+    def test_hierarchical_alltoall_over_ici_dcn(self):
+        """('dcn','ici') mesh: halltoall composes per-axis exchanges; the
+        composition must be an involution and must move data across both
+        axes (reference mpi_nccl_communication.cu:152-243 semantics)."""
+        mesh = make_mesh({"dcn": 2, "ici": 2})
+        assert mesh.axis_names == ("dcn", "ici")
+        from hetu_tpu.graph.ops_moe import halltoall_op
+        from hetu_tpu.graph.node import TraceContext
+        from jax import shard_map
+
+        node = ht.placeholder_op("t")
+        h = halltoall_op(node, axes=("ici", "dcn"))
+        xs = jnp.arange(16 * 2, dtype=jnp.float32).reshape(16, 2)
+
+        def body(x):
+            tc = TraceContext(axis_env=("ici", "dcn"))
+            return h.compute([x], tc)
+
+        run = jax.jit(shard_map(body, mesh=mesh,
+                                in_specs=P(("dcn", "ici")),
+                                out_specs=P(("dcn", "ici"))))
+        out = run(xs)
+        out2 = run(out)
+        np.testing.assert_array_equal(np.asarray(out2), np.asarray(xs))
+        assert not np.array_equal(np.asarray(out), np.asarray(xs))
+
+        # the hierarchical two-stage exchange must equal ONE flat
+        # all-to-all over the combined ('dcn','ici') superaxis
+        def flat(x):
+            n = 4
+            parts = x.reshape(n, x.shape[0] // n, *x.shape[1:])
+            return jax.lax.all_to_all(
+                parts, ("dcn", "ici"), split_axis=0,
+                concat_axis=0).reshape(x.shape)
+
+        flat_out = jax.jit(shard_map(flat, mesh=mesh,
+                                     in_specs=P(("dcn", "ici")),
+                                     out_specs=P(("dcn", "ici"))))(xs)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(flat_out))
+
+    def test_hierarchical_moe_trains_on_ici_dcn_mesh(self):
+        """MoE with hierarchical=True through the Executor on a
+        ('dcn','ici') mesh (pjit mode: constraint spans both axes)."""
+        x = ht.placeholder_op("x")
+        y = ht.placeholder_op("y")
+        gate = ht.layers.TopKGate(D, B, E, k=1, capacity_factor=1.0)
+        experts = ht.layers.StackedExperts(E, D, F, activation="relu",
+                                           name="hier")
+        moe = ht.layers.MoELayer(gate=gate, experts=experts, num_tokens=B,
+                                 embed_dim=D, hierarchical=True)
+        out, l_aux = moe(x)
+        head = ht.init.xavier_uniform((D, 2), name="hier_head")
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(out, head), y), axes=0) \
+            + ht.mul_byconst_op(l_aux, 0.01)
+        train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+        mesh = make_mesh({"dcn": 2, "ici": 2})
+        for name, node in {"hier_expert_stack_w1": None,
+                           "hier_expert_stack_w2": None}.items():
+            pass
+        ex = ht.Executor({"train": [loss, train]}, mesh=mesh)
+        for name, node in ex.variables.items():
+            if "expert_stack" in name:
+                node.sharding_spec = P(("dcn", "ici"), None, None)
+        ex.var_values = {k: jax.device_put(v, ex.param_sharding(k))
+                         for k, v in ex.var_values.items()}
+        for a, b in batches(3):
+            out_v = ex.run("train", feed_dict={x: a, y: b})
+            assert np.isfinite(float(np.asarray(out_v[0])))
