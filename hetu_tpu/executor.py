@@ -75,10 +75,13 @@ class HetuConfig:
                      everything is lazily traced into one jitted program,
                      XLA overlaps collectives, and collectives are always
                      XLA's (documented, accepted for API parity).
-      pipeline / use_preduce — raise until wired; see
-                     parallel.pipeline.PipelineTrainer and
-                     parallel.preduce.PartialReduce for the standalone
-                     implementations.
+      pipeline       'gpipe'/'1f1b'/'pipedream'/'hetpipe' — training
+                     subgraphs run through the pipeline partitioner +
+                     microbatch schedules (pipeline_executor.py); with a
+                     'pp' mesh axis and a uniform repeated body the SPMD
+                     scan pipeline is used.  num_stages/num_microbatches/
+                     sync_every parameterize it.
+      use_preduce — raises; drive parallel.preduce.PartialReduce directly.
     """
 
     def __init__(self, eval_node_list=None, train_name=None, val_name=None,
@@ -87,8 +90,8 @@ class HetuConfig:
                  log_path=None, my_eval_nodes=None, dist_strategy=None,
                  pipeline=None, overlap=True, use_preduce=False,
                  use_nccl_collectives=True, seed=0, mesh=None,
-                 num_microbatches=None, dtype=jnp.float32,
-                 mixed_precision=None, ps_comm=None):
+                 num_microbatches=None, num_stages=None, sync_every=None,
+                 dtype=jnp.float32, mixed_precision=None, ps_comm=None):
         if comm_mode not in (None, "AllReduce", "PS", "Hybrid"):
             raise ValueError(f"comm_mode must be None/'AllReduce'/'PS'/"
                              f"'Hybrid', got {comm_mode!r}")
@@ -104,9 +107,11 @@ class HetuConfig:
         self.cache_bound = cache_bound
         self.log_path = log_path
         self.dist_strategy = dist_strategy
-        if pipeline not in (None, "gpipe", "pipedream", "hetpipe"):
+        if pipeline not in (None, "gpipe", "1f1b", "pipedream", "hetpipe"):
             raise ValueError(f"unknown pipeline mode {pipeline!r}")
         self.pipeline = pipeline
+        self.num_stages = num_stages
+        self.sync_every = sync_every
         self.overlap = overlap
         if use_preduce:
             raise NotImplementedError(
@@ -410,11 +415,6 @@ class Executor:
             eval_node_dict = {"default": eval_node_dict}
         self.eval_node_dict = eval_node_dict
         self.config = config if config is not None else HetuConfig(**kargs)
-        if self.config.pipeline is not None:
-            raise NotImplementedError(
-                "Executor(pipeline=...) lands with the graph partitioner; "
-                "until then drive parallel.pipeline.PipelineTrainer / "
-                "spmd_pipeline directly")
         self.mesh = self.config.mesh
         self.rng = jax.random.PRNGKey(self.config.seed)
         self.step = jnp.zeros((), jnp.int32)
@@ -464,7 +464,12 @@ class Executor:
         self.subexecutor = {}
         self.opt_states = {}
         for name, nodes in eval_node_dict.items():
-            sub = SubExecutor(name, nodes, self)
+            has_opt = any(isinstance(n, OptimizerOp) for n in nodes)
+            if self.config.pipeline is not None and has_opt:
+                from .pipeline_executor import PipelineSubExecutor
+                sub = PipelineSubExecutor(name, nodes, self)
+            else:
+                sub = SubExecutor(name, nodes, self)
             self.subexecutor[name] = sub
             for opt_op in sub.optimizer_ops:
                 if opt_op.name not in self.opt_states:

@@ -114,11 +114,22 @@ class BatchNormOp(Op):
         self.momentum = momentum
         self.eps = eps
         c = scale.shape[0] if scale.shape else None
+        # state names derive from the scale param's (user-stable) name,
+        # NOT the auto node id — otherwise running stats silently fail to
+        # reload from a checkpoint in a fresh process.  A reused scale
+        # (same BatchNorm layer applied twice) gets a per-use suffix so
+        # the two ops' states don't collide.
+        base = getattr(scale, "name", self.name)
+        uses = getattr(scale, "_bn_uses", 0)
+        if isinstance(scale, PlaceholderOp):
+            scale._bn_uses = uses + 1
+        if uses:
+            base = f"{base}_{uses}"
         self.running_mean = PlaceholderOp(
-            f"{self.name}_running_mean",
+            f"{base}_running_mean",
             value=jnp.zeros((c,)) if c else None, trainable=False)
         self.running_var = PlaceholderOp(
-            f"{self.name}_running_var",
+            f"{base}_running_var",
             value=jnp.ones((c,)) if c else None, trainable=False)
         self.state_vars = [self.running_mean, self.running_var]
 

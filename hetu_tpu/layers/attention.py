@@ -28,7 +28,9 @@ class MultiHeadAttention(BaseLayer):
         self.nh = num_heads
         self.hd = hidden_size // num_heads
         self.seq = seq_len
-        self.bs = batch_size
+        # batch_size is accepted for API parity but no longer baked into
+        # the graph: reshapes use -1 so the layer works at any (local)
+        # batch, e.g. inside a dp-sharded shard_map pipeline body
         self.keep_prob = 1.0 - dropout_rate
         self.use_flash = use_flash
         self.causal = causal
@@ -45,8 +47,10 @@ class MultiHeadAttention(BaseLayer):
         self.bo = init.zeros((self.h,), name=name + "_proj_bias")
 
     def _split_heads(self, x):
-        # (B*S, H) -> (B, nh, S, hd)
-        x = array_reshape_op(x, [self.bs, self.seq, self.nh, self.hd])
+        # (B*S, H) -> (B, nh, S, hd).  -1 keeps the batch dim symbolic:
+        # under a dp-sharded shard_map (e.g. the SPMD pipeline body) the
+        # layer sees the LOCAL batch, so baking batch_size would break.
+        x = array_reshape_op(x, [-1, self.seq, self.nh, self.hd])
         return transpose_op(x, [0, 2, 1, 3])
 
     def __call__(self, x, attention_mask=None):
@@ -57,14 +61,14 @@ class MultiHeadAttention(BaseLayer):
             # [B*S, H] -> [B, S, nh, hd] (kernel layout)
             def bshd(node):
                 return array_reshape_op(
-                    node, [self.bs, self.seq, self.nh, self.hd])
+                    node, [-1, self.seq, self.nh, self.hd])
             q = bshd(linear_op(x, self.wq, self.bq))
             k = bshd(linear_op(x, self.wk, self.bk))
             v = bshd(linear_op(x, self.wv, self.bv))
             o = flash_attention_op(q, k, v, causal=self.causal,
                                    block_q=self.block_q,
                                    block_k=self.block_k)
-            o = array_reshape_op(o, [self.bs * self.seq, self.h])
+            o = array_reshape_op(o, [-1, self.h])
             return linear_op(o, self.wo, self.bo)
         q = self._split_heads(linear_op(x, self.wq, self.bq))
         k = self._split_heads(linear_op(x, self.wk, self.bk))
@@ -78,5 +82,5 @@ class MultiHeadAttention(BaseLayer):
             probs = dropout_op(probs, self.keep_prob)
         ctxv = batch_matmul_op(probs, v)  # (B, nh, S, hd)
         ctxv = transpose_op(ctxv, [0, 2, 1, 3])
-        ctxv = array_reshape_op(ctxv, [self.bs * self.seq, self.h])
+        ctxv = array_reshape_op(ctxv, [-1, self.h])
         return linear_op(ctxv, self.wo, self.bo)

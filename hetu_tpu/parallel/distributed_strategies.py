@@ -54,6 +54,11 @@ class ShardingPlan(Strategy):
     def configure(self, executor):
         if executor.config.mesh is None and self.mesh_axes:
             executor.config.mesh = make_mesh(self.mesh_axes)
+        if executor.config.mesh is None:
+            raise ValueError(
+                "ShardingPlan needs a mesh: pass mesh= to the Executor or "
+                "mesh_axes= to the plan (specs alone would silently run "
+                "replicated)")
         unknown = set(self.specs) - set(executor.variables)
         if unknown and self.strict:
             raise KeyError(f"ShardingPlan names unknown variables: "

@@ -45,7 +45,8 @@ from jax import shard_map
 # --------------------------------------------------------------------------- #
 
 def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
-                  axis="pp", checkpoint_stages=True):
+                  axis="pp", checkpoint_stages=True, mb_spec=None,
+                  stage_takes_tick=False):
     """Run ``microbatches`` through a pipeline of S stages over mesh axis
     ``axis`` in one SPMD program.
 
@@ -60,6 +61,12 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
       mesh: the device mesh containing ``axis``.
       checkpoint_stages: rematerialize each stage application in the
         backward pass (the usual memory/flops trade on TPU).
+      mb_spec: PartitionSpec for the microbatch array (default fully
+        replicated).  Pass e.g. ``P(None, 'dp')`` on a (pp, dp) mesh to
+        run one pipeline per data-parallel replica.
+      stage_takes_tick: call ``stage_fn(params, x, t)`` with the schedule
+        tick t — lets callers decorrelate per-microbatch state (e.g.
+        dropout RNG: microbatch index = t - stage).
 
     Returns ``[M, mb, ...]`` outputs of the last stage, replicated.
 
@@ -90,7 +97,7 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
             inp = jax.lax.dynamic_index_in_dim(
                 mb, jnp.clip(t, 0, M - 1), keepdims=False)
             x = jnp.where(stage == 0, inp, state)
-            y = fn(params, x)
+            y = fn(params, x, t) if stage_takes_tick else fn(params, x)
             # last stage emits microbatch t - (S-1); masked unconditional
             # write (lax.cond is off the table: branches would differ in
             # device-varyingness under shard_map's vma tracking)
@@ -112,7 +119,8 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
         return outputs
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    rep = P(*([None] * microbatches.ndim))
+    rep = mb_spec if mb_spec is not None \
+        else P(*([None] * microbatches.ndim))
     return shard_map(
         per_device, mesh=mesh,
         in_specs=(pspec, rep), out_specs=rep,
@@ -132,6 +140,35 @@ def shard_stacked_params(stacked, mesh, axis="pp"):
         spec = P(axis, *([None] * (x.ndim - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
     return jax.tree_util.tree_map(put, stacked)
+
+
+def ps_delta_sync(ps, params, snapshot):
+    """HetPipe's PS merge protocol (reference pipedream_subexecutor.py:
+    317-328): push the delta accumulated since the last sync (the server
+    ADDS pushes into its copy), pull the merged view, rebase.
+
+    ``params``: name-keyed numpy-able dict of current worker weights.
+    ``snapshot``: previous merged view, or None on the first sync — then
+    each key is seeded idempotently (exactly one worker wins the init and
+    pushes its full weights; a bare accumulate-push would sum every
+    worker's weights).  Works against PSServer (param_init) and PSClient
+    (parameter_init).  Returns (merged_params, new_snapshot)."""
+    init = getattr(ps, "param_init", None) or \
+        getattr(ps, "parameter_init", None)
+    merged_out, snap_out = {}, {}
+    first = snapshot is None
+    for k, v in params.items():
+        arr = np.asarray(v)
+        if first:
+            created = init(k, arr.shape) if init is not None else True
+            if created:
+                ps.push(k, arr)
+        else:
+            ps.push(k, arr - snapshot[k])
+        merged = np.asarray(ps.pull(k)).copy()
+        merged_out[k] = merged
+        snap_out[k] = merged
+    return merged_out, snap_out
 
 
 # --------------------------------------------------------------------------- #
@@ -296,34 +333,12 @@ class PipelineTrainer:
         return new_live
 
     def _ps_sync(self):
-        """HetPipe: push the param *delta* accumulated since the last sync
-        (the PS adds pushes into its copy — ps/server.py push — mirroring
-        the reference's server-side accumulate, pipedream_subexecutor.py:
-        317-328), then pull the merged view and rebase the snapshot."""
-        if self._ps_snapshot is None:
-            # first sync: seed the PS idempotently — exactly one worker wins
-            # param_init (it returns False if the key exists) and pushes its
-            # weights; everyone else just pulls the shared copy.  A bare
-            # accumulate-push here would sum every worker's full weights.
-            self._ps_snapshot = {}
-            for i, st in enumerate(self.stages):
-                for k, v in st.params.items():
-                    key = f"stage{i}/{k}"
-                    arr = np.asarray(v)
-                    created = True
-                    if hasattr(self.ps, "param_init"):
-                        created = self.ps.param_init(key, arr.shape)
-                    if created:
-                        self.ps.push(key, arr)
-                    self._ps_snapshot[key] = np.asarray(
-                        self.ps.pull(key)).copy()
-                    st.params[k] = jnp.asarray(self._ps_snapshot[key])
-            return
+        """HetPipe PS merge (shared protocol: ps_delta_sync above)."""
+        flat = {f"stage{i}/{k}": v
+                for i, st in enumerate(self.stages)
+                for k, v in st.params.items()}
+        merged, self._ps_snapshot = ps_delta_sync(
+            self.ps, flat, self._ps_snapshot)
         for i, st in enumerate(self.stages):
-            for k, v in st.params.items():
-                key = f"stage{i}/{k}"
-                delta = np.asarray(v) - self._ps_snapshot[key]
-                self.ps.push(key, delta)
-                merged = np.asarray(self.ps.pull(key)).copy()
-                self._ps_snapshot[key] = merged
-                st.params[k] = jnp.asarray(merged)
+            for k in st.params:
+                st.params[k] = jnp.asarray(merged[f"stage{i}/{k}"])

@@ -5,9 +5,9 @@ validate_results.py:11-17 asserts allclose vs the 1-device run).
 
 Here: one fixed-weight MLP driven through the *Executor* under every
 mesh layout; loss trajectories must match the single-device run to 1e-5.
-PP layouts are covered via the executor pipeline mode in
-test_pipeline_executor.py once the graph partitioner lands; expert
-parallelism in test_moe_mesh.py."""
+PP layouts (scan pipeline via Executor(pipeline=...), incl. composed
+dp x pp and dp x tp + microbatching) are in TestPipelineLayouts below;
+expert parallelism in test_moe_mesh.py."""
 
 import numpy as np
 import pytest
@@ -140,3 +140,56 @@ class TestAllLayouts:
             # eval before the step sees the same params the step consumes
             np.testing.assert_allclose(ev, tr, atol=1e-6)
             np.testing.assert_allclose(tr, base[k], atol=1e-5)
+
+
+class TestPipelineLayouts:
+    """PP rows of the layout matrix: the pipeline-capable residual MLP
+    from test_pipeline_executor driven through Executor(pipeline='gpipe')
+    under pp-only, dp x pp (SPMD scan pipeline), and dp x tp with
+    microbatching (GSPMD path)."""
+
+    @pytest.fixture(scope="class")
+    def pp_baseline(self):
+        from test_pipeline_executor import build_model, make_batches
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]})
+        w0 = ex.return_tensor_values()
+        batches = make_batches()
+        base = [float(np.asarray(
+            ex.run("train", feed_dict={x: a, y: b})[0]))
+            for a, b in batches]
+        return w0, batches, base
+
+    PP_LAYOUTS = {
+        "pp4": ({"pp": 4}, None),
+        "pp2xdp4": ({"pp": 2, "dp": 4}, None),
+        "dp2xtp2_mb": ({"dp": 2, "tp": 2},
+                       {"l0_w1": P(None, "tp"), "l0_b1": P("tp"),
+                        "l0_w2": P("tp", None),
+                        "l2_w1": P(None, "tp"), "l2_b1": P("tp"),
+                        "l2_w2": P("tp", None)}),
+    }
+
+    @pytest.mark.parametrize("layout", sorted(PP_LAYOUTS),
+                             ids=sorted(PP_LAYOUTS))
+    def test_pp_trajectory_matches(self, pp_baseline, layout):
+        from test_pipeline_executor import build_model
+        from hetu_tpu.parallel.mesh import make_mesh
+        w0, batches, base = pp_baseline
+        axes, specs = self.PP_LAYOUTS[layout]
+        x, y, loss, train = build_model()
+        mesh = make_mesh(axes)
+        strategy = ht.dist.ShardingPlan(specs) if specs else None
+        kw = dict(pipeline="gpipe", num_microbatches=4, mesh=mesh)
+        if strategy is not None:
+            kw["dist_strategy"] = strategy
+        if "pp" not in axes:
+            kw["num_stages"] = 2
+        ex = ht.Executor({"train": [loss, train]}, **kw)
+        if "pp" in axes:
+            assert ex.subexecutor["train"].spmd
+        ex.load_dict(w0)
+        tr = [float(np.asarray(
+            ex.run("train", feed_dict={x: a, y: b})[0]))
+            for a, b in batches]
+        np.testing.assert_allclose(tr, base, atol=1e-5)

@@ -221,8 +221,13 @@ class TestCheckpointAndKnobs:
         with pytest.raises(NotImplementedError):
             ht.Executor({"train": [loss, train]}, use_preduce=True)
         ids, y, loss, train = build_model()
+        with pytest.raises(ValueError):
+            ht.Executor({"train": [loss, train]}, pipeline="zigzag")
+        # pipeline + PS/Hybrid comm is the one unwired combination
+        ids, y, loss, train = build_model()
         with pytest.raises(NotImplementedError):
-            ht.Executor({"train": [loss, train]}, pipeline="gpipe")
+            ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                        comm_mode="Hybrid")
 
     def test_shared_table_multi_lookup_stays_on_device(self):
         """A table consumed by two lookups cannot live on the PS (summed

@@ -207,9 +207,6 @@ class TestShardMapA2A:
         train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
 
         mesh = make_mesh({"dcn": 2, "ici": 2})
-        for name, node in {"hier_expert_stack_w1": None,
-                           "hier_expert_stack_w2": None}.items():
-            pass
         ex = ht.Executor({"train": [loss, train]}, mesh=mesh)
         for name, node in ex.variables.items():
             if "expert_stack" in name:

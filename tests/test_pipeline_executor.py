@@ -1,0 +1,428 @@
+"""Pipeline as an executor mode (reference Executor(pipeline='gpipe')
+partitioning the built graph and driving microbatch subexecutors —
+gpipe_subexecutor.py:33-111, pipeline_subexecutor.py:29-81).
+
+The reference's tier-2 correctness criterion applies: the pipelined run's
+loss trajectory must equal the non-pipelined single-device run (GPipe and
+synchronous 1F1B are mathematically identical to full-batch training)."""
+
+import numpy as np
+import pytest
+
+import jax
+import hetu_tpu as ht
+from hetu_tpu.parallel.mesh import make_mesh
+from hetu_tpu.parallel.partition import partition
+
+
+BATCH, IN, HID, OUT = 16, 8, 16, 4
+N_LAYERS = 4
+N_STEPS = 6
+
+
+def build_model(opt=None, n_layers=N_LAYERS):
+    """Residual MLP with a uniform repeated body (the pipeline-friendly
+    shape: embedding-ish pre, N identical blocks, head + loss post)."""
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("y")
+    h = ht.linear_op(x, ht.init.xavier_uniform((IN, HID), name="in_w"),
+                     ht.init.zeros((HID,), name="in_b"))
+    for i in range(n_layers):
+        w1 = ht.init.xavier_uniform((HID, 2 * HID), name=f"l{i}_w1")
+        b1 = ht.init.zeros((2 * HID,), name=f"l{i}_b1")
+        w2 = ht.init.xavier_uniform((2 * HID, HID), name=f"l{i}_w2")
+        b2 = ht.init.zeros((HID,), name=f"l{i}_b2")
+        h = h + ht.linear_op(ht.gelu_op(ht.linear_op(h, w1, b1)), w2, b2)
+    logits = ht.matmul_op(h, ht.init.xavier_uniform((HID, OUT),
+                                                    name="head_w"))
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y), axes=0)
+    train = (opt or ht.optim.SGDOptimizer(learning_rate=0.1)).minimize(loss)
+    return x, y, loss, train
+
+
+def make_batches(n=N_STEPS, seed=11):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        xb = rng.randn(BATCH, IN).astype(np.float32)
+        yb = np.eye(OUT, dtype=np.float32)[xb[:, :OUT].argmax(axis=1)]
+        out.append((xb, yb))
+    return out
+
+
+def run_traj(ex, x, y, batches):
+    return [float(np.asarray(ex.run("train", feed_dict={x: a, y: b})[0]))
+            for a, b in batches]
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    x, y, loss, train = build_model()
+    ex = ht.Executor({"train": [loss, train]})
+    w0 = ex.return_tensor_values()
+    batches = make_batches()
+    base = run_traj(ex, x, y, batches)
+    assert base[-1] < base[0]
+    return w0, batches, base
+
+
+class TestPartitioner:
+    def test_uniform_body_found(self):
+        _, _, loss, _ = build_model()
+        plan = partition(loss, 2)
+        assert plan.uniform and plan.num_body_blocks() == N_LAYERS
+        names = [[p.name for p in blk] for blk in plan.body_params]
+        assert names[0] == ["l0_w1", "l0_b1", "l0_w2", "l0_b2"]
+        assert names[3] == ["l3_w1", "l3_b1", "l3_w2", "l3_b2"]
+
+    def test_trims_to_multiple_of_stages(self):
+        _, _, loss, _ = build_model()
+        plan = partition(loss, 3)
+        assert plan.uniform and plan.num_body_blocks() == 3
+        # l0 was trimmed into pre
+        assert any(n.name == "l0_w1" for n in plan.pre_nodes)
+
+    def test_shared_weight_defeats_stacking(self):
+        x = ht.placeholder_op("x")
+        y = ht.placeholder_op("y")
+        w = ht.init.xavier_uniform((IN, IN), name="shared_w")
+        h = x
+        for _ in range(4):
+            h = ht.gelu_op(ht.matmul_op(h, w))     # same w every block
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(
+                ht.matmul_op(h, ht.init.xavier_uniform((IN, OUT),
+                                                       name="hw")), y),
+            axes=0)
+        plan = partition(loss, 2)
+        assert not plan.uniform
+
+    def test_nonuniform_graph_no_body(self):
+        x = ht.placeholder_op("x")
+        y = ht.placeholder_op("y")
+        h = ht.gelu_op(ht.matmul_op(
+            x, ht.init.xavier_uniform((IN, HID), name="a")))
+        h = ht.tanh_op(ht.matmul_op(
+            h, ht.init.xavier_uniform((HID, HID), name="b")))
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(h, ht.init.xavier_uniform((HID, OUT),
+                                                   name="c")), y), axes=0)
+        plan = partition(loss, 2)
+        assert not plan.uniform
+        assert len(plan.blocks) >= 2    # cuts still found
+
+
+class TestHostPath:
+    """No 'pp' mesh axis: jitted microbatch-scan lowering."""
+
+    @pytest.mark.parametrize("mode", ["gpipe", "1f1b"])
+    def test_sync_modes_match_baseline(self, baseline, mode):
+        w0, batches, base = baseline
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, pipeline=mode,
+                         num_stages=2, num_microbatches=4)
+        ex.load_dict(w0)
+        tr = run_traj(ex, x, y, batches)
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_adam_matches_baseline(self, baseline):
+        _, batches, _ = baseline
+        x, y, loss, train = build_model(
+            ht.optim.AdamOptimizer(learning_rate=0.01))
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        base = run_traj(ex1, x, y, batches)
+        x, y, loss, train = build_model(
+            ht.optim.AdamOptimizer(learning_rate=0.01))
+        ex2 = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                          num_stages=4, num_microbatches=8)
+        ex2.load_dict(w0)
+        np.testing.assert_allclose(run_traj(ex2, x, y, batches), base,
+                                   atol=1e-5)
+
+    def test_pipedream_trains(self, baseline):
+        w0, batches, base = baseline
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, pipeline="pipedream",
+                         num_stages=2, num_microbatches=4)
+        ex.load_dict(w0)
+        tr = run_traj(ex, x, y, batches)
+        assert tr[-1] < tr[0]          # per-microbatch updates: trains,
+        assert not np.allclose(tr, base)   # but not the sync trajectory
+
+    def test_eval_subgraph_untouched(self, baseline):
+        """Forward-only subgraphs keep the plain jit path and see the
+        pipeline-updated params."""
+        w0, batches, base = baseline
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train], "eval": [loss]},
+                         pipeline="gpipe", num_microbatches=4,
+                         num_stages=2)
+        ex.load_dict(w0)
+        ev = float(np.asarray(ex.run(
+            "eval", feed_dict={x: batches[0][0], y: batches[0][1]})[0]))
+        np.testing.assert_allclose(ev, base[0], atol=1e-5)
+        tr = run_traj(ex, x, y, batches)
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_checkpoint_roundtrip(self, baseline, tmp_path):
+        w0, batches, base = baseline
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                         num_stages=2, num_microbatches=4)
+        ex.load_dict(w0)
+        run_traj(ex, x, y, batches[:3])
+        ex.save(str(tmp_path))
+        x, y, loss, train = build_model()
+        ex2 = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                          num_stages=2, num_microbatches=4)
+        ex2.load(str(tmp_path))
+        tr = run_traj(ex2, x, y, batches[3:])
+        np.testing.assert_allclose(tr, base[3:], atol=1e-5)
+
+
+class TestSPMDPath:
+    """'pp' mesh axis + uniform body: spmd_pipeline lowering."""
+
+    @pytest.mark.parametrize("axes", [{"pp": 4}, {"pp": 2, "dp": 2}],
+                             ids=["pp4", "pp2xdp2"])
+    def test_matches_baseline(self, baseline, axes):
+        w0, batches, base = baseline
+        x, y, loss, train = build_model()
+        mesh = make_mesh(axes)
+        ex = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                         mesh=mesh, num_microbatches=4)
+        assert ex.subexecutor["train"].spmd, "SPMD lowering not chosen"
+        ex.load_dict(w0)
+        tr = run_traj(ex, x, y, batches)
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_more_blocks_than_stages(self, baseline):
+        """R=4 blocks on pp=2: each stage scans 2 blocks."""
+        w0, batches, base = baseline
+        x, y, loss, train = build_model()
+        mesh = make_mesh({"pp": 2})
+        ex = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                         mesh=mesh, num_microbatches=4)
+        assert ex.subexecutor["train"].spmd
+        ex.load_dict(w0)
+        np.testing.assert_allclose(run_traj(ex, x, y, batches), base,
+                                   atol=1e-5)
+
+    def test_nonuniform_falls_back(self, baseline):
+        """Shared weights: SPMD refused, scan path still correct."""
+        mesh = make_mesh({"pp": 2})
+        x = ht.placeholder_op("x")
+        y = ht.placeholder_op("y")
+        w = ht.init.xavier_uniform((IN, IN), name="shared_w2")
+        h = x
+        for _ in range(2):
+            h = ht.gelu_op(ht.matmul_op(h, w))
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.matmul_op(h, ht.init.xavier_uniform((IN, OUT),
+                                                   name="hw2")), y), axes=0)
+        train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                         mesh=mesh, num_microbatches=4)
+        assert not ex.subexecutor["train"].spmd
+        w_before = np.array(ex.var_values["shared_w2"], copy=True)
+        batches = make_batches()
+        tr = run_traj(ex, x, y, batches)
+        assert np.all(np.isfinite(tr))
+        # the scan path really applied updates
+        assert not np.allclose(np.asarray(ex.var_values["shared_w2"]),
+                               w_before)
+
+
+class TestBert4L:
+    """The VERDICT's acceptance case: BERT-4L trains via
+    Executor(pipeline=...) matching the non-pipelined trajectory."""
+
+    B, S, H, L, V, M = 8, 16, 32, 4, 100, 4
+
+    def _build(self, batch):
+        """Graphs bake the batch dim into reshapes (static shapes), so the
+        pipelined graph is built at the MICROBATCH size — exactly how the
+        reference's pipeline examples set their per-worker dataloader."""
+        from hetu_tpu.models.bert import BertConfig, \
+            BertForSequenceClassification
+        cfg = BertConfig(vocab_size=self.V, hidden_size=self.H,
+                         num_hidden_layers=self.L, num_attention_heads=2,
+                         intermediate_size=2 * self.H, seq_len=self.S,
+                         batch_size=batch, hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        ids = ht.placeholder_op("input_ids")
+        labels = ht.placeholder_op("labels")
+        model = BertForSequenceClassification(cfg, num_labels=3)
+        loss, _ = model(ids, labels=labels)
+        # SGD: linear in the gradient, so microbatch-mean == full-batch
+        # math is fp-stable.  (Adam's rsqrt-normalized update amplifies
+        # ~1e-8 summation-order noise on near-zero grads into visible
+        # trajectory divergence — true of the reference as well.)
+        train = ht.optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        return ids, labels, loss, train
+
+    def _batches(self, n=4, seed=5):
+        rng = np.random.RandomState(seed)
+        return [(rng.randint(0, self.V, (self.B, self.S)).astype(np.int32),
+                 rng.randint(0, 3, (self.B,)).astype(np.int32))
+                for _ in range(n)]
+
+    def test_bert_pipeline_matches_baseline(self):
+        ids, labels, loss, train = self._build(self.B)
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        batches = self._batches()
+        base = [float(np.asarray(ex1.run(
+            "train", feed_dict={ids: a, labels: b})[0]))
+            for a, b in batches]
+
+        ids, labels, loss, train = self._build(self.B // self.M)
+        mesh = make_mesh({"pp": 2})
+        ex2 = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                          mesh=mesh, num_microbatches=self.M)
+        sub = ex2.subexecutor["train"]
+        assert sub.plan.uniform and sub.plan.num_body_blocks() == self.L
+        assert sub.spmd
+        ex2.load_dict(w0)
+        tr = [float(np.asarray(ex2.run(
+            "train", feed_dict={ids: a, labels: b})[0]))
+            for a, b in batches]
+        np.testing.assert_allclose(tr, base, rtol=2e-4)
+
+
+class TestHetPipe:
+    def test_hetpipe_syncs_via_ps(self, baseline):
+        from hetu_tpu.ps.server import PSServer
+        w0, batches, _ = baseline
+        x, y, loss, train = build_model()
+        ps = PSServer()
+        ex = ht.Executor({"train": [loss, train]}, pipeline="hetpipe",
+                         num_stages=2, num_microbatches=4, ps_comm=ps,
+                         sync_every=2)
+        ex.load_dict(w0)
+        tr = run_traj(ex, x, y, batches)
+        assert tr[-1] < tr[0]
+        sub = ex.subexecutor["train"]
+        assert sub._ps_snapshot is not None     # sync actually ran
+        # server copy agrees with the post-sync worker copy
+        np.testing.assert_allclose(
+            np.asarray(ps.pull("l0_w1")), sub._ps_snapshot["l0_w1"])
+
+
+class TestReviewRegressions:
+    def test_hetpipe_default_ps_client(self, baseline):
+        """hetpipe with no explicit ps_comm goes through PSClient, whose
+        init method is parameter_init (not param_init) — the sync helper
+        must handle both."""
+        w0, batches, _ = baseline
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, pipeline="hetpipe",
+                         num_stages=2, num_microbatches=4, sync_every=1)
+        ex.load_dict(w0)
+        tr = run_traj(ex, x, y, batches[:2])
+        assert np.all(np.isfinite(tr))
+        assert ex.subexecutor["train"]._ps_snapshot is not None
+
+    def test_tied_weights_across_pre_post(self, baseline):
+        """A weight used both before and after the uniform body (tied
+        embedding/LM-head pattern): SPMD path must bind it on demand in
+        the post segment and sum both uses' grads."""
+        def build_tied():
+            x = ht.placeholder_op("x")
+            y = ht.placeholder_op("y")
+            w_in = ht.init.xavier_uniform((IN, HID), name="tied_w")
+            h = ht.matmul_op(x, w_in)
+            for i in range(2):
+                w1 = ht.init.xavier_uniform((HID, HID), name=f"t{i}_w1")
+                b1 = ht.init.zeros((HID,), name=f"t{i}_b1")
+                h = h + ht.gelu_op(ht.linear_op(h, w1, b1))
+            logits = ht.matmul_op(h, w_in, trans_B=True)   # tied reuse
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(logits, y), axes=0)
+            train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            return x, y, loss, train
+
+        rng = np.random.RandomState(2)
+        batches = [(rng.randn(BATCH, IN).astype(np.float32),
+                    np.eye(IN, dtype=np.float32)[
+                        rng.randint(0, IN, BATCH)])
+                   for _ in range(4)]
+        x, y, loss, train = build_tied()
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        base = run_traj(ex1, x, y, batches)
+
+        from hetu_tpu.parallel.mesh import make_mesh
+        x, y, loss, train = build_tied()
+        ex2 = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                          mesh=make_mesh({"pp": 2}), num_microbatches=4)
+        assert ex2.subexecutor["train"].spmd
+        ex2.load_dict(w0)
+        np.testing.assert_allclose(run_traj(ex2, x, y, batches), base,
+                                   atol=1e-5)
+
+    def test_bn_state_chains_through_microbatches(self):
+        """Pipedream == stepping the baseline once per microbatch: BN
+        running stats must chain sequentially through the scan carry, not
+        keep only the last microbatch's update."""
+        def build_bn():
+            x = ht.placeholder_op("x")
+            y = ht.placeholder_op("y")
+            h = ht.linear_op(x, ht.init.xavier_uniform((IN, HID),
+                                                       name="bn_in_w"),
+                             ht.init.zeros((HID,), name="bn_in_b"))
+            h = ht.layers.BatchNorm(HID, name="bn0")(h)
+            logits = ht.matmul_op(h, ht.init.xavier_uniform(
+                (HID, OUT), name="bn_head"))
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(logits, y), axes=0)
+            train = ht.optim.SGDOptimizer(
+                learning_rate=0.1).minimize(loss)
+            return x, y, loss, train
+
+        M = 4
+        mb = BATCH // M
+        rng = np.random.RandomState(7)
+        xb = rng.randn(BATCH, IN).astype(np.float32)
+        yb = np.eye(OUT, dtype=np.float32)[rng.randint(0, OUT, BATCH)]
+
+        # reference: baseline stepped once per microbatch, sequentially
+        x, y, loss, train = build_bn()
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        for m in range(M):
+            ex1.run("train", feed_dict={x: xb[m * mb:(m + 1) * mb],
+                                        y: yb[m * mb:(m + 1) * mb]})
+        ref = ex1.return_tensor_values()
+
+        x, y, loss, train = build_bn()
+        ex2 = ht.Executor({"train": [loss, train]}, pipeline="pipedream",
+                          num_stages=2, num_microbatches=M)
+        ex2.load_dict(w0)
+        ex2.run("train", feed_dict={x: xb, y: yb})
+        got = ex2.return_tensor_values()
+        for k in ref:
+            np.testing.assert_allclose(got[k], ref[k], atol=1e-5,
+                                       err_msg=k)
+
+
+class TestValidation:
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError):
+            ht.HetuConfig(pipeline="zigzag")
+
+    def test_microbatch_divisibility_checked(self, baseline):
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                         num_stages=2, num_microbatches=5)
+        with pytest.raises(ValueError, match="divisible"):
+            ex.run("train", feed_dict={
+                x: np.zeros((16, IN), np.float32),
+                y: np.zeros((16, OUT), np.float32)})
+
+    def test_ps_comm_mode_rejected(self):
+        x, y, loss, train = build_model()
+        with pytest.raises(NotImplementedError):
+            ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                        comm_mode="Hybrid")
