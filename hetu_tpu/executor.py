@@ -91,7 +91,8 @@ class HetuConfig:
                  pipeline=None, overlap=True, use_preduce=False,
                  use_nccl_collectives=True, seed=0, mesh=None,
                  num_microbatches=None, num_stages=None, sync_every=None,
-                 dtype=jnp.float32, mixed_precision=None, ps_comm=None):
+                 non_batch_feeds=(), dtype=jnp.float32,
+                 mixed_precision=None, ps_comm=None):
         if comm_mode not in (None, "AllReduce", "PS", "Hybrid"):
             raise ValueError(f"comm_mode must be None/'AllReduce'/'PS'/"
                              f"'Hybrid', got {comm_mode!r}")
@@ -112,6 +113,10 @@ class HetuConfig:
         self.pipeline = pipeline
         self.num_stages = num_stages
         self.sync_every = sync_every
+        # pipeline mode: feed names that are per-step constants (e.g. an
+        # [S, S] attention mask), passed whole to every microbatch rather
+        # than split along dim 0
+        self.non_batch_feeds = tuple(non_batch_feeds)
         self.overlap = overlap
         if use_preduce:
             raise NotImplementedError(
@@ -132,6 +137,30 @@ class HetuConfig:
             mixed_precision = jnp.float16
         self.mixed_precision = mixed_precision
         self.ps_comm = ps_comm
+
+
+def gather_feeds(sub, feed_dict):
+    """Collect dataloader + fed values into a name-keyed dict, coercing
+    dtypes host-side.  Device-resident jax.Arrays pass through untouched
+    (np.asarray on them would force a blocking D2H)."""
+    feeds = {}
+    for dl in sub.dataloader_ops:
+        feeds[dl.name] = dl.get_arr(sub.name)
+    for node, value in feed_dict.items():
+        name = node.name if isinstance(node, Op) else node
+        feeds[name] = value
+    for name in list(feeds):
+        v = feeds[name]
+        if isinstance(v, jax.Array) and v.dtype not in (
+                jnp.float64, jnp.int64):
+            continue
+        arr = np.asarray(v)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        if arr.dtype == np.int64:
+            arr = arr.astype(np.int32)
+        feeds[name] = arr
+    return feeds
 
 
 class SubExecutor:
@@ -289,23 +318,7 @@ class SubExecutor:
 
     def run(self, feed_dict, convert_to_numpy_ret_vals=False):
         ex = self.executor
-        feeds = {}
-        for dl in self.dataloader_ops:
-            feeds[dl.name] = dl.get_arr(self.name)
-        for node, value in feed_dict.items():
-            name = node.name if isinstance(node, Op) else node
-            feeds[name] = value
-        for name in list(feeds):
-            v = feeds[name]
-            if isinstance(v, jax.Array) and v.dtype not in (
-                    jnp.float64, jnp.int64):
-                continue  # already device-resident; avoid a blocking D2H
-            arr = np.asarray(v)
-            if arr.dtype == np.float64:
-                arr = arr.astype(np.float32)
-            if arr.dtype == np.int64:
-                arr = arr.astype(np.int32)
-            feeds[name] = arr
+        feeds = gather_feeds(self, feed_dict)
         ps_ids = self._ps_phase_a(feeds)
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feeds.items()))

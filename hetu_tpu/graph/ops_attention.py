@@ -11,14 +11,22 @@ from __future__ import annotations
 from .node import SimpleOp
 
 
-def flash_attention_op(q, k, v, causal=False, block_q=128, block_k=128,
+def flash_attention_op(q, k, v, causal=False, block_q=None, block_k=None,
                        ctx=None):
-    """Fused attention on [B, S, H, D] q/k/v nodes -> [B, S, H, D]."""
+    """Fused attention on [B, S, H, D] q/k/v nodes -> [B, S, H, D].
+
+    block_q/block_k default to the kernel's tuned values (single source
+    of truth in kernels/flash_attention.py)."""
     from ..kernels.flash_attention import flash_attention
 
+    kw = {}
+    if block_q is not None:
+        kw["block_q"] = block_q
+    if block_k is not None:
+        kw["block_k"] = block_k
+
     def fn(q, k, v):
-        return flash_attention(q, k, v, causal=causal,
-                               block_q=block_q, block_k=block_k)
+        return flash_attention(q, k, v, causal=causal, **kw)
 
     return SimpleOp(fn, q, k, v, name="FlashAttention", ctx=ctx)
 

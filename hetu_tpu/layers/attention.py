@@ -22,7 +22,7 @@ from ..graph import (
 class MultiHeadAttention(BaseLayer):
     def __init__(self, hidden_size, num_heads, seq_len, batch_size,
                  dropout_rate=0.0, initializer=None, name="attn",
-                 use_flash=False, causal=False, block_q=128, block_k=128):
+                 use_flash=False, causal=False, block_q=512, block_k=1024):
         assert hidden_size % num_heads == 0
         self.h = hidden_size
         self.nh = num_heads

@@ -34,6 +34,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..graph.autodiff import find_topo_sort
+from ..graph.node import Op
 from ..graph.ops_misc import PlaceholderOp
 
 
@@ -45,6 +46,18 @@ _SKIP_ATTRS = frozenset({
 
 def _simple(v):
     return isinstance(v, (int, float, bool, str, type(None)))
+
+
+def _array_digest(v):
+    """Content digest for array-valued statics (closure constants like
+    lookup tables or assignment masks).  Without this, two layers that
+    differ only in a constant array would fingerprint equal and the
+    template-stacked SPMD body would silently use layer 0's constant
+    everywhere."""
+    import numpy as _np
+    a = _np.asarray(v)
+    return ("array", a.shape, str(a.dtype),
+            int(_np.int64(abs(hash(a.tobytes())))))
 
 
 def _callable_fingerprint(f):
@@ -61,10 +74,18 @@ def _callable_fingerprint(f):
             continue
         if _simple(v):
             items.append(v)
+        elif isinstance(v, Op):
+            items.append(("op", type(v).__name__))
         elif isinstance(v, (tuple, list)) and all(_simple(e) for e in v):
             items.append(tuple(v))
+        elif hasattr(v, "shape") and hasattr(v, "dtype"):
+            items.append(_array_digest(v))
         elif callable(v):
             items.append(getattr(v, "__qualname__", "fn"))
+        else:
+            # unknown static: include its type so at least differently-
+            # typed closures never collide
+            items.append(("opaque", type(v).__name__))
     return tuple(items)
 
 
@@ -77,11 +98,15 @@ def _attr_fingerprint(node):
         v = vars(node)[k]
         if _simple(v):
             items.append((k, v))
+        elif isinstance(v, Op):
+            items.append((k, ("op", type(v).__name__)))
         elif isinstance(v, (tuple, list)):
             if all(_simple(e) for e in v):
                 items.append((k, tuple(v)))
             else:
                 items.append((k, len(v)))
+        elif hasattr(v, "shape") and hasattr(v, "dtype"):
+            items.append((k, _array_digest(v)))
         elif callable(v):
             items.append((k, _callable_fingerprint(v)))
     return tuple(items)

@@ -89,6 +89,7 @@ class PipelineSubExecutor:
         self.ps_var_names = frozenset()
 
         self.topo = find_topo_sort([self.loss_node])
+        self.non_batch_feeds = frozenset(cfg.non_batch_feeds or ())
         # stateful layers (BN running stats): their updates must chain
         # microbatch-to-microbatch through the scan carry
         self.state_var_names = sorted({
@@ -227,15 +228,24 @@ class PipelineSubExecutor:
     # ------------------------------------------------------------------ #
 
     def _split_microbatches(self, feeds):
+        """Batched feeds -> [M, mb, ...]; feeds named in
+        config.non_batch_feeds (per-step constants like attention masks)
+        are NOT split — each microbatch sees them whole."""
         M = self.num_microbatches
-        out = {}
+        skip = self.non_batch_feeds
+        split, whole = {}, {}
         for k, v in feeds.items():
-            if v.ndim == 0 or v.shape[0] % M:
+            if k in skip:
+                whole[k] = v
+            elif v.ndim == 0 or v.shape[0] % M:
                 raise ValueError(
                     f"feed '{k}' batch dim {v.shape} not divisible by "
-                    f"num_microbatches={M}")
-            out[k] = v.reshape(M, v.shape[0] // M, *v.shape[1:])
-        return out
+                    f"num_microbatches={M}; if it is a per-step constant "
+                    f"rather than a batch, list it in "
+                    f"HetuConfig(non_batch_feeds=...)")
+            else:
+                split[k] = v.reshape(M, v.shape[0] // M, *v.shape[1:])
+        return split, whole
 
     def _make_step_fn(self):
         ex = self.executor
@@ -255,7 +265,7 @@ class PipelineSubExecutor:
             loss_of = None
 
         def step_fn(params, opt_states, step, rng, feeds):
-            mb = self._split_microbatches(feeds)
+            mb, whole = self._split_microbatches(feeds)
             rngs = jax.random.split(rng, M)
             tp, frozen = split_params(params)
             ostate = opt_states[opt_name]
@@ -271,7 +281,8 @@ class PipelineSubExecutor:
             if self.mode in ("gpipe", "1f1b"):
                 if loss_of is not None:
                     def total_loss(tp_):
-                        return loss_of({**frozen, **tp_}, mb, rngs, step)
+                        return loss_of({**frozen, **tp_}, mb, whole,
+                                       rngs, step)
                     loss, grads = jax.value_and_grad(total_loss)(tp)
                     state_fin = state0
                 else:
@@ -281,7 +292,8 @@ class PipelineSubExecutor:
 
                         def mb_loss(tp_):
                             return self._forward_loss(
-                                {**frozen, **st, **tp_}, fmb, r, step)
+                                {**frozen, **st, **tp_},
+                                {**fmb, **whole}, r, step)
                         (l, ex_), g = jax.value_and_grad(
                             mb_loss, has_aux=True)(tp)
                         return (_tree_add(acc, g),
@@ -301,7 +313,8 @@ class PipelineSubExecutor:
 
                     def mb_loss(tp_):
                         return self._forward_loss(
-                            {**frozen, **st, **tp_}, fmb, r, step)
+                            {**frozen, **st, **tp_},
+                            {**fmb, **whole}, r, step)
                     (l, ex_), g = jax.value_and_grad(
                         mb_loss, has_aux=True)(tp_c)
                     tp_n, ostate_n = self._apply_opt(tp_c, g, ostate_c, step)
@@ -327,13 +340,14 @@ class PipelineSubExecutor:
         n_pos = len(plan.body_blocks[0].params)
         mb_spec = P(None, "dp") if "dp" in mesh.axis_names else None
 
-        def loss_of(params, mb, rngs, step):
+        def loss_of(params, mb, whole, rngs, step):
             cfg = ex.config
 
             def pre_one(fmb, r):
                 tc = TraceContext(params={}, rng=r, training=True,
                                   mesh=mesh, config=cfg, step=step)
-                vals = self._trace_nodes(plan.pre_nodes, params, fmb, tc)
+                vals = self._trace_nodes(plan.pre_nodes, params,
+                                         {**fmb, **whole}, tc)
                 return vals[id(plan.body_entry)]
 
             xs = jax.vmap(pre_one)(mb, rngs)     # [M, mb, ...]
@@ -378,7 +392,8 @@ class PipelineSubExecutor:
                                   training=True, mesh=mesh, config=cfg,
                                   step=step)
                 seed = {id(plan.body_blocks[-1].boundary_out): y}
-                vals = self._trace_nodes(plan.post_nodes, params, fmb, tc,
+                vals = self._trace_nodes(plan.post_nodes, params,
+                                         {**fmb, **whole}, tc,
                                          seed_vals=seed)
                 return vals[id(self.loss_node)].astype(jnp.float32)
 
@@ -412,20 +427,9 @@ class PipelineSubExecutor:
         return min(nums) if nums else None
 
     def run(self, feed_dict, convert_to_numpy_ret_vals=False):
+        from .executor import gather_feeds
         ex = self.executor
-        feeds = {}
-        for dl in self.dataloader_ops:
-            feeds[dl.name] = dl.get_arr(self.name)
-        for node, value in feed_dict.items():
-            name = node.name if isinstance(node, Op) else node
-            feeds[name] = value
-        for name in list(feeds):
-            arr = np.asarray(feeds[name])
-            if arr.dtype == np.float64:
-                arr = arr.astype(np.float32)
-            if arr.dtype == np.int64:
-                arr = arr.astype(np.int32)
-            feeds[name] = arr
+        feeds = gather_feeds(self, feed_dict)
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feeds.items()))
         if feed_sig not in self._compiled:

@@ -426,3 +426,53 @@ class TestValidation:
         with pytest.raises(NotImplementedError):
             ht.Executor({"train": [loss, train]}, pipeline="gpipe",
                         comm_mode="Hybrid")
+
+
+class TestNonBatchFeeds:
+    def test_mask_feed_passed_whole(self, baseline):
+        """A per-step constant feed (here a [HID, HID]-shaped additive
+        term whose dim 0 happens to divide num_microbatches) must NOT be
+        split along dim 0 when listed in non_batch_feeds."""
+        w0, batches, base = baseline
+
+        def build_with_const():
+            x = ht.placeholder_op("x")
+            y = ht.placeholder_op("y")
+            c = ht.placeholder_op("cmask")       # [HID, HID] constant
+            h = ht.linear_op(x, ht.init.xavier_uniform((IN, HID),
+                                                       name="nb_in_w"),
+                             ht.init.zeros((HID,), name="nb_in_b"))
+            h = ht.matmul_op(h, c) + h
+            logits = ht.matmul_op(h, ht.init.xavier_uniform(
+                (HID, OUT), name="nb_head"))
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(logits, y), axes=0)
+            train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            return x, y, c, loss, train
+
+        cmask = (np.eye(HID) * 0.1).astype(np.float32)
+
+        x, y, c, loss, train = build_with_const()
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        base = [float(np.asarray(ex1.run("train", feed_dict={
+            x: a, y: b, c: cmask})[0])) for a, b in batches]
+
+        x, y, c, loss, train = build_with_const()
+        ex2 = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                          num_stages=2, num_microbatches=4,
+                          non_batch_feeds=("cmask",))
+        ex2.load_dict(w0)
+        tr = [float(np.asarray(ex2.run("train", feed_dict={
+            x: a, y: b, c: cmask})[0])) for a, b in batches]
+        np.testing.assert_allclose(tr, base, atol=1e-5)
+
+    def test_unlisted_indivisible_feed_error_mentions_knob(self, baseline):
+        w0, batches, _ = baseline
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                         num_stages=2, num_microbatches=4)
+        with pytest.raises(ValueError, match="non_batch_feeds"):
+            ex.run("train", feed_dict={
+                x: np.zeros((15, IN), np.float32),
+                y: np.zeros((15, OUT), np.float32)})
