@@ -18,8 +18,9 @@ from .cost_model import (ClusterSpec, LayerSpec, MemoryCostModel,
                          candidate_strategies)
 from .search import DPAlg, ParallelPlan, PlannerSearch, \
     pipeline_division_even
-from .profiler import (measure_cluster, profile_collective_bandwidth,
-                       profile_layer, profile_matmul_throughput)
+from .profiler import (calibrate_layers, graph_layer_fn, measure_cluster,
+                       profile_collective_bandwidth, profile_layer,
+                       profile_matmul_throughput)
 from .apply import AutoParallel, plan_to_json
 
 __all__ = [
@@ -27,5 +28,6 @@ __all__ = [
     "ParallelStrategy", "candidate_strategies", "DPAlg", "ParallelPlan",
     "PlannerSearch", "pipeline_division_even", "measure_cluster",
     "profile_collective_bandwidth", "profile_layer",
-    "profile_matmul_throughput", "AutoParallel", "plan_to_json",
+    "profile_matmul_throughput", "calibrate_layers", "graph_layer_fn",
+    "AutoParallel", "plan_to_json",
 ]

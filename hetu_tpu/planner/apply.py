@@ -57,6 +57,12 @@ class AutoParallel(BaseSearchingStrategy):
         if executor.config.mesh is None:
             want = {k: v for k, v in axes.items() if v > 1} or {"dp": 1}
             executor.config.mesh = make_mesh(want)
+        # a plan with pp > 1 drives the pipeline executor mode (strategies
+        # configure before subexecutors are built, so this takes effect)
+        if axes.get("pp", 1) > 1 and executor.config.pipeline is None:
+            executor.config.pipeline = "gpipe"
+            if executor.config.num_microbatches is None:
+                executor.config.num_microbatches = 2 * axes["pp"]
         mesh_axes = set(executor.config.mesh.axis_names)
         for name, node in executor.variables.items():
             if node.sharding_spec is not None or not node.shape:

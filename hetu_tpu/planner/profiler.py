@@ -42,6 +42,45 @@ def profile_matmul_throughput(dim=4096, dtype=jnp.bfloat16):
     return 2.0 * dim ** 3 / t
 
 
+def graph_layer_fn(output_node, feed_node):
+    """Jitted ``x -> output`` from a built graph block — lets the profiler
+    time REAL model layers (built from the hetu_tpu graph API) instead of
+    analytic stand-ins.  Reference counterpart: Galvatron's per-model
+    profile scripts time the actual torch modules
+    (bert/profile_forward.py)."""
+    from ..executor import Executor
+    ex = Executor({"fwd": [output_node]})
+    sub = ex.subexecutor["fwd"]
+    params = dict(ex.var_values)
+
+    def fn(x):
+        _, _, outputs, _ = sub._trace(
+            params, {}, jnp.zeros((), jnp.int32), jax.random.PRNGKey(0),
+            {feed_node.name: x})
+        return outputs[0]
+
+    return jax.jit(fn)
+
+
+def calibrate_layers(layers, layer_fns, batch=8, dtype=jnp.float32):
+    """Measure each layer callable and write the result into its
+    LayerSpec.fwd_time_per_sample (the TimeCostModel then uses measured
+    time instead of the flops estimate).  ``layer_fns`` may be shorter
+    than ``layers``: the last fn calibrates the remaining (identical)
+    layers — the common N-identical-encoder case profiles once."""
+    times = []
+    for i, spec in enumerate(layers):
+        if i < len(layer_fns):
+            fn = layer_fns[i]
+            t = profile_layer(fn, (spec.seq_len, spec.hidden),
+                              batch=batch, dtype=dtype)
+            times.append(t)
+        else:
+            t = times[-1]
+        spec.fwd_time_per_sample = t
+    return layers
+
+
 def profile_collective_bandwidth(mesh, axis, size_mb=16):
     """Achieved allreduce bandwidth (algorithm bytes/s) over one mesh
     axis, via shard_map psum."""
@@ -72,14 +111,16 @@ def profile_layer(layer_fn, sample_shape, batch=8, dtype=jnp.float32,
     return t / batch
 
 
-def measure_cluster(mesh=None, n_devices=None, hbm_bytes=None):
+def measure_cluster(mesh=None, n_devices=None, hbm_bytes=None,
+                    probe_dim=4096):
     """Build a ClusterSpec from live measurements (analytic defaults fill
-    anything unmeasurable on the current backend)."""
+    anything unmeasurable on the current backend).  ``probe_dim`` sizes
+    the matmul probe — shrink it on slow backends (CPU tests)."""
     spec = ClusterSpec()
     spec.n_devices = n_devices or (
         int(np.prod(list(mesh.shape.values()))) if mesh is not None
         else jax.device_count())
-    achieved = profile_matmul_throughput()
+    achieved = profile_matmul_throughput(dim=probe_dim)
     spec.flops_per_sec = achieved
     spec.mfu = 1.0  # 'achieved' already folds utilization in
     if hbm_bytes:

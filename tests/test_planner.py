@@ -206,3 +206,101 @@ class TestAutoParallelStrategy:
             "jax").sharding.PartitionSpec(None, "tp")
         assert specs["l0_ffn_wo"] == __import__(
             "jax").sharding.PartitionSpec("tp", None)
+
+
+class TestClosedLoop:
+    """The full Galvatron loop in one test: profile a REAL graph-built
+    layer -> calibrate the cost models -> search -> apply -> execute on
+    the 8-device CPU mesh (reference: test_env scripts ->
+    cost-model configs -> search_layerwise_hp -> Galvatron runtime)."""
+
+    H, S, L, V, GBS = 32, 16, 4, 100, 16
+
+    def _specs(self):
+        return [LayerSpec.transformer_encoder(self.H, self.S,
+                                              name=f"l{i}")
+                for i in range(self.L)]
+
+    def test_profile_calibrate_search_apply_run(self):
+        from hetu_tpu.models.bert import BertConfig, BertLayer, \
+            BertForSequenceClassification
+        from hetu_tpu.planner import calibrate_layers, graph_layer_fn, \
+            measure_cluster
+
+        # 1. profile a real encoder block built from the graph API
+        cfg = BertConfig(vocab_size=self.V, hidden_size=self.H,
+                         num_hidden_layers=1, num_attention_heads=2,
+                         intermediate_size=4 * self.H, seq_len=self.S,
+                         batch_size=4, hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        xin = ht.placeholder_op("cl_profile_x")
+        fn = graph_layer_fn(BertLayer(cfg, name="cl_profile")(xin), xin)
+
+        # 2. calibrate cluster + layer specs from measurements
+        cluster = measure_cluster(n_devices=8, probe_dim=128)
+        assert cluster.flops_per_sec > 0
+        layers = self._specs()
+        calibrate_layers(layers, [lambda x: fn(
+            x.reshape(-1, self.H))], batch=4)
+        assert all(l.fwd_time_per_sample and l.fwd_time_per_sample > 0
+                   for l in layers)
+
+        # 3. memory pressure: pure DP must NOT fit, so the search is
+        # forced off the naive strategy ("beats naive DP" concretely:
+        # naive DP is infeasible, the plan is feasible and executes)
+        pure_dp = ParallelStrategy(dp=8)
+        dp_mem = MemoryCostModel(pure_dp, layers[0], self.GBS,
+                                 cluster).total
+        cluster.hbm_bytes = dp_mem * 0.8 / 0.9   # cap below pure-DP need
+        search = PlannerSearch(layers, global_batch_size=self.GBS,
+                               cluster=cluster, mem_unit=4 * 1024,
+                               allow_cp=False)
+        plan = search.search()
+        assert plan is not None, "no feasible plan found"
+        assert all(str(s) != str(pure_dp) for s in plan.strategies)
+        assert np.isfinite(plan.cost)
+
+        # 4-5. apply + run: build the real model, train under the plan
+        pp = plan.mesh_axes().get("pp", 1)
+        num_mb = 2 * pp if pp > 1 else 1
+        mcfg = BertConfig(vocab_size=self.V, hidden_size=self.H,
+                          num_hidden_layers=self.L,
+                          num_attention_heads=2,
+                          intermediate_size=4 * self.H, seq_len=self.S,
+                          batch_size=self.GBS // num_mb,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+        ids = ht.placeholder_op("cl_ids")
+        labels = ht.placeholder_op("cl_labels")
+        model = BertForSequenceClassification(mcfg, num_labels=2)
+        loss, _ = model(ids, labels=labels)
+        train = ht.optim.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+        from hetu_tpu.planner import AutoParallel
+        ex = ht.Executor({"train": [loss, train]},
+                         dist_strategy=AutoParallel(plan))
+        rng = np.random.RandomState(0)
+        for _ in range(2):
+            xb = rng.randint(0, self.V,
+                             (self.GBS, self.S)).astype(np.int32)
+            yb = rng.randint(0, 2, (self.GBS,)).astype(np.int32)
+            out = ex.run("train", feed_dict={ids: xb, labels: yb})
+            assert np.isfinite(float(np.asarray(out[0])))
+
+    def test_pp_plan_drives_pipeline_mode(self):
+        """A plan with pp>1 turns on Executor(pipeline='gpipe')."""
+        from hetu_tpu.planner import ParallelPlan
+        layers = self._specs()
+        strat = ParallelStrategy(pp=2, dp=4)
+        plan = ParallelPlan([strat] * self.L, layers, 1e-3, _cluster())
+        from test_pipeline_executor import build_model
+        x, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]},
+                         dist_strategy=AutoParallel(plan))
+        assert ex.config.pipeline == "gpipe"
+        sub = ex.subexecutor["train"]
+        assert sub.spmd    # uniform residual-MLP body on the pp mesh
+        xb = np.random.RandomState(1).randn(16, 8).astype(np.float32)
+        yb = np.eye(4, dtype=np.float32)[np.random.RandomState(2)
+                                         .randint(0, 4, 16)]
+        out = ex.run("train", feed_dict={x: xb, y: yb})
+        assert np.isfinite(float(np.asarray(out[0])))
