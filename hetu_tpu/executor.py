@@ -139,10 +139,53 @@ class HetuConfig:
         self.ps_comm = ps_comm
 
 
+# below this per-batch size the background device_put costs more (thread
+# contention on dispatch) than the H2D it hides; measured on the v5e
+# tunnel, small batches run fastest with host-only ring assembly
+_RING_DEVICE_PUT_MIN_BYTES = 4 << 20
+
+
+def _wire_prefetch(sub):
+    """Start background prefetch rings for this subgraph's dataloaders
+    (config.prefetch; reference 3-deep ring, dataloader.py:30-100).
+
+    Loaders feeding PS embedding lookups stay host-side — phase A needs
+    the raw ids as numpy.  Large batches additionally device_put (with
+    the feed sharding) inside the ring so the H2D transfer leaves the
+    critical path; small batches stay host-only (the put is cheaper than
+    the thread contention it causes)."""
+    ex = sub.executor
+    if not ex.config.prefetch:
+        return
+    ps_srcs = {id(lk.inputs[1]) for lk in getattr(sub, "ps_lookups", [])}
+    for dl_op in sub.dataloader_ops:
+        loaders = getattr(dl_op, "dataloaders", None)
+        loader = loaders.get(sub.name) if loaders else None
+        if loader is None or loader._ring is not None:
+            continue
+        transform = None
+        if id(dl_op) not in ps_srcs:
+            loader.init_states()
+            nbytes = int(np.prod(loader.shape)) * \
+                loader.data.dtype.itemsize
+            if nbytes >= _RING_DEVICE_PUT_MIN_BYTES:
+                def transform(arr, _n=dl_op.name):
+                    arr = np.asarray(arr)
+                    if arr.dtype == np.float64:
+                        arr = arr.astype(np.float32)
+                    if arr.dtype == np.int64:
+                        arr = arr.astype(np.int32)
+                    return ex.device_put_feed(_n, arr)
+        loader.start_prefetch(transform=transform)
+
+
 def gather_feeds(sub, feed_dict):
     """Collect dataloader + fed values into a name-keyed dict, coercing
     dtypes host-side.  Device-resident jax.Arrays pass through untouched
     (np.asarray on them would force a blocking D2H)."""
+    if not getattr(sub, "_prefetch_wired", False):
+        sub._prefetch_wired = True
+        _wire_prefetch(sub)
     feeds = {}
     for dl in sub.dataloader_ops:
         feeds[dl.name] = dl.get_arr(sub.name)
