@@ -95,3 +95,92 @@ class TestCLI:
                      f"open({marker!r}, 'w').write('1')"])
         assert code == 0
         assert os.path.exists(marker)
+
+
+class TestHeturnTrainEndToEnd:
+    """The full reference tier-3 flow: `heturun -c cluster.yml python
+    train.py` — yaml cluster config, launcher spawns the PS and two
+    worker processes, each worker builds an Executor in Hybrid mode and
+    TRAINS against the shared PS with a BSP barrier per step; both
+    workers' embedding updates land in the one table."""
+
+    def test_cluster_yaml_hybrid_training(self):
+        from hetu_tpu.launcher import _free_port
+        d = tempfile.mkdtemp()
+        yml = os.path.join(d, "cluster.yml")
+        with open(yml, "w") as f:
+            f.write("""
+nodes:
+  - host: localhost
+    chief: true
+    servers: 1
+    workers: 2
+""")
+        script = os.path.join(d, "train.py")
+        with open(script, "w") as f:
+            f.write("""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import hetu_tpu as ht
+from hetu_tpu.ps.client import PSClient
+
+OUT = %r
+V, D, B, STEPS = 16, 8, 8, 4
+rank = int(os.environ["HETU_PS_RANK"])
+
+ids_node = ht.placeholder_op("ids")
+y = ht.placeholder_op("y")
+emb = ht.layers.Embedding(V, D, name="e2e_table")
+h = ht.embedding_lookup_op(emb.embedding_table, ids_node)
+h = ht.reduce_mean_op(h, [1])
+logits = ht.matmul_op(h, ht.init.xavier_uniform((D, 2), name="e2e_head"))
+loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y), axes=0)
+train = ht.optim.SGDOptimizer(learning_rate=0.5).minimize(loss)
+
+# bsp=0: per-step BSP barrier across the two workers (reference
+# BarrierWorker, ParameterServerCommunicate.py:49-53)
+ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid", bsp=0)
+c = PSClient.get()
+c.BarrierWorker("post_init")     # both executors finished param_set
+
+rng = np.random.RandomState(100 + rank)
+half = V // 2
+losses = []
+for _ in range(STEPS):
+    # worker r touches only its half of the vocabulary
+    idb = rng.randint(rank * half, (rank + 1) * half,
+                      (B, 4)).astype(np.int32)
+    yb = np.eye(2, dtype=np.float32)[rng.randint(0, 2, B)]
+    out = ex.run("train", feed_dict={ids_node: idb, y: yb})
+    losses.append(float(np.asarray(out[0])))
+assert all(np.isfinite(l) for l in losses), losses
+c.BarrierWorker("trained")
+
+table = np.asarray(c.pull("e2e_table_table"))
+init = np.asarray(ex.variables["e2e_table_table"].init_value(0))
+delta = np.abs(table - init).sum(axis=1)
+# MY half moved (I trained it)...
+mine = slice(rank * half, (rank + 1) * half)
+assert delta[mine].sum() > 1e-6, delta
+# ...and the OTHER worker's half moved too: cross-process updates
+# through the one shared PS table
+other = slice((1 - rank) * half, (2 - rank) * half)
+assert delta[other].sum() > 1e-6, delta
+open(os.path.join(OUT, f"trained{rank}"), "w").write(
+    repr(losses))
+""" % d)
+        port = _free_port()
+        env_old = os.environ.get("HETU_PS_PORT")
+        os.environ["HETU_PS_PORT"] = str(port)
+        try:
+            code = main(["-c", yml, sys.executable, script])
+        finally:
+            if env_old is None:
+                os.environ.pop("HETU_PS_PORT", None)
+            else:
+                os.environ["HETU_PS_PORT"] = env_old
+        assert code == 0
+        assert os.path.exists(os.path.join(d, "trained0"))
+        assert os.path.exists(os.path.join(d, "trained1"))
