@@ -519,6 +519,7 @@ class Executor:
 
         self.subexecutor = {}
         self.opt_states = {}
+        self._opt_ops = {}
         for name, nodes in eval_node_dict.items():
             has_opt = any(isinstance(n, OptimizerOp) for n in nodes)
             if self.config.pipeline is not None and has_opt:
@@ -528,6 +529,14 @@ class Executor:
                 sub = SubExecutor(name, nodes, self)
             self.subexecutor[name] = sub
             for opt_op in sub.optimizer_ops:
+                prev = self._opt_ops.get(opt_op.name)
+                if prev is not None and prev is not opt_op:
+                    raise ValueError(
+                        f"two distinct optimizers cover the same variable "
+                        f"set (stable name {opt_op.name!r}); their slot "
+                        f"states would collide — give them disjoint "
+                        f"var_lists")
+                self._opt_ops[opt_op.name] = opt_op
                 if opt_op.name not in self.opt_states:
                     self.opt_states[opt_op.name] = opt_op.init_state(
                         _ParamView(self.var_values),
@@ -735,7 +744,17 @@ class Executor:
     # save optimizer slot state, step, and rng as well, SURVEY.md §5.4)
     # ------------------------------------------------------------------ #
 
-    def save(self, path, file=None, varlist=None):
+    def save(self, path, file=None, varlist=None, sharded=False,
+             async_=False):
+        """Checkpoint params + optimizer slots + step + rng (reference
+        executor.py:461-485 saves params only; SURVEY §5.4 'strictly
+        better').  ``sharded=True`` writes an orbax checkpoint: each
+        device stores only its shard (no host gather of the full state —
+        required once params exceed one host's RAM), ``async_=True``
+        returns immediately and flushes in the background
+        (``wait_for_checkpoint()`` joins it)."""
+        if sharded or async_:
+            return self._save_orbax(path, async_=async_)
         os.makedirs(path, exist_ok=True)
         fname = os.path.join(path, file or "checkpoint.pkl")
         # copy=True: np.asarray over jax CPU arrays is zero-copy and the
@@ -758,19 +777,88 @@ class Executor:
                          "step": int(self.step),
                          "rng": np.asarray(self.rng)}, f)
 
+    # ---- orbax path: sharded + async ---- #
+
+    def _orbax_state(self):
+        state = {"params": dict(self.var_values),
+                 "opt_states": self.opt_states,
+                 "step": self.step, "rng": self.rng}
+        for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
+            ct = self.cstables.get(name)
+            if ct is not None:
+                ct.flush()
+            state["params"][name] = jnp.asarray(
+                np.asarray(self.ps_comm.pull(name)))
+        return state
+
+    def _save_orbax(self, path, async_=False):
+        import orbax.checkpoint as ocp
+        path = os.path.abspath(os.path.join(path, "orbax"))
+        self.wait_for_checkpoint()
+        if async_:
+            self._async_ckptr = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+            self._async_ckptr.save(path, args=ocp.args.StandardSave(
+                self._orbax_state()), force=True)
+        else:
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(path, self._orbax_state(), force=True)
+
+    def wait_for_checkpoint(self):
+        ck = getattr(self, "_async_ckptr", None)
+        if ck is not None:
+            ck.wait_until_finished()
+            ck.close()
+            self._async_ckptr = None
+
+    def load_sharded(self, path):
+        """Restore an orbax checkpoint, placing each leaf directly with
+        THIS executor's shardings (resharding across different meshes /
+        layouts happens inside orbax — a tp2-saved checkpoint restores
+        onto an fsdp8 executor without a full-state host bounce)."""
+        import orbax.checkpoint as ocp
+        path = os.path.abspath(os.path.join(path, "orbax"))
+        cur = self._orbax_state()
+
+        def abstract(x):
+            x = jnp.asarray(x) if not hasattr(x, "dtype") else x
+            sharding = getattr(x, "sharding", None)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=sharding)
+        target = jax.tree_util.tree_map(abstract, cur)
+        with ocp.StandardCheckpointer() as ckptr:
+            state = ckptr.restore(path, target)
+        params = state["params"]
+        for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
+            if name in params:
+                self.load_dict({name: np.asarray(params.pop(name))})
+        self.var_values = {k: v for k, v in params.items()
+                           if k in self.variables
+                           and k not in self.ps_sparse_vars}
+        self.opt_states = state["opt_states"]
+        self.step = jnp.asarray(state["step"], jnp.int32)
+        self.rng = jnp.asarray(state["rng"], jnp.uint32)
+
     def load(self, path, file=None, consider_splits=False):
+        if os.path.isdir(os.path.join(path, "orbax")) and not os.path.exists(
+                os.path.join(path, file or "checkpoint.pkl")):
+            return self.load_sharded(path)
         fname = os.path.join(path, file or "checkpoint.pkl")
         with open(fname, "rb") as f:
             ckpt = pickle.load(f)
         self.load_dict(ckpt["params"])
         if ckpt.get("opt_states"):
             loaded = jax.tree_util.tree_map(jnp.asarray, ckpt["opt_states"])
-            # OptimizerOp node names embed the global node id, which differs
-            # across processes/builds; remap saved states onto the current
-            # optimizer ops by their (stable) per-variable key sets.
+            # optimizer names are checkpoint-stable (hash of the var set),
+            # so direct lookup works; the key-set match remains only as a
+            # fallback for checkpoints written before stable naming
             remapped = {}
             used = set()
             for cur_key, cur_state in self.opt_states.items():
+                if cur_key in loaded:
+                    used.add(cur_key)
+                    remapped[cur_key] = loaded[cur_key]
+                    continue
                 match = None
                 for old_key, old_state in loaded.items():
                     if old_key not in used and \

@@ -344,6 +344,16 @@ class OptimizerOp(Op):
 
     def __init__(self, grads, var_list, optimizer):
         super().__init__(*grads, name="Optimizer")
+        # checkpoint-stable name: derived from the optimizer class and the
+        # variable names, NOT the global node-id counter — otherwise saved
+        # optimizer state cannot be keyed back in a fresh process (the old
+        # key-set remapping collided when two optimizers covered identical
+        # param-name sets)
+        import hashlib
+        digest = hashlib.sha1(
+            "|".join(sorted(v.name for v in var_list)).encode()
+        ).hexdigest()[:10]
+        self.name = f"opt_{type(optimizer).__name__}_{digest}"
         self.var_list = var_list
         self.optimizer = optimizer
         # sparse adjoints are consumed structurally, not evaluated densely

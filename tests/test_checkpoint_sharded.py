@@ -1,0 +1,124 @@
+"""Checkpoint robustness (VERDICT weak #7): checkpoint-stable optimizer
+naming and orbax sharded/async save-restore with cross-layout resharding
+(reference saves a rank-0 pickle of params only, executor.py:461-485 —
+this is the strictly-better path SURVEY §5.4 called for)."""
+
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+import hetu_tpu as ht
+
+
+BATCH, IN, HID, OUT = 16, 8, 32, 4
+
+TP_SPECS = {
+    "ck_fc1_weight": P(None, "tp"),
+    "ck_fc1_bias": P("tp"),
+    "ck_fc2_weight": P("tp", None),
+}
+
+
+def build(prefix="ck"):
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("y")
+    w1 = ht.init.xavier_uniform((IN, HID), name=f"{prefix}_fc1_weight")
+    b1 = ht.init.zeros((HID,), name=f"{prefix}_fc1_bias")
+    w2 = ht.init.xavier_uniform((HID, IN), name=f"{prefix}_fc2_weight")
+    wh = ht.init.xavier_uniform((IN, OUT), name=f"{prefix}_head")
+    h = ht.gelu_op(ht.linear_op(x, w1, b1))
+    h = ht.matmul_op(h, w2)
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, wh), y), axes=0)
+    train = ht.optim.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    return x, y, loss, train
+
+
+def batches(n, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        xb = rng.randn(BATCH, IN).astype(np.float32)
+        yb = np.eye(OUT, dtype=np.float32)[xb[:, :OUT].argmax(1)]
+        out.append((xb, yb))
+    return out
+
+
+class TestStableOptNames:
+    def test_name_stable_across_builds(self):
+        _, _, _, t1 = build()
+        _, _, _, t2 = build()      # fresh nodes, different node ids
+        assert t1.name == t2.name
+        assert t1.name.startswith("opt_AdamOptimizer_")
+
+    def test_duplicate_optimizers_rejected(self):
+        x, y, loss, _ = build("dup")
+        opt_a = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        opt_b = ht.optim.SGDOptimizer(learning_rate=0.2).minimize(loss)
+        with pytest.raises(ValueError, match="same variable set"):
+            ht.Executor({"a": [loss, opt_a], "b": [loss, opt_b]})
+
+    def test_stable_names_restore_by_key(self, tmp_path):
+        x, y, loss, train = build("sn")
+        ex = ht.Executor({"train": [loss, train]})
+        bs = batches(6)
+        for a, b in bs[:3]:
+            ex.run("train", feed_dict={x: a, y: b})
+        ex.save(str(tmp_path))
+        base = [float(np.asarray(ex.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[3:]]
+
+        x, y, loss, train = build("sn")
+        ex2 = ht.Executor({"train": [loss, train]})
+        ex2.load(str(tmp_path))
+        # Adam moments restored by the stable name — trajectory continues
+        got = [float(np.asarray(ex2.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[3:]]
+        np.testing.assert_allclose(got, base, atol=1e-6)
+
+
+class TestShardedCheckpoint:
+    def test_sharded_roundtrip_reshards_across_layouts(self, tmp_path):
+        """Save under tp2 x dp4, restore onto fsdp8 — the trajectory must
+        continue exactly; orbax reshards without a host bounce."""
+        bs = batches(8)
+        x, y, loss, train = build("sc")
+        ex = ht.Executor({"train": [loss, train]},
+                         dist_strategy=ht.dist.ShardingPlan(
+                             {"sc_fc1_weight": P(None, "tp"),
+                              "sc_fc1_bias": P("tp"),
+                              "sc_fc2_weight": P("tp", None)},
+                             mesh_axes={"dp": 4, "tp": 2}))
+        for a, b in bs[:4]:
+            ex.run("train", feed_dict={x: a, y: b})
+        ex.save(str(tmp_path), sharded=True)
+        base = [float(np.asarray(ex.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[4:]]
+
+        x, y, loss, train = build("sc")
+        ex2 = ht.Executor({"train": [loss, train]},
+                          dist_strategy=ht.dist.FSDP(dp=8, min_size=16))
+        ex2.load(str(tmp_path))       # auto-detects the orbax dir
+        got = [float(np.asarray(ex2.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[4:]]
+        np.testing.assert_allclose(got, base, atol=1e-5)
+
+    def test_async_save(self, tmp_path):
+        bs = batches(5)
+        x, y, loss, train = build("as")
+        ex = ht.Executor({"train": [loss, train]})
+        for a, b in bs[:2]:
+            ex.run("train", feed_dict={x: a, y: b})
+        ex.save(str(tmp_path), async_=True)
+        # training continues while the write flushes in the background
+        base = [float(np.asarray(ex.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[2:]]
+        ex.wait_for_checkpoint()
+
+        x, y, loss, train = build("as")
+        ex2 = ht.Executor({"train": [loss, train]})
+        ex2.load(str(tmp_path))
+        got = [float(np.asarray(ex2.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[2:]]
+        np.testing.assert_allclose(got, base, atol=1e-6)

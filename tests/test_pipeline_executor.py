@@ -146,9 +146,12 @@ class TestHostPath:
         ex = ht.Executor({"train": [loss, train]}, pipeline="pipedream",
                          num_stages=2, num_microbatches=4)
         ex.load_dict(w0)
-        tr = run_traj(ex, x, y, batches)
-        assert tr[-1] < tr[0]          # per-microbatch updates: trains,
-        assert not np.allclose(tr, base)   # but not the sync trajectory
+        tr = run_traj(ex, x, y, make_batches(16))
+        # per-microbatch updates train (trend over a window: single-step
+        # deltas are init-sensitive on a tiny model)...
+        assert np.mean(tr[-4:]) < np.mean(tr[:4]), tr
+        # ...but do not reproduce the sync trajectory
+        assert not np.allclose(tr[:len(base)], base)
 
     def test_eval_subgraph_untouched(self, baseline):
         """Forward-only subgraphs keep the plain jit path and see the
@@ -301,8 +304,8 @@ class TestHetPipe:
                          num_stages=2, num_microbatches=4, ps_comm=ps,
                          sync_every=2)
         ex.load_dict(w0)
-        tr = run_traj(ex, x, y, batches)
-        assert tr[-1] < tr[0]
+        tr = run_traj(ex, x, y, make_batches(16))
+        assert np.mean(tr[-4:]) < np.mean(tr[:4]), tr
         sub = ex.subexecutor["train"]
         assert sub._ps_snapshot is not None     # sync actually ran
         # server copy agrees with the post-sync worker copy
