@@ -1,0 +1,128 @@
+"""Embedding push/pull throughput vs PS server count.
+
+BASELINE.md's embedding metric is rows trainable per chip; the PS side of
+that is sparse push/pull row throughput.  This bench spawns N real server
+processes (TCP, like `heturun` does), row-shards a table across them with
+ShardedPSClient, and measures sd_pushpull rows/sec for N = 1, 2, 4 — the
+reference scales the same way by adding ps-lite server processes.
+
+  python examples/ctr/bench_embedding.py --rows 200000 --dim 64
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _worker_main(addrs, rows, dim, batch_ids, iters, seed, out_q,
+                 barrier):
+    import numpy as np  # noqa: F811  (fresh interpreter)
+    import time
+    from hetu_tpu.ps.sharded import ShardedPSClient
+
+    c = ShardedPSClient(addrs=addrs)
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, rows, batch_ids).astype(np.int64)
+    grads = np.ones((batch_ids, dim), np.float32)
+    c.sd_pushpull("bench_table", ids, grads)            # warm
+    barrier.wait()      # all workers loaded: start together so the
+    t0 = time.perf_counter()   # measured windows overlap
+    for _ in range(iters):
+        c.sd_pushpull("bench_table", ids, grads)
+    out_q.put(batch_ids * iters / (time.perf_counter() - t0))
+
+
+def bench_group(n_servers, n_workers, rows, dim, batch_ids, iters):
+    """The scaling scenario that matters: W worker processes hammer the
+    N-server group concurrently (one GIL-bound client cannot load more
+    than one server; the reference's ps-lite scales the same way)."""
+    import multiprocessing as mp
+    from hetu_tpu.launcher import _free_port, _start_ps_process, _wait_ps
+    from hetu_tpu.ps.sharded import ShardedPSClient
+
+    ports, procs = [], []
+    for _ in range(n_servers):
+        port = _free_port()
+        procs.append(_start_ps_process(port))
+        ports.append(port)
+    for port in ports:
+        _wait_ps("localhost", port)
+    addrs = [f"localhost:{p}" for p in ports]
+    try:
+        c = ShardedPSClient(addrs=addrs)
+        c.param_set("bench_table", np.zeros((rows, dim), np.float32),
+                    opt="sgd", opt_args={"learning_rate": 0.1})
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        barrier = ctx.Barrier(n_workers)
+        workers = [ctx.Process(target=_worker_main,
+                               args=(addrs, rows, dim, batch_ids, iters,
+                                     100 + w, q, barrier))
+                   for w in range(n_workers)]
+        for w in workers:
+            w.start()
+        rates = []
+        for _ in workers:
+            try:
+                rates.append(q.get(timeout=300))
+            except Exception:
+                raise RuntimeError(
+                    "a bench worker died before reporting (exit codes: "
+                    f"{[w.exitcode for w in workers]})")
+        for w in workers:
+            w.join()
+        c.finalize()
+        # windows overlap (barrier-synchronized start): sum of rates
+        return sum(rates), rates
+    finally:
+        for w in locals().get("workers", []):
+            if w.is_alive():
+                w.terminate()
+        for p in procs:
+            p.terminate()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch-ids", type=int, default=8192)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--servers", type=int, nargs="+", default=[1, 2, 4])
+    args = ap.parse_args()
+
+    cores = os.cpu_count() or 1
+    results = {}
+    for n in args.servers:
+        if cores < n + args.workers:
+            print(f"NOTE: {cores} host core(s) < {n} servers + "
+                  f"{args.workers} workers — processes timeshare, so "
+                  f"these numbers measure protocol overhead, not server "
+                  f"scaling (run on a multi-core host for the scaling "
+                  f"curve)")
+        rps, _ = bench_group(n, args.workers, args.rows, args.dim,
+                             args.batch_ids, args.iters)
+        results[n] = rps
+        print(f"servers={n} workers={args.workers}: {rps/1e6:.3f} M "
+              f"rows/sec sd_pushpull (dim {args.dim})")
+    base = results[min(results)]
+    print(json.dumps({
+        "metric": "ps_embedding_pushpull_rows_per_sec",
+        "value": round(max(results.values()), 1),
+        "unit": "rows/sec",
+        "scaling": {str(k): round(v / base, 2) for k, v in
+                    results.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -621,12 +621,17 @@ class Executor:
                         "the HET cache path accumulates -lr*grad deltas; "
                         "only plain SGD with a scalar LR is supported on "
                         "cached embeddings (reference hetu_cache ditto)")
-                self.ps_comm.param_set(name, val)
+                # the HET cache's versioned sync protocol needs the whole
+                # table on ONE server; with a sharded client the table
+                # lives whole on its home server of the group
+                cache_comm = self.ps_comm._home(name) \
+                    if hasattr(self.ps_comm, "_home") else self.ps_comm
+                cache_comm.param_set(name, val)
                 self._ps_opt_specs[name] = None
                 from .cache.cstable import CacheSparseTable
                 self.cstables[name] = CacheSparseTable(
                     cfg.cache_bound, val.shape[0], val.shape[1], key=name,
-                    comm=self.ps_comm, policy=cfg.cstable_policy)
+                    comm=cache_comm, policy=cfg.cstable_policy)
             else:
                 spec = _spec_for(name, opt)
                 self._ps_opt_specs[name] = spec
@@ -881,16 +886,21 @@ class Executor:
         for k, v in state_dict.items():
             if k in self.ps_sparse_vars or k in self.ps_dense_vars:
                 spec = self._ps_opt_specs.get(k)
-                self.ps_comm.param_set(k, np.asarray(v, np.float32),
-                                       opt=spec and spec[0],
-                                       opt_args=spec and spec[1])
+                comm = self.ps_comm
+                if k in self.cstables and hasattr(comm, "_home"):
+                    comm = comm._home(k)   # cache tables live whole
+                comm.param_set(k, np.asarray(v, np.float32),
+                               opt=spec and spec[0],
+                               opt_args=spec and spec[1])
                 ct = self.cstables.get(k)
                 if ct is not None:
-                    # drop cached lines; they refer to pre-load values
+                    # drop cached lines; they refer to pre-load values.
+                    # comm stays the HOME server (sharded groups don't
+                    # speak the cache's versioned sync protocol)
                     self.cstables[k] = CacheSparseTable(
                         ct.cache.limit if hasattr(ct.cache, "limit")
                         else self.config.cache_bound,
-                        ct.vocab, ct.width, key=k, comm=self.ps_comm,
+                        ct.vocab, ct.width, key=k, comm=comm,
                         policy=self.config.cstable_policy,
                         pull_bound=ct.pull_bound, push_bound=ct.push_bound)
                 if k in self.ps_dense_vars:

@@ -39,7 +39,8 @@ class _TCPTransport:
 
     def call(self, method, *args, **kwargs):
         s = self._sock()
-        _send_msg(s, pickle.dumps((method, args, kwargs)))
+        _send_msg(s, pickle.dumps((method, args, kwargs),
+                                  protocol=pickle.HIGHEST_PROTOCOL))
         ok, result = pickle.loads(_recv_msg(s))
         if not ok:
             raise RuntimeError(f"PS server error in {method}: {result}")
@@ -82,9 +83,17 @@ class PSClient:
     @classmethod
     def get(cls):
         if cls._instance is None:
-            cls._instance = PSClient(
-                rank=int(os.environ.get("HETU_PS_RANK", "0")),
-                nrank=int(os.environ.get("HETU_PS_NRANK", "1")))
+            rank = int(os.environ.get("HETU_PS_RANK", "0"))
+            nrank = int(os.environ.get("HETU_PS_NRANK", "1"))
+            addrs = [a for a in
+                     os.environ.get("HETU_PS_ADDRS", "").split(",") if a]
+            if len(addrs) > 1:
+                # launcher exposed a server group: shard keys across it
+                from .sharded import ShardedPSClient
+                cls._instance = ShardedPSClient(addrs=addrs, rank=rank,
+                                                nrank=nrank)
+            else:
+                cls._instance = PSClient(rank=rank, nrank=nrank)
         return cls._instance
 
     def finalize(self):

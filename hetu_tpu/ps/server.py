@@ -351,9 +351,13 @@ class PSServer:
                         method, args, kwargs = pickle.loads(raw)
                         try:
                             result = getattr(outer, method)(*args, **kwargs)
-                            payload = pickle.dumps((True, result))
+                            payload = pickle.dumps(
+                                (True, result),
+                                protocol=pickle.HIGHEST_PROTOCOL)
                         except Exception as e:  # noqa: BLE001
-                            payload = pickle.dumps((False, repr(e)))
+                            payload = pickle.dumps(
+                                (False, repr(e)),
+                                protocol=pickle.HIGHEST_PROTOCOL)
                         _send_msg(self.request, payload)
                 except (ConnectionResetError, BrokenPipeError):
                     return
@@ -420,6 +424,20 @@ class PSServer:
         with self.lock:
             self.params[key] = _Param(value, optimizer)
             return True
+
+    def param_assign(self, key, value):
+        """In-place value overwrite that PRESERVES the server-side
+        optimizer and its slot state (param_set would reset them) — the
+        checkpoint-restore path."""
+        value = np.asarray(value, np.float32)
+        with self.lock:
+            p = self.params.get(key)
+            if p is None:
+                self.params[key] = _Param(value.copy(), None)
+                return True
+        with p.lock:
+            p.value[...] = value
+        return True
 
     def param_clear(self, key):
         with self.lock:
@@ -603,7 +621,19 @@ class PSServer:
 # --------------------------------------------------------------------- #
 
 def _send_msg(sock, payload: bytes):
-    sock.sendall(struct.pack("!Q", len(payload)) + payload)
+    # gather write: one syscall/segment, no header+payload concat copy
+    # (payloads are multi-MB embedding batches)
+    header = struct.pack("!Q", len(payload))
+    total = len(header) + len(payload)
+    try:
+        sent = sock.sendmsg([header, payload])
+    except (AttributeError, OSError):
+        sock.sendall(header)
+        sock.sendall(payload)
+        return
+    if sent < total:        # rare partial send: finish with a copy
+        rest = memoryview(bytes(header) + bytes(payload))[sent:]
+        sock.sendall(rest)
 
 
 def _recv_msg(sock):
@@ -615,13 +645,17 @@ def _recv_msg(sock):
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # recv_into a preallocated buffer: O(n), vs the O(n^2) bytes+=chunk
+    # pattern that dominated large-message latency
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             return None
-        buf += chunk
-    return buf
+        got += r
+    return buf      # pickle.loads takes the bytearray without a copy
 
 
 class Scheduler:

@@ -1,0 +1,265 @@
+"""Sharded PS client: one logical parameter server over N server
+processes.
+
+Reference: ps-lite's server GROUP — keys are range-partitioned across
+servers by the Postoffice (ps-lite/include/ps/internal/postoffice.h), so
+embedding traffic and storage scale with server count.  Here:
+
+- 2-D tables are ROW-sharded: server s stores rows {i : i % N == s} at
+  local index i // N (round-robin balances hot heads of zipfian id
+  distributions better than contiguous ranges).  Sparse push/pull split
+  the id set per shard and fan out concurrently; dense pull/push
+  reassemble/scatter the full table.
+- other params route whole to ``hash(key) % N``.
+- coordination ops (barrier, SSP clocks, preduce matchmaking) live on
+  server 0 — they are tiny and need a single view.
+- the HET cache sync protocol (versioned sync/push_embedding) is NOT
+  row-sharded here; point the cache at one server of the group.
+
+Which rows-sharding applies to a key is recorded on server 0
+(``__rows__<key>`` metadata), so a worker that did not create the table
+still routes correctly.
+
+``PSClient.get()`` returns this client automatically when the launcher
+exposes several servers via HETU_PS_ADDRS.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .client import PSClient, _TCPTransport, _LocalTransport
+
+
+class _LocalServerTransport:
+    """Like _LocalTransport but against an explicit server instance (for
+    in-process multi-server tests)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def call(self, method, *args, **kwargs):
+        return getattr(self.server, method)(*args, **kwargs)
+
+    def close(self):
+        pass
+
+
+class ShardedPSClient:
+    def __init__(self, addrs=None, servers=None, rank=0, nrank=1):
+        if servers is not None:
+            transports = [_LocalServerTransport(s) for s in servers]
+        else:
+            addrs = addrs or os.environ.get("HETU_PS_ADDRS", "").split(",")
+            addrs = [a for a in addrs if a]
+            if not addrs:
+                transports = [_LocalTransport()]
+            else:
+                transports = []
+                for a in addrs:
+                    host, port = a.rsplit(":", 1)
+                    transports.append(_TCPTransport(host, int(port)))
+        self.clients = [PSClient(t, rank=rank, nrank=nrank)
+                        for t in transports]
+        self.n = len(self.clients)
+        self.rank = rank
+        self.nrank = nrank
+        # _pool serves EXTERNAL async submissions (the executor's
+        # ps_lookup_async duck-types it); _fan_pool is private to the
+        # per-shard fan-out — sharing one pool deadlocks when an external
+        # task occupying every worker then blocks on _fan results
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(self.n, 2), thread_name_prefix="ps-shard")
+        self._fan_pool = ThreadPoolExecutor(
+            max_workers=max(self.n, 2), thread_name_prefix="ps-fan")
+        self._row_sharded = {}      # key -> (rows, width) or None
+
+    # ------------------------------------------------------------------ #
+
+    def _home(self, key):
+        import zlib
+        return self.clients[zlib.crc32(key.encode()) % self.n]
+
+    def _rows_of(self, key):
+        meta = self._meta_of(key)
+        return None if meta is None else meta[0]
+
+    def _meta_of(self, key):
+        if key in self._row_sharded:
+            return self._row_sharded[key]
+        try:
+            arr = np.asarray(self.clients[0].pull("__rows__" + key))
+            meta = (int(arr[0]), int(arr[1]) if arr.size > 1 else None)
+        except Exception:
+            meta = None
+        self._row_sharded[key] = meta
+        return meta
+
+    def _fan(self, fn_per_shard):
+        futs = [self._fan_pool.submit(fn_per_shard, s)
+                for s in range(self.n)]
+        return [f.result() for f in futs]
+
+    # ---------------- Worker API ---------------- #
+
+    def param_set(self, key, value, opt=None, opt_args=None):
+        value = np.asarray(value, np.float32)
+        if value.ndim == 2 and self.n > 1:
+            self.clients[0].param_set("__rows__" + key,
+                                      np.asarray(value.shape, np.float32))
+            self._row_sharded[key] = (value.shape[0], value.shape[1])
+            self._fan(lambda s: self.clients[s].param_set(
+                key, value[s::self.n], opt=opt, opt_args=opt_args))
+            return True
+        self._row_sharded[key] = None
+        return self._home(key).param_set(key, value, opt=opt,
+                                         opt_args=opt_args)
+
+    def parameter_init(self, key, shape, **kw):
+        # sharded init of 2-D tables is delegated to param_set by the
+        # executor bridge; plain inits route whole
+        self._row_sharded[key] = None
+        return self._home(key).parameter_init(key, shape, **kw)
+
+    def pull(self, key):
+        rows = self._rows_of(key)
+        if rows is None:
+            return self._home(key).pull(key)
+        parts = self._fan(lambda s: np.asarray(self.clients[s].pull(key)))
+        out = np.empty((rows, parts[0].shape[1]), np.float32)
+        for s, p in enumerate(parts):
+            out[s::self.n] = p
+        return out
+
+    def push(self, key, grad):
+        grad = np.asarray(grad, np.float32)
+        rows = self._rows_of(key)
+        if rows is None:
+            return self._home(key).push(key, grad)
+        self._fan(lambda s: self.clients[s].push(key, grad[s::self.n]))
+
+    def sparse_pull(self, key, ids):
+        ids = np.asarray(ids, np.int64)
+        meta = self._meta_of(key)
+        if meta is None:
+            return self._home(key).sparse_pull(key, ids)
+        if len(ids) == 0:
+            return np.empty((0, meta[1] or 0), np.float32)
+        shard = ids % self.n
+        local = ids // self.n
+
+        def one(s):
+            m = shard == s
+            if not m.any():
+                return None
+            return np.asarray(self.clients[s].sparse_pull(key, local[m]))
+        parts = self._fan(one)
+        width = meta[1] or next(p.shape[1] for p in parts
+                                if p is not None)
+        out = np.empty((len(ids), width), np.float32)
+        for s, p in enumerate(parts):
+            if p is not None:
+                out[shard == s] = p
+        return out
+
+    def sparse_push(self, key, ids, rows_arr):
+        ids = np.asarray(ids, np.int64)
+        rows_arr = np.asarray(rows_arr, np.float32)
+        if self._rows_of(key) is None:
+            return self._home(key).sparse_push(key, ids, rows_arr)
+        shard = ids % self.n
+        local = ids // self.n
+
+        def one(s):
+            m = shard == s
+            if m.any():
+                self.clients[s].sparse_push(key, local[m], rows_arr[m])
+        self._fan(one)
+
+    def sd_pushpull(self, key, ids, rows_arr, pull_ids=None):
+        ids = np.asarray(ids, np.int64)
+        rows_arr = np.asarray(rows_arr, np.float32)
+        pids = ids if pull_ids is None else np.asarray(pull_ids, np.int64)
+        meta = self._meta_of(key)
+        if meta is None:
+            return self._home(key).sd_pushpull(key, ids, rows_arr, pids)
+        # ONE fused round trip per shard (this is the hot CTR path)
+        shard, local = ids % self.n, ids // self.n
+        pshard, plocal = pids % self.n, pids // self.n
+
+        def one(s):
+            m, mp = shard == s, pshard == s
+            if not m.any() and not mp.any():
+                return None
+            return np.asarray(self.clients[s].sd_pushpull(
+                key, local[m], rows_arr[m], plocal[mp]))
+        parts = self._fan(one)
+        width = meta[1] or next(p.shape[1] for p in parts
+                                if p is not None)
+        out = np.empty((len(pids), width), np.float32)
+        for s, p in enumerate(parts):
+            if p is not None:
+                out[pshard == s] = p
+        return out
+
+    ss_pushpull = sd_pushpull
+
+    def save(self, key, path):
+        os.makedirs(path, exist_ok=True)
+        if self._rows_of(key) is None:
+            return self._home(key).save(key, path)
+        table = self.pull(key)
+        np.save(os.path.join(path, f"ps_param_{key}.npy"), table)
+
+    def load(self, key, path):
+        if self._rows_of(key) is None:
+            # the server loads from ITS filesystem (multi-host: the file
+            # lives where save() wrote it)
+            return self._home(key).load(key, path)
+        arr = np.load(os.path.join(path, f"ps_param_{key}.npy"))
+        # param_assign keeps each shard's server optimizer + slot state
+        self._fan(lambda s: self.clients[s].t.call(
+            "param_assign", key, arr[s::self.n]))
+
+    def clear(self, key):
+        self._row_sharded.pop(key, None)
+        self._fan(lambda s: self.clients[s].clear(key))
+
+    def wait(self, ticket):
+        return self.clients[0].wait(ticket)
+
+    # ---------------- coordination: server 0 ---------------- #
+
+    def ssp_init(self, group=0, bound=0):
+        return self.clients[0].ssp_init(group, bound)
+
+    def ssp_sync(self, group=0):
+        return self.clients[0].ssp_sync(group)
+
+    def BarrierWorker(self, group=0):
+        return self.clients[0].BarrierWorker(group)
+
+    def preduce_get_partner(self, key, max_worker, wait_time):
+        return self.clients[0].preduce_get_partner(key, max_worker,
+                                                   wait_time)
+
+    def getLoads(self):
+        return self._fan(lambda s: self.clients[s].getLoads())
+
+    def finalize(self):
+        self._pool.shutdown(wait=True)
+        self._fan_pool.shutdown(wait=True)
+        for c in self.clients:
+            c.finalize()
+
+    # cache sync protocol: single-server only (see module docstring)
+    def sync_embedding(self, *a, **kw):
+        raise NotImplementedError(
+            "HET cache sync is not row-sharded; point the CacheSparseTable "
+            "at one server of the group")
+
+    push_embedding = sync_embedding
+    push_sync_embedding = sync_embedding
