@@ -1,0 +1,107 @@
+"""CNN layout-equivalence suite (reference all_cnn_tests.sh: the same
+fixed-weight CNNs under every parallel layout must reproduce the 1-GPU
+loss trajectory; here 1-device vs dp8/fsdp8 through the Executor).
+
+BatchNorm makes this the interesting CNN case: batch statistics must be
+GLOBAL means under dp sharding (GSPMD inserts the cross-device reduction
+from the sharding annotations alone — the pjit equivalent of sync-BN),
+otherwise the trajectories diverge."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.models import cnn as zoo
+
+
+BATCH = 16
+N_STEPS = 5
+
+
+def build(model_name):
+    x = ht.placeholder_op("x")
+    y = ht.placeholder_op("y")
+    loss, pred = getattr(zoo, model_name)(x, y)
+    train = ht.optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    return x, y, loss, train
+
+
+def batches(shape, n=N_STEPS, classes=10, seed=9):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        xb = rng.randn(BATCH, *shape).astype(np.float32)
+        yb = np.eye(classes, dtype=np.float32)[rng.randint(0, classes,
+                                                           BATCH)]
+        out.append((xb, yb))
+    return out
+
+
+CASES = {
+    # model -> input shape (NCHW for convs, flat for mlp)
+    "mlp": (784,),
+    "cnn_3_layers": (1, 28, 28),
+    "lenet": (1, 28, 28),
+    "resnet18": (3, 32, 32),
+}
+
+LAYOUTS = {
+    "dp8": lambda: ht.dist.DataParallel(num_devices=8),
+    "fsdp8": lambda: ht.dist.FSDP(dp=8, min_size=64),
+}
+
+
+class TestCNNLayouts:
+    @pytest.mark.parametrize("model", sorted(CASES), ids=sorted(CASES))
+    @pytest.mark.parametrize("layout", sorted(LAYOUTS),
+                             ids=sorted(LAYOUTS))
+    def test_trajectory_matches_single_device(self, model, layout):
+        shape = CASES[model]
+        # resnet18: 20 stacked BNs amplify psum summation-order noise
+        # (each rsqrt(var+eps) renormalizes), so compare fewer steps
+        n_steps = 3 if model == "resnet18" else N_STEPS
+        x, y, loss, train = build(model)
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        bs = batches(shape, n=n_steps)
+        base = [float(np.asarray(ex1.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs]
+
+        x, y, loss, train = build(model)
+        ex2 = ht.Executor({"train": [loss, train]},
+                          dist_strategy=LAYOUTS[layout]())
+        ex2.load_dict(w0)
+        tr = [float(np.asarray(ex2.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs]
+        tol = dict(rtol=5e-3, atol=1e-4) if model == "resnet18" \
+            else dict(rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(tr, base, **tol)
+
+    def test_bn_running_stats_global_under_dp(self):
+        """After dp8 training, BN running stats equal the single-device
+        run's (batch statistics were reduced across devices — the pjit
+        equivalent of sync-BN)."""
+        x, y, loss, train = build("resnet18")
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        # ONE step: a sync-BN failure (per-device 2-sample stats vs the
+        # global 16-sample batch) is a large first-step error, while
+        # later steps only accumulate fp drift of the params
+        bs = batches(CASES["resnet18"], n=1)
+        for a, b in bs:
+            ex1.run("train", feed_dict={x: a, y: b})
+        ref = ex1.return_tensor_values()
+
+        x, y, loss, train = build("resnet18")
+        ex2 = ht.Executor({"train": [loss, train]},
+                          dist_strategy=ht.dist.DataParallel(
+                              num_devices=8))
+        ex2.load_dict(w0)
+        for a, b in bs:
+            ex2.run("train", feed_dict={x: a, y: b})
+        got = ex2.return_tensor_values()
+        stats = [k for k in ref if "running" in k]
+        assert stats, "model has no BN running stats?"
+        for k in stats:
+            np.testing.assert_allclose(got[k], ref[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=k)
