@@ -49,3 +49,9 @@ def test_plan_bert_example_runs():
     _run_main(mod, ["--hidden", "32", "--layers", "2", "--heads", "2",
                     "--seq-len", "16", "--vocab", "100",
                     "--global-batch", "16", "--steps", "1"])
+
+
+def test_transformer_mt_learns():
+    mod = _load("nlp/train_transformer.py", "ex_mt")
+    acc = _run_main(mod, ["--num-steps", "80", "--log-every", "80"])
+    assert acc > 0.05    # chance is ~1/62 on the synthetic MT task
