@@ -46,7 +46,7 @@ from jax import shard_map
 
 def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
                   axis="pp", checkpoint_stages=True, mb_spec=None,
-                  stage_takes_tick=False):
+                  stage_takes_tick=False, manual_axes=None):
     """Run ``microbatches`` through a pipeline of S stages over mesh axis
     ``axis`` in one SPMD program.
 
@@ -67,6 +67,11 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
       stage_takes_tick: call ``stage_fn(params, x, t)`` with the schedule
         tick t — lets callers decorrelate per-microbatch state (e.g.
         dropout RNG: microbatch index = t - stage).
+      manual_axes: axes the shard_map is MANUAL over (default: all).
+        Passing {'pp'} leaves the other mesh axes to GSPMD, so tensor-
+        parallel shardings on the stage params partition the in-stage
+        matmuls automatically (composed pp x tp x dp) — in/out specs then
+        mention only the manual axes.
 
     Returns ``[M, mb, ...]`` outputs of the last stage, replicated.
 
@@ -121,9 +126,12 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     rep = mb_spec if mb_spec is not None \
         else P(*([None] * microbatches.ndim))
+    kw = {}
+    if manual_axes is not None:
+        kw["axis_names"] = frozenset(manual_axes)
     return shard_map(
         per_device, mesh=mesh,
-        in_specs=(pspec, rep), out_specs=rep,
+        in_specs=(pspec, rep), out_specs=rep, **kw,
     )(stage_params, microbatches)
 
 

@@ -338,7 +338,6 @@ class PipelineSubExecutor:
         R = plan.num_body_blocks()
         rps = R // S
         n_pos = len(plan.body_blocks[0].params)
-        mb_spec = P(None, "dp") if "dp" in mesh.axis_names else None
 
         def loss_of(params, mb, whole, rngs, step):
             cfg = ex.config
@@ -352,14 +351,30 @@ class PipelineSubExecutor:
 
             xs = jax.vmap(pre_one)(mb, rngs)     # [M, mb, ...]
 
-            # stack body params [R, ...] -> [S, R/S, ...], 'pp'-sharded
+            # stack body params [R, ...] -> [S, R/S, ...], 'pp'-sharded;
+            # mixed precision casts at graph entry (masters stay fp32)
+            mp = cfg.mixed_precision
+
+            def entry_cast(v):
+                if mp is not None and jnp.issubdtype(v.dtype,
+                                                    jnp.floating):
+                    return v.astype(mp)
+                return v
+
             stacked = []
             for pos in range(n_pos):
-                leaves = [params[plan.body_params[r][pos].name]
+                tmpl = plan.body_params[0][pos]
+                leaves = [entry_cast(params[plan.body_params[r][pos].name])
                           for r in range(R)]
                 st = jnp.stack(leaves).reshape(S, rps, *leaves[0].shape)
+                # shard_map is manual over 'pp' ONLY; the per-layer tp/dp
+                # specs carry into the stacked dims and GSPMD partitions
+                # the in-stage matmuls (true pp x tp composition)
+                var_spec = getattr(tmpl, "sharding_spec", None)
+                tail = tuple(var_spec) if var_spec is not None \
+                    else (None,) * (st.ndim - 2)
                 st = jax.lax.with_sharding_constraint(
-                    st, NamedSharding(mesh, P("pp")))
+                    st, NamedSharding(mesh, P("pp", None, *tail)))
                 stacked.append(st)
             stacked = tuple(stacked)
 
@@ -384,8 +399,10 @@ class PipelineSubExecutor:
                 return h
 
             ys = spmd_pipeline(stage_fn, stacked, xs, mesh=mesh,
-                               axis="pp", mb_spec=mb_spec,
-                               stage_takes_tick=True)
+                               axis="pp",
+                               mb_spec=P(*([None] * (xs.ndim))),
+                               stage_takes_tick=True,
+                               manual_axes={"pp"})
 
             def post_one(y, fmb, r):
                 tc = TraceContext(params={}, rng=jax.random.fold_in(r, 13),

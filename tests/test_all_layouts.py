@@ -160,6 +160,12 @@ class TestPipelineLayouts:
             for a, b in batches]
         return w0, batches, base
 
+    # body-layer tp specs must be uniform across layers (the SPMD path
+    # stacks them); Megatron col/row split on every block
+    BODY_TP = {f"l{i}_{n}": s for i in range(4) for n, s in
+               [("w1", P(None, "tp")), ("b1", P("tp")),
+                ("w2", P("tp", None))]}
+
     PP_LAYOUTS = {
         "pp4": ({"pp": 4}, None),
         "pp2xdp4": ({"pp": 2, "dp": 4}, None),
@@ -168,6 +174,10 @@ class TestPipelineLayouts:
                         "l0_w2": P("tp", None),
                         "l2_w1": P(None, "tp"), "l2_b1": P("tp"),
                         "l2_w2": P("tp", None)}),
+        # the full 3-D composition: scan pipeline manual over 'pp', GSPMD
+        # partitioning the in-stage matmuls over 'tp' and the batch over
+        # 'dp' (BASELINE config 5's layout class)
+        "dp2xtp2xpp2": ({"pp": 2, "dp": 2, "tp": 2}, BODY_TP),
     }
 
     @pytest.mark.parametrize("layout", sorted(PP_LAYOUTS),

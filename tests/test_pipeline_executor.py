@@ -479,3 +479,23 @@ class TestNonBatchFeeds:
             ex.run("train", feed_dict={
                 x: np.zeros((15, IN), np.float32),
                 y: np.zeros((15, OUT), np.float32)})
+
+
+class TestMixedPrecisionPipeline:
+    @pytest.mark.parametrize("spmd", [False, True], ids=["host", "spmd"])
+    def test_bf16_pipeline_trains_fp32_masters(self, spmd):
+        """mixed_precision='bf16' through both pipeline lowerings: bf16
+        compute, fp32 masters, finite decreasing loss."""
+        x, y, loss, train = build_model()
+        kw = dict(pipeline="gpipe", num_microbatches=4,
+                  mixed_precision="bf16")
+        if spmd:
+            kw["mesh"] = make_mesh({"pp": 2})
+        else:
+            kw["num_stages"] = 2
+        ex = ht.Executor({"train": [loss, train]}, **kw)
+        assert ex.subexecutor["train"].spmd == spmd
+        tr = run_traj(ex, x, y, make_batches(10))
+        assert np.all(np.isfinite(tr))
+        assert np.mean(tr[-3:]) < np.mean(tr[:3]), tr
+        assert ex.var_values["l0_w1"].dtype == np.float32   # masters
