@@ -55,3 +55,9 @@ def test_transformer_mt_learns():
     mod = _load("nlp/train_transformer.py", "ex_mt")
     acc = _run_main(mod, ["--num-steps", "80", "--log-every", "80"])
     assert acc > 0.05    # chance is ~1/62 on the synthetic MT task
+
+
+def test_long_context_example_tiny():
+    mod = _load("nlp/train_long_context.py", "ex_lc")
+    toks = _run_main(mod, ["--seq-len", "256", "--tiny"])
+    assert toks > 0
