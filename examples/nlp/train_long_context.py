@@ -3,10 +3,10 @@ SURVEY.md §5.7: no sequence parallelism, BERT capped at seq 512).
 
 Single chip: Pallas flash attention (O(S) memory, fused backward) makes
 seq 4k-8k trainable where the unfused softmax(QK^T)V chain would
-materialize the S x S score matrix per head.  Multi-chip: shard the
-sequence over a 'cp' mesh axis with --cp (ring attention / Ulysses in
-parallel/context_parallel.py; here Ulysses via the attention layer is
-exercised on the virtual CPU mesh).
+materialize the S x S score matrix per head.  Sequences beyond one
+chip shard over a 'cp' mesh axis (ring attention / Ulysses in
+parallel/context_parallel.py; see tests/test_context_parallel.py for the
+multi-device drive — this example is the single-chip path).
 
   python examples/nlp/train_long_context.py --seq-len 4096   # one TPU
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \

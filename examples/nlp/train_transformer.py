@@ -38,14 +38,18 @@ def synthetic_pairs(rng, n, vocab, src_len, tgt_len, pad_id=0, bos_id=1):
     perm[2:] = 2 + rng.permutation(vocab - 2)
     src = rng.randint(2, vocab, (n, src_len)).astype(np.int32)
     tgt_core = perm[src[:, ::-1]][:, :tgt_len - 1]
+
+    def pad_to(a, width):
+        return np.concatenate(
+            [a, np.full((n, width - a.shape[1]), pad_id, np.int32)],
+            axis=1) if a.shape[1] < width else a[:, :width]
+
     dec_in = np.concatenate(
         [np.full((n, 1), bos_id, np.int32), tgt_core[:, :-1]], axis=1)
     labels = np.concatenate(
-        [tgt_core, np.full((n, 1), pad_id, np.int32)], axis=1)[:, :tgt_len]
-    dec_in = np.concatenate(
-        [dec_in, np.full((n, tgt_len - dec_in.shape[1]), pad_id,
-                         np.int32)], axis=1)
-    return src, dec_in.astype(np.int32), labels.astype(np.int32)
+        [tgt_core, np.full((n, 1), pad_id, np.int32)], axis=1)
+    return (src, pad_to(dec_in, tgt_len).astype(np.int32),
+            pad_to(labels, tgt_len).astype(np.int32))
 
 
 def main():
@@ -91,7 +95,7 @@ def main():
     S, D, L = synthetic_pairs(rng, 4096, args.vocab, args.src_len,
                               args.tgt_len)
 
-    def token_acc(n=256):
+    def token_acc():
         lg = np.asarray(ex.run("eval", feed_dict={
             src: S[:args.batch_size], tgt: D[:args.batch_size],
             labels: L[:args.batch_size]})[0])

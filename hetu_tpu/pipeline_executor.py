@@ -364,6 +364,18 @@ class PipelineSubExecutor:
             stacked = []
             for pos in range(n_pos):
                 tmpl = plan.body_params[0][pos]
+                # the stacked constraint can express only ONE spec per
+                # position: require per-layer specs to be uniform, or the
+                # template's would silently override the others
+                specs = {str(getattr(plan.body_params[r][pos],
+                                     "sharding_spec", None))
+                         for r in range(R)}
+                if len(specs) > 1:
+                    raise ValueError(
+                        f"pipeline body param position {pos} "
+                        f"({tmpl.name}-like) has non-uniform sharding "
+                        f"specs across layers ({sorted(specs)}); give "
+                        f"every body layer the same spec")
                 leaves = [entry_cast(params[plan.body_params[r][pos].name])
                           for r in range(R)]
                 st = jnp.stack(leaves).reshape(S, rps, *leaves[0].shape)

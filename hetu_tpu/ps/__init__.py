@@ -4,3 +4,9 @@ Reference: ps-lite (§2.2 of SURVEY.md) + src/hetu_cache (§2.3).  Built in
 stages: in-process server (this round) -> multi-process ZMQ-free TCP server
 -> C++ hot path.  See server.py / client.py / cache.py.
 """
+
+from .server import PSServer, Scheduler
+from .client import PSClient
+from .sharded import ShardedPSClient
+
+__all__ = ["PSServer", "Scheduler", "PSClient", "ShardedPSClient"]
