@@ -107,6 +107,8 @@ class Dataloader:
         self.parts = None
         self._initialized = False
         self._ring = None
+        self._consumed = 0      # batches handed to the trainer (ring
+                                # lookahead excluded) — checkpoint state
 
     # ---- DP / MP hooks (reference dataloader.py:102-141) ---- #
 
@@ -163,12 +165,37 @@ class Dataloader:
             self._ring = None
 
     def get_arr(self):
+        self._consumed += 1
         if self._ring is not None:
             return self._ring.get()
         if getattr(self, "_peeked", None) is not None:
             batch, self._peeked = self._peeked, None
             return batch
         return self._next_batch()
+
+    # ---- checkpoint state (exact mid-epoch resume; the reference's
+    # Dataloader has no state capture, SURVEY §5.4) ---- #
+
+    def state_dict(self):
+        return {"consumed": self._consumed, "seed": self.seed}
+
+    def load_state_dict(self, state):
+        """Fast-forward to `consumed` batches deterministically: the
+        epoch permutation is a pure function of (seed, epoch), so the
+        position is computed, not replayed."""
+        assert self._ring is None and \
+            getattr(self, "_peeked", None) is None, \
+            "restore dataloader state before the first batch is drawn"
+        self._initialized = False
+        self.init_states()
+        consumed = int(state["consumed"])
+        epoch, within = divmod(consumed, self.batch_num)
+        self._epoch = 0
+        for _ in range(epoch):
+            self._reshuffle()
+        self.index = min(within * self.batch_size, self.samples_num)
+        self.batch_id = within
+        self._consumed = consumed
 
     def peek_arr(self):
         """The batch the next get_arr() will return, without consuming it

@@ -780,14 +780,39 @@ class Executor:
         with open(fname, "wb") as f:
             pickle.dump({"params": params, "opt_states": opt,
                          "step": int(self.step),
-                         "rng": np.asarray(self.rng)}, f)
+                         "rng": np.asarray(self.rng),
+                         "dataloaders": self._loader_states()}, f)
+
+    def _loaders(self):
+        # keys must be stable across BUILDS (auto node names embed the
+        # global id counter): subgraph name + topo position + loader name
+        seen = {}
+        for sub_name in sorted(self.subexecutor):
+            sub = self.subexecutor[sub_name]
+            for i, dl_op in enumerate(getattr(sub, "dataloader_ops", [])):
+                for key, loader in getattr(dl_op, "dataloaders",
+                                           {}).items():
+                    seen[f"{sub_name}:{i}:{key}"] = loader
+        return seen
+
+    def _loader_states(self):
+        """Exact mid-epoch resume state (reference loses the iterator
+        position on restart; SURVEY §5.4 'strictly better')."""
+        return {k: ld.state_dict() for k, ld in self._loaders().items()}
+
+    def _restore_loaders(self, states):
+        loaders = self._loaders()
+        for k, st in (states or {}).items():
+            if k in loaders:
+                loaders[k].load_state_dict(st)
 
     # ---- orbax path: sharded + async ---- #
 
     def _orbax_state(self):
         state = {"params": dict(self.var_values),
                  "opt_states": self.opt_states,
-                 "step": self.step, "rng": self.rng}
+                 "step": self.step, "rng": self.rng,
+                 "dataloaders": self._loader_states()}
         for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
             ct = self.cstables.get(name)
             if ct is not None:
@@ -843,6 +868,8 @@ class Executor:
         self.opt_states = state["opt_states"]
         self.step = jnp.asarray(state["step"], jnp.int32)
         self.rng = jnp.asarray(state["rng"], jnp.uint32)
+        if state.get("dataloaders"):
+            self._restore_loaders(state["dataloaders"])
 
     def load(self, path, file=None, consider_splits=False):
         if os.path.isdir(os.path.join(path, "orbax")) and not os.path.exists(
@@ -880,6 +907,8 @@ class Executor:
             self.step = jnp.asarray(ckpt["step"], jnp.int32)
         if "rng" in ckpt:
             self.rng = jnp.asarray(ckpt["rng"], jnp.uint32)
+        if ckpt.get("dataloaders"):
+            self._restore_loaders(ckpt["dataloaders"])
 
     def load_dict(self, state_dict):
         from .cache.cstable import CacheSparseTable

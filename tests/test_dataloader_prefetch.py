@@ -154,3 +154,62 @@ class TestExecutorIntegration:
         # the ids loader ring has no device_put transform
         if ids_loader._ring is not None:
             assert isinstance(ids_loader.peek_arr(), np.ndarray)
+
+
+class TestMidEpochResume:
+    """Checkpoint captures the dataloader position: a resumed run pops
+    the EXACT batch stream the uninterrupted run would have (incl. the
+    epoch-seeded reshuffles) — the reference restarts its iterator."""
+
+    def _build(self, tag):
+        X = _data(40, 4, seed=21)
+        Y = np.eye(2, dtype=np.float32)[(X[:, 0] > 0).astype(int)]
+        dlx = ht.dataloader_op([ht.Dataloader(X, 8, "train",
+                                              shuffle=True, seed=4)])
+        dly = ht.dataloader_op([ht.Dataloader(Y, 8, "train",
+                                              shuffle=True, seed=4)])
+        w = ht.init.xavier_uniform((4, 2), name=f"mr_w_{tag}")
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(ht.matmul_op(dlx, w), dly), axes=0)
+        train = ht.optim.AdamOptimizer(learning_rate=0.05).minimize(loss)
+        return loss, train
+
+    def test_resume_continues_batch_stream(self, tmp_path):
+        # uninterrupted: 12 steps (2+ epochs of 5 batches, reshuffles)
+        loss, train = self._build("a")
+        ex = ht.Executor({"train": [loss, train]}, prefetch=False)
+        w0 = ex.return_tensor_values()
+        full = [float(np.asarray(ex.run("train")[0])) for _ in range(12)]
+
+        # interrupted at step 7, checkpoint, fresh process resumes
+        loss, train = self._build("a")
+        ex1 = ht.Executor({"train": [loss, train]}, prefetch=False)
+        ex1.load_dict(w0)
+        part1 = [float(np.asarray(ex1.run("train")[0])) for _ in range(7)]
+        ex1.save(str(tmp_path))
+
+        loss, train = self._build("a")
+        ex2 = ht.Executor({"train": [loss, train]}, prefetch=False)
+        ex2.load(str(tmp_path))
+        part2 = [float(np.asarray(ex2.run("train")[0])) for _ in range(5)]
+        np.testing.assert_allclose(part1 + part2, full, atol=1e-6)
+
+    def test_resume_with_prefetch_ring(self, tmp_path):
+        """The ring prefetches ahead, but _consumed tracks the trainer's
+        position, so resume is exact with prefetch on too."""
+        loss, train = self._build("b")
+        ex = ht.Executor({"train": [loss, train]}, prefetch=False)
+        w0 = ex.return_tensor_values()
+        full = [float(np.asarray(ex.run("train")[0])) for _ in range(10)]
+
+        loss, train = self._build("b")
+        ex1 = ht.Executor({"train": [loss, train]}, prefetch=True)
+        ex1.load_dict(w0)
+        part1 = [float(np.asarray(ex1.run("train")[0])) for _ in range(6)]
+        ex1.save(str(tmp_path))
+
+        loss, train = self._build("b")
+        ex2 = ht.Executor({"train": [loss, train]}, prefetch=True)
+        ex2.load(str(tmp_path))
+        part2 = [float(np.asarray(ex2.run("train")[0])) for _ in range(4)]
+        np.testing.assert_allclose(part1 + part2, full, atol=1e-6)
