@@ -104,7 +104,8 @@ class TestHeturnTrainEndToEnd:
     TRAINS against the shared PS with a BSP barrier per step; both
     workers' embedding updates land in the one table."""
 
-    def test_cluster_yaml_hybrid_training(self):
+    @pytest.mark.parametrize("bsp", [0, 1], ids=["bsp", "ssp1"])
+    def test_cluster_yaml_hybrid_training(self, bsp):
         from hetu_tpu.launcher import _free_port
         d = tempfile.mkdtemp()
         yml = os.path.join(d, "cluster.yml")
@@ -127,6 +128,7 @@ import hetu_tpu as ht
 from hetu_tpu.ps.client import PSClient
 
 OUT = %r
+BSP = %d
 V, D, B, STEPS = 16, 8, 8, 4
 rank = int(os.environ["HETU_PS_RANK"])
 
@@ -140,8 +142,9 @@ loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(logits, y), axes=0)
 train = ht.optim.SGDOptimizer(learning_rate=0.5).minimize(loss)
 
 # bsp=0: per-step BSP barrier across the two workers (reference
-# BarrierWorker, ParameterServerCommunicate.py:49-53)
-ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid", bsp=0)
+# BarrierWorker, ParameterServerCommunicate.py:49-53); bsp=k: SSP with
+# staleness bound k (reference ssp_init/ssp_sync)
+ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid", bsp=BSP)
 c = PSClient.get()
 c.BarrierWorker("post_init")     # both executors finished param_set
 
@@ -170,7 +173,7 @@ other = slice((1 - rank) * half, (2 - rank) * half)
 assert delta[other].sum() > 1e-6, delta
 open(os.path.join(OUT, f"trained{rank}"), "w").write(
     repr(losses))
-""" % d)
+""" % (d, bsp))
         port = _free_port()
         env_old = os.environ.get("HETU_PS_PORT")
         os.environ["HETU_PS_PORT"] = str(port)
