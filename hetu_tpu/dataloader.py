@@ -177,15 +177,29 @@ class Dataloader:
     # Dataloader has no state capture, SURVEY §5.4) ---- #
 
     def state_dict(self):
-        return {"consumed": self._consumed, "seed": self.seed}
+        return {"consumed": self._consumed, "seed": self.seed,
+                "shuffle": self.shuffle}
 
     def load_state_dict(self, state):
         """Fast-forward to `consumed` batches deterministically: the
         epoch permutation is a pure function of (seed, epoch), so the
-        position is computed, not replayed."""
-        assert self._ring is None and \
-            getattr(self, "_peeked", None) is None, \
-            "restore dataloader state before the first batch is drawn"
+        position is computed, not replayed.  A running prefetch ring is
+        drained and restarted at the restored position; any lookahead it
+        held is discarded."""
+        if "seed" in state and state["seed"] != self.seed:
+            raise ValueError(
+                f"dataloader '{self.name}' checkpoint was written with "
+                f"seed={state['seed']}, this loader has seed={self.seed} "
+                f"— the replayed shuffle order would silently diverge")
+        if "shuffle" in state and bool(state["shuffle"]) != self.shuffle:
+            raise ValueError(
+                f"dataloader '{self.name}' checkpoint shuffle="
+                f"{state['shuffle']} != this loader's {self.shuffle}")
+        ring = self._ring
+        if ring is not None:
+            depth, transform = ring.depth, ring.transform
+            self.stop_prefetch()
+        self._peeked = None
         self._initialized = False
         self.init_states()
         consumed = int(state["consumed"])
@@ -196,6 +210,8 @@ class Dataloader:
         self.index = min(within * self.batch_size, self.samples_num)
         self.batch_id = within
         self._consumed = consumed
+        if ring is not None:
+            self.start_prefetch(depth, transform)
 
     def peek_arr(self):
         """The batch the next get_arr() will return, without consuming it

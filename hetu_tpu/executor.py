@@ -802,9 +802,19 @@ class Executor:
 
     def _restore_loaders(self, states):
         loaders = self._loaders()
+        missing = []
         for k, st in (states or {}).items():
             if k in loaders:
                 loaders[k].load_state_dict(st)
+            else:
+                missing.append(k)
+        if missing:
+            import warnings
+            warnings.warn(
+                f"checkpoint dataloader state {missing} has no match in "
+                f"this build (graph structure changed?); those data "
+                f"streams restart from batch 0 while params resume at "
+                f"step {int(self.step)}", stacklevel=2)
 
     # ---- orbax path: sharded + async ---- #
 
@@ -856,8 +866,16 @@ class Executor:
             return jax.ShapeDtypeStruct(x.shape, x.dtype,
                                         sharding=sharding)
         target = jax.tree_util.tree_map(abstract, cur)
-        with ocp.StandardCheckpointer() as ckptr:
-            state = ckptr.restore(path, target)
+        try:
+            with ocp.StandardCheckpointer() as ckptr:
+                state = ckptr.restore(path, target)
+        except Exception:
+            # checkpoints written before dataloader state existed have a
+            # smaller tree; retry without it rather than failing restore
+            target.pop("dataloaders", None)
+            with ocp.StandardCheckpointer() as ckptr:
+                state = ckptr.restore(path, target)
+            state["dataloaders"] = None
         params = state["params"]
         for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
             if name in params:

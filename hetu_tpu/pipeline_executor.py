@@ -366,9 +366,15 @@ class PipelineSubExecutor:
                 tmpl = plan.body_params[0][pos]
                 # the stacked constraint can express only ONE spec per
                 # position: require per-layer specs to be uniform, or the
-                # template's would silently override the others
-                specs = {str(getattr(plan.body_params[r][pos],
-                                     "sharding_spec", None))
+                # template's would silently override the others.
+                # (normalize: P('tp') == P('tp', None))
+                def _norm(spec):
+                    t = tuple(spec) if spec is not None else ()
+                    while t and t[-1] is None:
+                        t = t[:-1]
+                    return t
+                specs = {_norm(getattr(plan.body_params[r][pos],
+                                       "sharding_spec", None))
                          for r in range(R)}
                 if len(specs) > 1:
                     raise ValueError(

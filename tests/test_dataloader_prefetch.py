@@ -213,3 +213,36 @@ class TestMidEpochResume:
         ex2.load(str(tmp_path))
         part2 = [float(np.asarray(ex2.run("train")[0])) for _ in range(4)]
         np.testing.assert_allclose(part1 + part2, full, atol=1e-6)
+
+
+class TestResumeRobustness:
+    def test_load_midsession_with_ring_running(self, tmp_path):
+        """Executor.load() after training started (prefetch ring live)
+        must drain + restart the ring at the restored position, not
+        crash."""
+        mk = TestMidEpochResume()
+        loss, train = mk._build("rb")
+        ex = ht.Executor({"train": [loss, train]}, prefetch=True)
+        w0 = ex.return_tensor_values()
+        full = [float(np.asarray(ex.run("train")[0])) for _ in range(9)]
+
+        loss, train = mk._build("rb")
+        ex1 = ht.Executor({"train": [loss, train]}, prefetch=True)
+        ex1.load_dict(w0)
+        part1 = [float(np.asarray(ex1.run("train")[0])) for _ in range(5)]
+        ex1.save(str(tmp_path))
+        # keep training past the save, then roll BACK mid-session — the
+        # ring is running and ahead of the restored position
+        for _ in range(3):
+            ex1.run("train")
+        ex1.load(str(tmp_path))
+        part2 = [float(np.asarray(ex1.run("train")[0])) for _ in range(4)]
+        np.testing.assert_allclose(part1 + part2, full, atol=1e-6)
+
+    def test_seed_mismatch_rejected(self):
+        dl = Dataloader(_data(32), 8, "t", shuffle=True, seed=4)
+        dl.get_arr()
+        st = dl.state_dict()
+        other = Dataloader(_data(32), 8, "t", shuffle=True, seed=5)
+        with pytest.raises(ValueError, match="seed"):
+            other.load_state_dict(st)
