@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 
 import numpy as np
 import jax
@@ -179,6 +180,18 @@ def _wire_prefetch(sub):
         loader.start_prefetch(transform=transform)
 
 
+def stable_rng_ids(sub):
+    """node.id -> topo position: a build-invariant RNG stream index
+    (two builds of the same graph give every node the same position,
+    while raw ids shift with the global counter).  Cached on the
+    subexecutor; shared by the plain and pipeline executors so their
+    dropout/rand streams follow one contract."""
+    ids = getattr(sub, "_rng_ids", None)
+    if ids is None:
+        ids = sub._rng_ids = {n.id: i for i, n in enumerate(sub.topo)}
+    return ids
+
+
 def gather_feeds(sub, feed_dict):
     """Collect dataloader + fed values into a name-keyed dict, coercing
     dtypes host-side.  Device-resident jax.Arrays pass through untouched
@@ -262,10 +275,14 @@ class SubExecutor:
 
     # ------------------------------------------------------------------ #
 
+    def _stable_rng_ids(self):
+        return stable_rng_ids(self)
+
     def _trace(self, params, opt_states, step, rng, feeds):
         tc = TraceContext(params=_ParamView(params), rng=rng,
                           training=self.training, mesh=self.executor.mesh,
                           config=self.executor.config, step=step)
+        tc.rng_ids = self._stable_rng_ids()
         tc.extra_outputs = _ExtraOutputs()
         vals = {}
         new_opt_states = dict(opt_states)
@@ -331,12 +348,15 @@ class SubExecutor:
         ex = self.executor
 
         def step_fn(params, opt_states, step, rng, feeds):
+            # rng splits INSIDE the jitted program (an eager per-step
+            # split is a full host<->device round trip on a tunneled TPU)
+            new_rng, sub = jax.random.split(rng)
             new_params, new_opt, outputs, side = self._trace(
-                params, opt_states, step, rng, feeds)
+                params, opt_states, step, sub, feeds)
             # only optimizer steps advance the counter — eval passes must
             # not skew Adam bias correction / LR schedules
             new_step = step + 1 if self.training else step
-            return new_params, new_opt, new_step, outputs, side
+            return new_params, new_opt, new_step, new_rng, outputs, side
 
         jit_kwargs = dict(donate_argnums=(0, 1))
         if ex.mesh is not None:
@@ -350,7 +370,8 @@ class SubExecutor:
             # pin updated params/opt states to their input shardings —
             # otherwise GSPMD may pick a different output layout and the
             # next step's in_shardings check fails
-            jit_kwargs["out_shardings"] = (param_sh, opt_sh, rep, None, None)
+            jit_kwargs["out_shardings"] = (param_sh, opt_sh, rep, rep,
+                                           None, None)
         return jax.jit(step_fn, **jit_kwargs)
 
     @property
@@ -370,9 +391,8 @@ class SubExecutor:
         fn = self._compiled[feed_sig]
         if ex.mesh is not None:
             feeds = {k: ex.device_put_feed(k, v) for k, v in feeds.items()}
-        ex.rng, sub = jax.random.split(ex.rng)
-        ex.var_values, ex.opt_states, ex.step, outputs, side = fn(
-            ex.var_values, ex.opt_states, ex.step, sub, feeds)
+        ex.var_values, ex.opt_states, ex.step, ex.rng, outputs, side = fn(
+            ex.var_values, ex.opt_states, ex.step, ex.rng, feeds)
         if self.ps_var_names and self.training:
             self._ps_phase_b(side, ps_ids)
         self._ps_prefetch()
@@ -821,8 +841,7 @@ class Executor:
     def _orbax_state(self):
         state = {"params": dict(self.var_values),
                  "opt_states": self.opt_states,
-                 "step": self.step, "rng": self.rng,
-                 "dataloaders": self._loader_states()}
+                 "step": self.step, "rng": self.rng}
         for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
             ct = self.cstables.get(name)
             if ct is not None:
@@ -832,19 +851,52 @@ class Executor:
         return state
 
     def _save_orbax(self, path, async_=False):
+        import json
         import orbax.checkpoint as ocp
+        loaders_file = os.path.join(os.path.abspath(path), "loaders.json")
         path = os.path.abspath(os.path.join(path, "orbax"))
         self.wait_for_checkpoint()
+        # dataloader positions are a handful of host-side scalars; a JSON
+        # sidecar keeps them out of the sharded tree so per-loader schema
+        # changes can never make the orbax restore structure-mismatch.
+        # The payload is stamped with the step and published (atomic
+        # rename) only AFTER the orbax tree is durable, so a crash at any
+        # point leaves either a matching pair or a stamp mismatch the
+        # restore detects — never a silent position/params divergence.
+        payload = json.dumps({"step": int(self.step),
+                              "loaders": self._loader_states()},
+                             default=int)
+
+        def publish():
+            os.makedirs(os.path.dirname(loaders_file), exist_ok=True)
+            tmp = loaders_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, loaders_file)
+
         if async_:
-            self._async_ckptr = ocp.AsyncCheckpointer(
+            ck = self._async_ckptr = ocp.AsyncCheckpointer(
                 ocp.StandardCheckpointHandler())
-            self._async_ckptr.save(path, args=ocp.args.StandardSave(
+            ck.save(path, args=ocp.args.StandardSave(
                 self._orbax_state()), force=True)
+
+            def wait_then_publish():
+                ck.wait_until_finished()
+                publish()
+
+            self._sidecar_thread = threading.Thread(
+                target=wait_then_publish, daemon=True)
+            self._sidecar_thread.start()
         else:
             with ocp.StandardCheckpointer() as ckptr:
                 ckptr.save(path, self._orbax_state(), force=True)
+            publish()
 
     def wait_for_checkpoint(self):
+        t = getattr(self, "_sidecar_thread", None)
+        if t is not None:
+            t.join()
+            self._sidecar_thread = None
         ck = getattr(self, "_async_ckptr", None)
         if ck is not None:
             ck.wait_until_finished()
@@ -856,7 +908,13 @@ class Executor:
         THIS executor's shardings (resharding across different meshes /
         layouts happens inside orbax — a tp2-saved checkpoint restores
         onto an fsdp8 executor without a full-state host bounce)."""
+        import json
         import orbax.checkpoint as ocp
+        # join any in-flight async save first: its sidecar publishes only
+        # after the orbax finalize, and restoring inside that window would
+        # silently drop the dataloader positions
+        self.wait_for_checkpoint()
+        loaders_file = os.path.join(os.path.abspath(path), "loaders.json")
         path = os.path.abspath(os.path.join(path, "orbax"))
         cur = self._orbax_state()
 
@@ -866,16 +924,45 @@ class Executor:
             return jax.ShapeDtypeStruct(x.shape, x.dtype,
                                         sharding=sharding)
         target = jax.tree_util.tree_map(abstract, cur)
+        loader_states, sidecar_step = None, None
+        if os.path.exists(loaders_file):
+            with open(loaders_file) as f:
+                sidecar = json.load(f)
+            loader_states = sidecar.get("loaders", sidecar)
+            sidecar_step = sidecar.get("step")
         try:
             with ocp.StandardCheckpointer() as ckptr:
                 state = ckptr.restore(path, target)
-        except Exception:
-            # checkpoints written before dataloader state existed have a
-            # smaller tree; retry without it rather than failing restore
-            target.pop("dataloaders", None)
-            with ocp.StandardCheckpointer() as ckptr:
-                state = ckptr.restore(path, target)
-            state["dataloaders"] = None
+        except Exception as core_err:
+            # checkpoints from builds that stored dataloader state INSIDE
+            # the orbax tree (orbax needs an exact tree match, so the
+            # core-only target above fails on them): retry with that
+            # subtree mirrored from each schema those builds ever wrote.
+            # If none matches, surface the original error — don't let the
+            # compat chain mask a real shape/dtype problem.
+            def loader_target(keys):
+                # np dtypes: orbax stored the in-tree python scalars as
+                # int64/bool_, not jax's int32 default
+                return {
+                    name: {k: jax.ShapeDtypeStruct(
+                        (), np.asarray(v).dtype)
+                        for k, v in st.items() if k in keys}
+                    for name, st in self._loader_states().items()}
+
+            state = None
+            for keys in (("consumed", "seed", "shuffle"),
+                         ("consumed", "seed")):
+                t2 = dict(target)
+                t2["dataloaders"] = loader_target(keys)
+                try:
+                    with ocp.StandardCheckpointer() as ckptr:
+                        state = ckptr.restore(path, t2)
+                    loader_states = state.pop("dataloaders", None)
+                    break
+                except Exception:
+                    state = None
+            if state is None:
+                raise core_err
         params = state["params"]
         for name in list(self.ps_sparse_vars) + list(self.ps_dense_vars):
             if name in params:
@@ -886,8 +973,19 @@ class Executor:
         self.opt_states = state["opt_states"]
         self.step = jnp.asarray(state["step"], jnp.int32)
         self.rng = jnp.asarray(state["rng"], jnp.uint32)
-        if state.get("dataloaders"):
-            self._restore_loaders(state["dataloaders"])
+        if loader_states and sidecar_step is not None \
+                and sidecar_step != int(self.step):
+            # crash window between the orbax finalize and the sidecar
+            # publish (or vice versa): positions belong to another save
+            import warnings
+            warnings.warn(
+                f"dataloader sidecar is stamped step {sidecar_step} but "
+                f"the checkpoint restored step {int(self.step)}; "
+                f"ignoring it — data streams restart from batch 0",
+                stacklevel=2)
+            loader_states = None
+        if loader_states:
+            self._restore_loaders(loader_states)
 
     def load(self, path, file=None, consider_splits=False):
         if os.path.isdir(os.path.join(path, "orbax")) and not os.path.exists(

@@ -39,11 +39,18 @@ class TraceContext:
         self.config = config
         self.step = step
         self.extra_outputs = {}
+        # node.id -> stable stream index (topo position).  The raw global
+        # id counter differs between two builds of the same graph (e.g.
+        # checkpoint resume in a process that built a graph before), so
+        # executors install topo positions here to keep dropout/rand
+        # streams — and therefore resumed trajectories — build-invariant.
+        self.rng_ids = {}
 
     def rng_for(self, node) -> jax.Array:
         assert self._rng is not None, (
             "op %s needs an RNG key but the trace has none" % node)
-        return jax.random.fold_in(self._rng, node.id)
+        return jax.random.fold_in(
+            self._rng, self.rng_ids.get(node.id, node.id))
 
     def has_axis(self, name) -> bool:
         return name in self.axis_env

@@ -104,6 +104,9 @@ class VJPOp(Op):
             params=tc.params, rng=tc._rng, training=tc.training,
             mesh=tc.mesh, axis_env=tc.axis_env, config=tc.config,
             step=tc.step)
+        # same RNG stream ids as the outer trace — the recomputed forward
+        # must see the identical dropout mask the primal forward used
+        inner_tc.rng_ids = tc.rng_ids
 
         def primal(*a):
             return self._orig.compute(list(a), inner_tc)

@@ -177,12 +177,17 @@ class PipelineSubExecutor:
                 vals[id(node)] = node.compute(ins, tc)
         return vals
 
+    def _stable_rng_ids(self):
+        from .executor import stable_rng_ids
+        return stable_rng_ids(self)
+
     def _forward_loss(self, params, feeds, rng, step):
         """Full-graph forward for one microbatch -> (loss, extra_outputs)."""
         from .executor import _ParamView
         tc = TraceContext(params=_ParamView(params), rng=rng, training=True,
                           mesh=self.executor.mesh,
                           config=self.executor.config, step=step)
+        tc.rng_ids = self._stable_rng_ids()
         tc.extra_outputs = {}
         vals = self._trace_nodes(self.topo, params, feeds, tc)
         loss = vals[id(self.loss_node)]
@@ -345,6 +350,7 @@ class PipelineSubExecutor:
             def pre_one(fmb, r):
                 tc = TraceContext(params={}, rng=r, training=True,
                                   mesh=mesh, config=cfg, step=step)
+                tc.rng_ids = self._stable_rng_ids()
                 vals = self._trace_nodes(plan.pre_nodes, params,
                                          {**fmb, **whole}, tc)
                 return vals[id(plan.body_entry)]
@@ -380,7 +386,8 @@ class PipelineSubExecutor:
                     raise ValueError(
                         f"pipeline body param position {pos} "
                         f"({tmpl.name}-like) has non-uniform sharding "
-                        f"specs across layers ({sorted(specs)}); give "
+                        f"specs across layers ({sorted(map(str, specs))}); "
+                        f"give "
                         f"every body layer the same spec")
                 leaves = [entry_cast(params[plan.body_params[r][pos].name])
                           for r in range(R)]
@@ -412,6 +419,7 @@ class PipelineSubExecutor:
                                       rng=jax.random.fold_in(r, bi),
                                       training=True, mesh=mesh, config=cfg,
                                       step=step, axis_env=mesh.axis_names)
+                    tc.rng_ids = self._stable_rng_ids()
                     return self._apply_template_block(list(pr), h, tc), None
                 h, _ = jax.lax.scan(blk, x, (plist, jnp.arange(rps)))
                 return h
@@ -426,6 +434,7 @@ class PipelineSubExecutor:
                 tc = TraceContext(params={}, rng=jax.random.fold_in(r, 13),
                                   training=True, mesh=mesh, config=cfg,
                                   step=step)
+                tc.rng_ids = self._stable_rng_ids()
                 seed = {id(plan.body_blocks[-1].boundary_out): y}
                 vals = self._trace_nodes(plan.post_nodes, params,
                                          {**fmb, **whole}, tc,
@@ -439,7 +448,15 @@ class PipelineSubExecutor:
 
     def _compile(self, feed_sig):
         ex = self.executor
-        step_fn = self._make_step_fn()
+        inner = self._make_step_fn()
+
+        def step_fn(params, opt_states, step, rng, feeds):
+            # rng splits INSIDE the jitted program (an eager per-step
+            # split is a full host<->device round trip on a tunneled TPU)
+            new_rng, sub = jax.random.split(rng)
+            p, o, s, loss = inner(params, opt_states, step, sub, feeds)
+            return p, o, s, new_rng, loss
+
         jit_kwargs = dict(donate_argnums=(0, 1))
         if ex.mesh is not None:
             from .executor import _opt_sharding_like
@@ -450,7 +467,7 @@ class PipelineSubExecutor:
             opt_sh = _opt_sharding_like(ex, ex.opt_states)
             jit_kwargs["in_shardings"] = (
                 param_sh, opt_sh, rep, rep, feed_sh)
-            jit_kwargs["out_shardings"] = (param_sh, opt_sh, rep, None)
+            jit_kwargs["out_shardings"] = (param_sh, opt_sh, rep, rep, None)
         return jax.jit(step_fn, **jit_kwargs)
 
     # ------------------------------------------------------------------ #
@@ -472,9 +489,8 @@ class PipelineSubExecutor:
         fn = self._compiled[feed_sig]
         if ex.mesh is not None:
             feeds = {k: ex.device_put_feed(k, v) for k, v in feeds.items()}
-        ex.rng, sub = jax.random.split(ex.rng)
-        ex.var_values, ex.opt_states, ex.step, loss = fn(
-            ex.var_values, ex.opt_states, ex.step, sub, feeds)
+        ex.var_values, ex.opt_states, ex.step, ex.rng, loss = fn(
+            ex.var_values, ex.opt_states, ex.step, ex.rng, feeds)
         self._batches_seen += 1
         if self.mode == "hetpipe" and \
                 self._batches_seen % self.sync_every == 0:

@@ -78,6 +78,36 @@ class TestStableOptNames:
         np.testing.assert_allclose(got, base, atol=1e-6)
 
 
+class TestRngResume:
+    def test_dropout_trajectory_exact_across_rebuilds(self, tmp_path):
+        """RNG streams are keyed by topo position, not the global node-id
+        counter: a graph rebuilt later in the same process (shifted ids)
+        must resume a dropout model's trajectory bit-exactly, and the VJP
+        recompute must see the same mask as the primal forward."""
+        def build_do():
+            x = ht.placeholder_op("xr")
+            w = ht.init.xavier_uniform((IN, IN), name="rr_w")
+            h = ht.dropout_op(ht.matmul_op(x, w), 0.5)
+            loss = ht.reduce_mean_op(ht.mul_op(h, h), axes=[0, 1])
+            train = ht.optim.AdamOptimizer(learning_rate=0.01).minimize(
+                loss)
+            return x, ht.Executor({"train": [loss, train]}, seed=7)
+
+        X = np.random.RandomState(0).randn(BATCH, IN).astype(np.float32)
+        x, ex = build_do()
+        for _ in range(3):
+            ex.run("train", feed_dict={x: X})
+        ex.save(str(tmp_path), "rng_ck.pkl")
+        base = [float(ex.run("train", feed_dict={x: X})[0])
+                for _ in range(3)]
+
+        x, ex2 = build_do()          # fresh nodes, shifted id counter
+        ex2.load(str(tmp_path), "rng_ck.pkl")
+        got = [float(ex2.run("train", feed_dict={x: X})[0])
+               for _ in range(3)]
+        np.testing.assert_allclose(got, base, atol=1e-7)
+
+
 class TestShardedCheckpoint:
     def test_sharded_roundtrip_reshards_across_layouts(self, tmp_path):
         """Save under tp2 x dp4, restore onto fsdp8 — the trajectory must

@@ -87,9 +87,10 @@ class TestCheck:
     def test_scan_bodies_walked(self, mesh):
         def body(x):
             def tick(c, _):
-                # psum output is axis-invariant; pvary restores the carry's
+                # psum output is axis-invariant; pcast restores the carry's
                 # varying-axes type so scan's carry typing is stable
-                return jax.lax.pvary(jax.lax.psum(c, "dp"), "dp"), None
+                return jax.lax.pcast(jax.lax.psum(c, "dp"), "dp",
+                                     to="varying"), None
             out, _ = jax.lax.scan(tick, x, jnp.arange(3))
             return out
 
