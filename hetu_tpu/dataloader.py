@@ -83,6 +83,11 @@ class _PrefetchRing:
         with self.cv:
             self.stopped = True
             self.cv.notify_all()
+        # join: an in-flight producer() mutates the loader's position
+        # state; callers (load_state_dict) reset that state right after
+        # stop() and must not race the worker's last write
+        if self.thread is not threading.current_thread():
+            self.thread.join()
 
 
 class Dataloader:
