@@ -26,9 +26,23 @@ logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
 logger = logging.getLogger("ctr")
 
 
-def synthetic_criteo(rng, n, feature_dimension):
+def zipf_ids(rng, dim, size, a):
+    """Zipf-skewed categorical ids (CTR id frequencies are power-law —
+    the skew the HET cache exploits); a<=0 falls back to uniform."""
+    if a <= 0:
+        return rng.randint(0, dim, size).astype(np.int32)
+    ranks = np.arange(1, dim + 1, dtype=np.float64)
+    p = ranks ** -a
+    p /= p.sum()
+    ids = rng.choice(dim, size=size, p=p)
+    # hotness should not imply row locality: scatter hot ids over the table
+    perm = rng.permutation(dim)
+    return perm[ids].astype(np.int32)
+
+
+def synthetic_criteo(rng, n, feature_dimension, zipf=1.05):
     dense = rng.randn(n, 13).astype(np.float32)
-    sparse = rng.randint(0, feature_dimension, (n, 26)).astype(np.int32)
+    sparse = zipf_ids(rng, feature_dimension, (n, 26), zipf)
     y = rng.randint(0, 2, (n, 1)).astype(np.float32)
     return dense, sparse, y
 
@@ -47,10 +61,20 @@ def main():
                         help="None / AllReduce / PS / Hybrid")
     parser.add_argument("--cache", default=None,
                         help="cstable policy: lru / lfu / lfuopt")
-    parser.add_argument("--cache-bound", type=int, default=100)
+    parser.add_argument("--cache-bound", type=int, default=None,
+                        help="cache capacity in rows (default: 10%% of "
+                             "--feature-dim)")
+    parser.add_argument("--zipf", type=float, default=1.05,
+                        help="id skew exponent for synthetic data "
+                             "(0 = uniform)")
+    parser.add_argument("--bf16", action="store_true",
+                        help="bf16 compute + bf16 embedding-row "
+                             "transfers; fp32 masters on the PS")
     parser.add_argument("--all", action="store_true",
                         help="eval AUC each 10 steps")
     args = parser.parse_args()
+    if args.cache_bound is None:
+        args.cache_bound = max(args.feature_dim // 10, 1024)
 
     rng = np.random.RandomState(0)
     if args.model == "wdl_adult":
@@ -73,24 +97,35 @@ def main():
             return feeds
     else:
         builder = getattr(models, args.model)
-        dense = ht.placeholder_op("dense")
-        sparse = ht.placeholder_op("sparse")
-        y_ = ht.placeholder_op("y_")
+        # feed through dataloaders: the ring prefetches batches and the
+        # executor overlaps the NEXT batch's PS/cache embedding lookup
+        # with the current step (placeholder feeds cannot be peeked)
+        n_pool = 32
+        d, s, y = synthetic_criteo(rng, n_pool * args.batch_size,
+                                   args.feature_dim, args.zipf)
+        dense = ht.dataloader_op([ht.Dataloader(d, args.batch_size,
+                                                "train")])
+        sparse = ht.dataloader_op([ht.Dataloader(s, args.batch_size,
+                                                 "train")])
+        y_ = ht.dataloader_op([ht.Dataloader(y, args.batch_size,
+                                             "train")])
         loss, pred, label, train_op = builder(
             dense, sparse, y_, feature_dimension=args.feature_dim,
             embedding_size=args.embedding_size)
 
         def batch():
-            d, s, y = synthetic_criteo(rng, args.batch_size,
-                                       args.feature_dim)
-            return {dense: d, sparse: s, y_: y}
+            return None
 
     executor = ht.Executor({"train": [loss, pred, label, train_op]},
                            comm_mode=args.comm_mode,
                            cstable_policy=args.cache,
-                           cache_bound=args.cache_bound)
+                           cache_bound=args.cache_bound,
+                           mixed_precision="bf16" if args.bf16 else None)
+    out = executor.run("train", feed_dict=batch())  # compile + warmup
+    logger.info("step 0 loss=%.4f (compile)",
+                float(np.asarray(out[0]).reshape(-1)[0]))
     t0 = time.time()
-    for step in range(args.num_steps):
+    for step in range(1, args.num_steps):
         out = executor.run("train", feed_dict=batch())
         if step % 10 == 0 or step == args.num_steps - 1:
             dt = time.time() - t0
@@ -110,7 +145,7 @@ def main():
                 msg += " cache_hit=%.3f" % hr
             logger.info("step %d loss=%.4f (%.1f samples/s)%s", step,
                         float(np.asarray(out[0]).reshape(-1)[0]),
-                        (step + 1) * args.batch_size / dt, msg)
+                        step * args.batch_size / dt, msg)
 
 
 if __name__ == "__main__":

@@ -180,6 +180,16 @@ def _wire_prefetch(sub):
         loader.start_prefetch(transform=transform)
 
 
+def _bucket_len(n):
+    """Next power of two >= n (min 64): pads the variable unique-row
+    count to a handful of shapes so the shape-keyed compile cache stays
+    small while the host link still ships ~n rows."""
+    b = 64
+    while b < n:
+        b <<= 1
+    return b
+
+
 def stable_rng_ids(sub):
     """node.id -> topo position: a build-invariant RNG stream index
     (two builds of the same graph give every node the same position,
@@ -300,8 +310,14 @@ class SubExecutor:
         from .dataloader import DataloaderOp
         for node in self.topo:
             if id(node) in self._ps_lookup_ids:
-                # PS-managed embedding: rows pre-gathered host-side
-                vals[id(node)] = _cast_in(feeds["__psrows__" + node.name])
+                # PS-managed embedding: UNIQUE rows pre-gathered
+                # host-side; the in-trace gather re-expands them
+                # (device-side dedup — the host link carries U unique
+                # rows, not B*T positions; reference dedups on GPU via
+                # IndexedSlices, src/ops/IndexedSlices.cu)
+                uniq = _cast_in(feeds["__psuniq__" + node.name])
+                inv = feeds["__psinv__" + node.name]
+                vals[id(node)] = jnp.take(uniq, inv, axis=0)
             elif isinstance(node, DataloaderOp):
                 vals[id(node)] = _cast_in(feeds[node.name])
             elif isinstance(node, PlaceholderOp):
@@ -328,6 +344,23 @@ class SubExecutor:
             else:
                 vals[id(node)] = node.compute(
                     [vals[id(i)] for i in node.inputs], tc)
+        # dedup the embedding grads on DEVICE: segment-sum per-position
+        # rows into the unique-row slots so phase B ships U rows back,
+        # mirroring the forward's unique-row feed
+        for lk in self.ps_lookups:
+            var = lk.inputs[0].name
+            if var in side_outputs and var in self.executor.ps_sparse_vars:
+                inv = feeds["__psinv__" + lk.name].reshape(-1)
+                rows = side_outputs[var]
+                upad = feeds["__psuniq__" + lk.name].shape[0]
+                g_uniq = jnp.zeros(
+                    (upad, rows.shape[-1]), rows.dtype).at[inv].add(rows)
+                if mp is not None:
+                    # grads were computed in the policy dtype; shipping
+                    # them D2H at that width halves the host-link bytes
+                    # (the PS applies the update in fp32 regardless)
+                    g_uniq = g_uniq.astype(mp)
+                side_outputs[var] = g_uniq
         outputs = [vals[id(n)] for n in self.eval_nodes]
         if mp is not None:
             # report losses/metrics in fp32
@@ -415,7 +448,11 @@ class SubExecutor:
     # ------------------------------------------------------------------ #
 
     def _ps_phase_a(self, feeds):
-        """Gather rows for every PS-managed lookup; returns {var: ids}."""
+        """Gather UNIQUE rows for every PS-managed lookup; returns
+        {var: unique ids}.  The host link (PCIe in the reference, the
+        tunnel here) carries U unique rows, padded to power-of-two
+        buckets so the jitted step compiles a handful of shapes, not one
+        per batch; the in-trace gather re-expands to B*T positions."""
         ex = self.executor
         ps_ids = {}
         for lk in self.ps_lookups:
@@ -424,11 +461,27 @@ class SubExecutor:
             ids = np.asarray(feeds[src.name])
             pre = self._prefetched.pop(lk.name, None)
             if pre is not None and np.array_equal(pre[0], ids):
-                rows = pre[1].result()
+                _, uniq, inv, fut = pre
+                rows = fut.result()
             else:
-                rows = ex.ps_lookup(var_name, ids)
-            feeds["__psrows__" + lk.name] = np.asarray(rows, np.float32)
-            ps_ids[var_name] = ids
+                uniq, inv = np.unique(
+                    ids.reshape(-1).astype(np.int64), return_inverse=True)
+                rows = ex.ps_lookup(var_name, uniq)
+            rows = np.asarray(rows, np.float32).reshape(len(uniq), -1)
+            mp = ex.config.mixed_precision
+            if mp is not None:
+                # the trace casts float feeds to the policy dtype anyway;
+                # casting host-side halves the H2D bytes for the rows
+                rows = rows.astype(mp)
+            upad = _bucket_len(len(uniq))
+            if upad > len(uniq):
+                rows = np.concatenate(
+                    [rows, np.zeros((upad - len(uniq), rows.shape[1]),
+                                    rows.dtype)])
+            feeds["__psuniq__" + lk.name] = rows
+            feeds["__psinv__" + lk.name] = \
+                inv.reshape(ids.shape).astype(np.int32)
+            ps_ids[var_name] = uniq
         # dense-PS params ('PS' mode): refresh from the server so other
         # workers' pushes are visible (BSP/SSP pacing via config.bsp)
         for name in ex.ps_dense_vars:
@@ -441,12 +494,15 @@ class SubExecutor:
         return ps_ids
 
     def _ps_phase_b(self, side, ps_ids):
-        """Push grads: sparse rows -> cache/PS, dense grads -> PS."""
+        """Push grads: sparse rows -> cache/PS, dense grads -> PS.
+        Sparse rows arrive already segment-summed into unique-row slots
+        (device-side dedup), so the push is duplicate-free."""
         ex = self.executor
         for var_name, g in side.items():
             g = np.asarray(g, np.float32)
             if var_name in ex.ps_sparse_vars:
-                ex.ps_update(var_name, ps_ids[var_name], g)
+                uniq = ps_ids[var_name]
+                ex.ps_update(var_name, uniq, g[:len(uniq)])
             else:
                 ex.ps_comm.push(var_name, g)
                 ex.ps_dense_dirty[var_name] = True
@@ -468,9 +524,11 @@ class SubExecutor:
             except Exception:
                 continue
             var_name = lk.inputs[0].name
-            fut = ex.ps_lookup_async(var_name, ids)
+            uniq, inv = np.unique(
+                ids.reshape(-1).astype(np.int64), return_inverse=True)
+            fut = ex.ps_lookup_async(var_name, uniq)
             if fut is not None:
-                self._prefetched[lk.name] = (ids, fut)
+                self._prefetched[lk.name] = (ids, uniq, inv, fut)
 
 
 def _opt_sharding_like(ex, opt_states):

@@ -32,10 +32,13 @@ def _make_gate(gate_type, embed_dim, num_tokens, num_experts, top_k,
 
 def moe_mlp(x, y_, batch_size, num_tokens, model_dim, hidden_size,
             num_local_experts=2, all2all_size=1, gate_type="top", top_k=2,
-            device_id=0, hierarchical=False):
+            device_id=0, hierarchical=False, sparse_labels=False):
     """MoE classifier (reference test_moe_base/top/hash/ktop1/sam.py).
 
-    x: (B, T, D) tokens; y_: (B*T, C) one-hot.  Returns (loss, y).
+    x: (B, T, D) tokens; y_: (B*T, C) one-hot, or (B*T,) int class ids
+    with ``sparse_labels=True`` (C=model_dim one-hot targets are ~1000x
+    the host->device bytes of int ids — feed sparse on TPU).
+    Returns (loss, y).
     """
     experts = [
         htl.Expert(embed_dim=model_dim, ffn_dim=hidden_size,
@@ -54,12 +57,14 @@ def moe_mlp(x, y_, batch_size, num_tokens, model_dim, hidden_size,
                          name=layer_name, top=top_k,
                          hierarchical=hierarchical)
     out = model(x)
+    ce = softmaxcrossentropy_sparse_op if sparse_labels \
+        else softmaxcrossentropy_op
     if gate_type == "balance":
         y = out
-        loss = reduce_mean_op(softmaxcrossentropy_op(y, y_), [0])
+        loss = reduce_mean_op(ce(y, y_), [0])
     else:
         y, l_aux = out
-        loss = reduce_mean_op(softmaxcrossentropy_op(y, y_), [0])
+        loss = reduce_mean_op(ce(y, y_), [0])
         if l_aux is not None:  # HashGate has no balance loss
             loss = loss + l_aux
     return loss, y
