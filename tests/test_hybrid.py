@@ -110,6 +110,31 @@ class TestHybridEquivalence:
         np.testing.assert_allclose(tr, base, atol=1e-5)
 
 
+class TestDedupPath:
+    def test_heavy_duplication_matches_dense(self):
+        """Device-side dedup (unique-row feed + segment-summed grads):
+        with a tiny vocab every batch is dominated by duplicate ids, so
+        a double-count or dropped duplicate shows up immediately against
+        the dense baseline."""
+        fresh_ps()
+        vocab = 5
+        ids, y, loss, train = build_model()
+        ex = ht.Executor({"train": [loss, train]})
+        w0 = ex.return_tensor_values()
+        rng = np.random.RandomState(3)
+        batches = [(rng.randint(0, vocab, (16, 2)).astype(np.int32),
+                    np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)])
+                   for _ in range(6)]
+        base = run_trajectory(ex, ids, y, batches)
+
+        fresh_ps()
+        ids, y, loss, train = build_model()
+        ex2 = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid")
+        ex2.load_dict(w0)
+        got = run_trajectory(ex2, ids, y, batches)
+        np.testing.assert_allclose(got, base, atol=1e-6)
+
+
 class TestCacheBehavior:
     def test_cache_hit_rate_counted(self):
         fresh_ps()
