@@ -61,13 +61,28 @@ def _finalize(l, o):
     return o / denom
 
 
+def _batch_axis(mesh, cp_axis, batch):
+    """Shard the batch dim over 'dp' when the mesh has one and the batch
+    divides it: entering the shard_map with the batch replicated forces
+    GSPMD into a full rematerialization (unshard/reshard) around every
+    call — the body does no cross-batch communication, so slicing it per
+    dp device is free.  Indivisible batches (e.g. B=1 inference on a
+    training mesh) stay replicated."""
+    if "dp" in mesh.axis_names and cp_axis != "dp" \
+            and batch % mesh.shape["dp"] == 0:
+        return "dp"
+    return None
+
+
 def ring_attention(q, k, v, *, mesh, axis="cp", causal=False):
     """Blockwise ring attention over sequence-sharded q/k/v.
 
     Args:
       q, k, v: [B, S, H, D] arrays; the S dim is (or will be) sharded over
         ``axis``.  Pass either global (replicated/sharded jax.Arrays under
-        jit) — shard_map slices per device.
+        jit) — shard_map slices per device.  If the mesh has a 'dp' axis
+        and B divides it, the batch dim is dp-sharded too (replicated
+        otherwise).
       causal: apply a causal mask using global positions.
 
     Returns [B, S, H, D] attention output, sequence-sharded like q.
@@ -76,6 +91,7 @@ def ring_attention(q, k, v, *, mesh, axis="cp", causal=False):
     S = q.shape[1]
     assert S % cp == 0, f"seq {S} not divisible by cp={cp}"
     blk = S // cp
+    bax = _batch_axis(mesh, axis, q.shape[0])
 
     def per_device(q, k, v):
         # local blocks [B, blk, H, D]
@@ -84,8 +100,11 @@ def ring_attention(q, k, v, *, mesh, axis="cp", causal=False):
         m = jnp.full((B, H, blk), NEG_INF, q.dtype)
         l = jnp.zeros((B, H, blk), q.dtype)
         o = jnp.zeros_like(q)  # varying already (derived from sharded q)
-        m = jax.lax.pcast(m, (axis,), to="varying")
-        l = jax.lax.pcast(l, (axis,), to="varying")
+        # carry typing: m/l must vary over every manual axis q varies
+        # over, or the scan carry changes type after the first update
+        vary = (axis,) if bax is None else (axis, bax)
+        m = jax.lax.pcast(m, vary, to="varying")
+        l = jax.lax.pcast(l, vary, to="varying")
         shift = [(i, (i + 1) % cp) for i in range(cp)]
         q_pos = my * blk + jnp.arange(blk)
 
@@ -108,7 +127,7 @@ def ring_attention(q, k, v, *, mesh, axis="cp", causal=False):
             step, (k, v, m, l, o), jnp.arange(cp))
         return _finalize(l, o)
 
-    spec = P(None, axis, None, None)
+    spec = P(bax, axis, None, None)
     return shard_map(per_device, mesh=mesh,
                      in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
 
@@ -118,8 +137,10 @@ def ulysses_attention(q, k, v, *, mesh, axis="cp", causal=False,
     """DeepSpeed-Ulysses-style: all_to_all seq<->head, full local attention.
 
     q, k, v: [B, S, H, D] with S sharded over ``axis``; requires cp | H.
-    ``attn_fn(q, k, v, causal)`` may override the local attention (e.g. the
-    Pallas flash kernel); default is exact softmax attention.
+    The batch dim additionally shards over a 'dp' mesh axis when B
+    divides it.  ``attn_fn(q, k, v, causal)`` may override the local
+    attention (e.g. the Pallas flash kernel); default is exact softmax
+    attention.
     """
     cp = mesh.shape[axis]
     B, S, H, D = q.shape
@@ -146,7 +167,7 @@ def ulysses_attention(q, k, v, *, mesh, axis="cp", causal=False,
         ol = attn_fn(ql, kl, vl, causal)
         return head_to_seq(ol)
 
-    spec = P(None, axis, None, None)
+    spec = P(_batch_axis(mesh, axis, q.shape[0]), axis, None, None)
     # check_vma off: attn_fn may be a pallas_call, whose out_shape carries
     # no varying-axes info under shard_map's vma tracking
     return shard_map(per_device, mesh=mesh, in_specs=(spec, spec, spec),

@@ -84,3 +84,19 @@ def test_ring_composes_with_dp():
     want = _exact(q, k, v, False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_ring_indivisible_batch_stays_replicated():
+    """B=1 on a dp mesh (eval / partial last batch): the batch dim must
+    fall back to replicated instead of failing the dp split."""
+    mesh = make_mesh({"dp": 2, "cp": 4})
+    rng = np.random.RandomState(7)
+    q, k, v = (jnp.asarray(rng.randn(1, S, H, D), jnp.float32)
+               for _ in range(3))
+    got = ring_attention(q, k, v, mesh=mesh, causal=True)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    p = jax.nn.softmax(jnp.where(mask[None, None], s, -1e30), axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
