@@ -487,9 +487,11 @@ class SubExecutor:
         for name in ex.ps_dense_vars:
             if ex.ps_dense_dirty.pop(name, False):
                 val = ex.ps_comm.pull(name)
-                arr = jnp.asarray(val)
                 if ex.mesh is not None:
-                    arr = jax.device_put(arr, ex.param_sharding(name))
+                    arr = ex.place_value(np.asarray(val),
+                                         ex.param_sharding(name))
+                else:
+                    arr = jnp.asarray(val)
                 ex.var_values[name] = arr
         return ps_ids
 
@@ -592,7 +594,7 @@ class Executor:
                            if name not in self.ps_sparse_vars}
         if self.mesh is not None:
             self.var_values = {
-                k: jax.device_put(v, self.param_sharding(k))
+                k: self.place_value(v, self.param_sharding(k))
                 for k, v in self.var_values.items()}
 
         self.subexecutor = {}
@@ -787,6 +789,42 @@ class Executor:
     # sharding helpers
     # ------------------------------------------------------------------ #
 
+    @property
+    def multiprocess(self):
+        """True when the mesh spans jax processes (multi-host SPMD over
+        DCN/ICI via jax.distributed; reference's multi-node NCCL/MPI
+        role, SURVEY §5.8).  Every process must build the identical graph
+        and run the identical steps.  Cached: the mesh is fixed at
+        construction and this sits on the per-feed hot path."""
+        mpv = getattr(self, "_multiprocess", None)
+        if mpv is None:
+            if self.mesh is None:
+                mpv = False
+            else:
+                pid = jax.process_index()
+                mpv = any(d.process_index != pid
+                          for d in self.mesh.devices.flat)
+            self._multiprocess = mpv
+        return mpv
+
+    def place_value(self, value, sharding):
+        """Place a host (or replicated-device) value with `sharding`.
+        Single-process: plain device_put.  Multi-process: device_put of a
+        cross-process sharding is illegal, so each process supplies its
+        addressable shards from the (identical) host value.  Values that
+        already carry the target sharding (e.g. ring-prefetched feeds)
+        pass through untouched."""
+        if sharding is None:
+            return jnp.asarray(value)
+        if isinstance(value, jax.Array) and \
+                value.sharding.is_equivalent_to(sharding, value.ndim):
+            return value
+        if not self.multiprocess:
+            return jax.device_put(value, sharding)
+        value = np.asarray(value)
+        return jax.make_array_from_callback(
+            value.shape, sharding, lambda idx: value[idx])
+
     def param_sharding(self, name):
         node = self.variables[name]
         spec = getattr(node, "sharding_spec", None)
@@ -810,7 +848,11 @@ class Executor:
         return NamedSharding(self.mesh, P())
 
     def device_put_feed(self, name, value):
-        return jax.device_put(value, self.feed_sharding(name, value.shape))
+        """Multi-process convention: every process feeds the identical
+        GLOBAL batch (same dataloader data/order everywhere); each keeps
+        only its addressable shards."""
+        return self.place_value(value,
+                                self.feed_sharding(name, value.shape))
 
     # ------------------------------------------------------------------ #
 
@@ -838,6 +880,11 @@ class Executor:
         (``wait_for_checkpoint()`` joins it)."""
         if sharded or async_:
             return self._save_orbax(path, async_=async_)
+        if self.multiprocess:
+            raise ValueError(
+                "pickle save cannot gather shards held by other "
+                "processes; use save(path, sharded=True) — orbax writes "
+                "each process's shards collectively")
         os.makedirs(path, exist_ok=True)
         fname = os.path.join(path, file or "checkpoint.pkl")
         # copy=True: np.asarray over jax CPU arrays is zero-copy and the
@@ -1107,16 +1154,20 @@ class Executor:
                         policy=self.config.cstable_policy,
                         pull_bound=ct.pull_bound, push_bound=ct.push_bound)
                 if k in self.ps_dense_vars:
-                    arr = jnp.asarray(v)
                     if self.mesh is not None:
-                        arr = jax.device_put(arr, self.param_sharding(k))
+                        arr = self.place_value(np.asarray(v),
+                                               self.param_sharding(k))
+                    else:
+                        arr = jnp.asarray(v)
                     self.var_values[k] = arr
                     self.ps_dense_dirty.pop(k, None)
                 continue
             if k in self.var_values:
-                arr = jnp.asarray(v)
                 if self.mesh is not None:
-                    arr = jax.device_put(arr, self.param_sharding(k))
+                    arr = self.place_value(np.asarray(v),
+                                           self.param_sharding(k))
+                else:
+                    arr = jnp.asarray(v)
                 self.var_values[k] = arr
 
     def load_seeds(self, seed):

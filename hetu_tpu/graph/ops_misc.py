@@ -52,10 +52,18 @@ class PlaceholderOp(Op):
         return self.tensor_value is not None or self.initializer is not None
 
     def init_value(self, seed: int) -> jnp.ndarray:
-        """Materialize the initial value (host side, before jit)."""
+        """Materialize the initial value (host side, before jit).
+
+        The stream is keyed by the variable NAME, not the global node-id
+        counter: ids shift with every graph built earlier in the process,
+        which would make init values depend on build order (and diverge
+        across jax processes building the same model after different
+        warm-up work).  Names are unique per executor."""
         if self.tensor_value is not None:
             return jnp.asarray(self.tensor_value, dtype=self.dtype)
-        key = jax.random.fold_in(jax.random.PRNGKey(seed), self.id)
+        import zlib
+        key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                 zlib.crc32(self.name.encode()) & 0x7FFFFFFF)
         return self.initializer.generate(key, self.dtype)
 
     def compute(self, input_vals, tc: TraceContext):
