@@ -41,10 +41,54 @@ def _make_data():
     return W1, W2, batches
 
 
+def _build_and_run_cp(mesh):
+    """Ring-attention causal LM: the 'cp' axis spans the two processes,
+    so every KV rotation is a cross-process ppermute (ICI/DCN stand-in)."""
+    import hetu_tpu as ht
+
+    Hh, Dh, S, vocab = 2, 4, 8, 16
+    D, B = Hh * Dh, 4
+    rng = np.random.RandomState(1)
+    batches = [(rng.randn(B, S, D).astype(np.float32),
+                rng.randint(0, vocab, (B, S)).astype(np.int32))
+               for _ in range(STEPS)]
+    x = ht.placeholder_op("cx")
+    y = ht.placeholder_op("cy")
+
+    def proj(name):
+        w = ht.Variable(name, value=np.eye(D, dtype=np.float32)
+                        + 0.01 * np.arange(D * D, dtype=np.float32)
+                        .reshape(D, D) / (D * D))
+        return ht.array_reshape_op(
+            ht.matmul_op(ht.array_reshape_op(x, [B * S, D]), w),
+            [B, S, Hh, Dh])
+
+    head = ht.Variable("c_head", value=np.linspace(
+        -0.1, 0.1, D * vocab).astype(np.float32).reshape(D, vocab))
+    attn = ht.ring_attention_op(proj("c_wq"), proj("c_wk"),
+                                proj("c_wv"), mesh=mesh, causal=True)
+    logits = ht.matmul_op(ht.array_reshape_op(attn, [B * S, D]), head)
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_sparse_op(
+        logits, ht.array_reshape_op(y, [B * S])), axes=0)
+    train = ht.optim.AdamOptimizer(learning_rate=0.02).minimize(loss)
+    return _run_traj(loss, train, mesh, None, x, y, batches)
+
+
+def _run_traj(loss, train, mesh, strategy, x, y, batches):
+    import hetu_tpu as ht
+
+    ex = ht.Executor({"train": [loss, train]}, mesh=mesh,
+                     dist_strategy=strategy)
+    return [float(np.asarray(ex.run("train", feed_dict={x: a, y: b})[0]))
+            for a, b in batches]
+
+
 def _build_and_run(mesh, layout="dp"):
     """Identical graph build + trajectory on every process."""
     import hetu_tpu as ht
 
+    if layout == "cp":
+        return _build_and_run_cp(mesh)
     W1, W2, batches = _make_data()
     x = ht.placeholder_op("x")
     y = ht.placeholder_op("y")
@@ -60,10 +104,7 @@ def _build_and_run(mesh, layout="dp"):
         # Megatron col/row split: each process holds HALF of each weight
         strategy = ht.dist.ShardingPlan({"w1": P(None, "tp"),
                                          "w2": P("tp", None)})
-    ex = ht.Executor({"train": [loss, train]}, mesh=mesh,
-                     dist_strategy=strategy)
-    return [float(np.asarray(ex.run("train", feed_dict={x: a, y: b})[0]))
-            for a, b in batches]
+    return _run_traj(loss, train, mesh, strategy, x, y, batches)
 
 
 def _worker(rank, port, layout, q):
@@ -85,7 +126,58 @@ def _worker(rank, port, layout, q):
         q.put((rank, f"ERROR: {type(e).__name__}: {e}"))
 
 
-@pytest.mark.parametrize("layout", ["dp", "tp"])
+def test_heturun_spawns_spmd_workers(tmp_path):
+    """`heturun -w 2 python train.py` end-to-end: the launcher provides
+    the coordinator env, each worker's distributed_init() joins the
+    2-process mesh, and both train the same dp=2 trajectory."""
+    import subprocess
+    import sys
+    import json
+
+    script = tmp_path / "train.py"
+    script.write_text(f"""
+import os, json
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from hetu_tpu.launcher import distributed_init
+distributed_init()
+sys.path.insert(0, os.path.dirname({str(__file__)!r}))
+from test_multiprocess import _build_and_run
+from hetu_tpu.parallel.mesh import make_mesh
+losses = _build_and_run(make_mesh({{"dp": 2}}))
+rank = os.environ["HETU_PROCESS_ID"]
+with open({str(tmp_path)!r} + "/out_" + rank + ".json", "w") as f:
+    json.dump(losses, f)
+""")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    # own session: on timeout we must kill the launcher's worker
+    # grandchildren too, or a wedged Gloo peer outlives the test holding
+    # the coordinator port
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hetu_tpu.launcher", "-w", "2",
+         sys.executable, str(script)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out, err = proc.communicate(timeout=240)
+    except subprocess.TimeoutExpired:
+        import signal
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.communicate()
+        raise
+    assert proc.returncode == 0, (out[-2000:], err[-2000:])
+    t0 = json.loads((tmp_path / "out_0.json").read_text())
+    t1 = json.loads((tmp_path / "out_1.json").read_text())
+    np.testing.assert_allclose(t0, t1, rtol=0, atol=0)
+    np.testing.assert_allclose(t0, _build_and_run(None), atol=1e-5)
+
+
+@pytest.mark.parametrize("layout", ["dp", "tp", "cp"])
 def test_two_process_matches_single_process(layout):
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -107,9 +199,16 @@ def test_two_process_matches_single_process(layout):
     for rank, val in results.items():
         assert isinstance(val, list), f"rank {rank}: {val}"
     # both processes saw the identical (replicated) loss trajectory
-    np.testing.assert_allclose(results[0], results[1], atol=0)
+    np.testing.assert_allclose(results[0], results[1], rtol=0, atol=0)
 
     # and it matches the single-process ground truth (the conftest's
-    # in-process 8-device CPU backend, mesh-free run)
-    base = _build_and_run(None)
+    # in-process 8-device CPU backend; cp baseline = ring over one
+    # device, which degenerates to exact attention)
+    if layout == "cp":
+        import jax
+        from hetu_tpu.parallel.mesh import make_mesh
+        base = _build_and_run(
+            make_mesh({"cp": 1}, devices=jax.devices()[:1]), "cp")
+    else:
+        base = _build_and_run(None)
     np.testing.assert_allclose(results[0], base, atol=1e-5)
