@@ -126,6 +126,95 @@ def _worker(rank, port, layout, q):
         q.put((rank, f"ERROR: {type(e).__name__}: {e}"))
 
 
+def _ha2a_worker(rank, port, q):
+    try:
+        # 2 local devices per process: 'ici' stays intra-process, 'dcn'
+        # crosses the process boundary — the real topology the
+        # hierarchical exchange is designed for
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        os.environ["HETU_NUM_PROCESSES"] = "2"
+        os.environ["HETU_PROCESS_ID"] = str(rank)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from hetu_tpu.launcher import distributed_init
+        distributed_init()
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import hetu_tpu as ht
+        from hetu_tpu.parallel.mesh import make_mesh
+        from hetu_tpu.graph.ops_moe import halltoall_op
+        from hetu_tpu.graph.node import TraceContext
+
+        mesh = make_mesh({"dcn": 2, "ici": 2})
+        node = ht.placeholder_op("t")
+        h = halltoall_op(node, axes=("ici", "dcn"))
+        xs = np.arange(16 * 2, dtype=np.float32).reshape(16, 2)
+        sh = NamedSharding(mesh, P(("dcn", "ici")))
+        glob = jax.make_array_from_callback(xs.shape, sh,
+                                            lambda idx: xs[idx])
+
+        def body(x):
+            tc = TraceContext(axis_env=("ici", "dcn"))
+            return h.compute([x], tc)
+
+        run = jax.jit(shard_map(body, mesh=mesh, in_specs=P(("dcn", "ici")),
+                                out_specs=P(("dcn", "ici"))))
+        out = run(glob)
+        out2 = run(out)
+
+        def flat(x):
+            parts = x.reshape(4, x.shape[0] // 4, *x.shape[1:])
+            return jax.lax.all_to_all(
+                parts, ("dcn", "ici"), split_axis=0,
+                concat_axis=0).reshape(x.shape)
+
+        flat_out = jax.jit(shard_map(
+            flat, mesh=mesh, in_specs=P(("dcn", "ici")),
+            out_specs=P(("dcn", "ici"))))(glob)
+
+        def local(a):
+            return np.concatenate(
+                [np.asarray(s.data) for s in a.addressable_shards])
+        involution_ok = bool(np.array_equal(local(out2), local(glob)))
+        moved = not np.array_equal(local(out), local(glob))
+        flat_match = bool(np.array_equal(local(out), local(flat_out)))
+        q.put((rank, {"involution": involution_ok, "moved": moved,
+                      "flat_match": flat_match}))
+    except BaseException as e:
+        q.put((rank, f"ERROR: {type(e).__name__}: {e}"))
+
+
+def test_hierarchical_a2a_crosses_process_boundary():
+    """halltoall over ('ici','dcn') where 'dcn' spans two REAL processes
+    (reference dlarrayHAllToAll crosses node boundaries the same way,
+    mpi_nccl_communication.cu:152-243): intra-process exchange over
+    'ici', inter-process over 'dcn'; composition == one flat a2a and is
+    an involution."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = _free_port()
+    procs = [ctx.Process(target=_ha2a_worker, args=(r, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, val = q.get(timeout=240)
+            results[rank] = val
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    for rank, val in results.items():
+        assert isinstance(val, dict), f"rank {rank}: {val}"
+        assert val == {"involution": True, "moved": True,
+                       "flat_match": True}, f"rank {rank}: {val}"
+
+
 def test_heturun_spawns_spmd_workers(tmp_path):
     """`heturun -w 2 python train.py` end-to-end: the launcher provides
     the coordinator env, each worker's distributed_init() joins the
