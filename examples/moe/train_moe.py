@@ -62,16 +62,54 @@ def main():
     yb = ht.dataloader_op([ht.Dataloader(targets, args.batch_size,
                                          "train")])
     y_ = ht.array_reshape_op(yb, [args.batch_size * args.num_tokens])
+
+    # --all2all-size N over N+ devices: experts shard over the 'ep' mesh
+    # axis and the token exchange is a REAL all_to_all (reference NCCL
+    # alltoall, gpu_ops/AllToAll.py); --hierarchical uses a (dcn, ici)
+    # mesh so the exchange stages intra- then inter-group
+    mesh, strategy = None, None
+    ep = args.all2all_size
+    if ep > 1:
+        if args.gate == "balance":
+            raise SystemExit(
+                "--gate balance uses the per-local-expert balance-"
+                "assignment formulation, which has no expert-parallel "
+                "lowering; drop --all2all-size")
+        import jax
+        from hetu_tpu.parallel.mesh import make_mesh
+        n_dev = jax.device_count()
+        if n_dev % ep:
+            raise SystemExit(f"--all2all-size {ep} needs a device count "
+                             f"divisible by it (have {n_dev})")
+        if args.hierarchical:
+            if ep % 2 or ep < 4:
+                raise SystemExit("--hierarchical needs an even "
+                                 "--all2all-size >= 4 (dcn x ici mesh)")
+            if n_dev != ep:
+                raise SystemExit(
+                    f"--hierarchical builds a dcn x ici mesh of exactly "
+                    f"--all2all-size devices; have {n_dev}, want {ep} "
+                    f"(the non-hierarchical path adds a dp axis instead)")
+            from jax.sharding import PartitionSpec as P
+            mesh = make_mesh({"dcn": 2, "ici": ep // 2})
+            # experts shard over the combined (dcn, ici) superaxis
+            strategy = ht.dist.ShardingPlan({
+                "expert_expert_stack_w1": P(("dcn", "ici"), None, None),
+                "expert_expert_stack_w2": P(("dcn", "ici"), None, None)})
+        else:
+            dp = n_dev // ep
+            strategy = ht.dist.ExpertParallel(ep=ep, dp=dp)
     loss, y = moe_mlp(
         x, y_, batch_size=args.batch_size, num_tokens=args.num_tokens,
         model_dim=args.model_dim, hidden_size=args.hidden_size,
         num_local_experts=args.num_local_experts,
         all2all_size=args.all2all_size, gate_type=args.gate,
         top_k=args.top_k, hierarchical=args.hierarchical,
-        sparse_labels=True)
+        sparse_labels=True, expert_parallel=ep > 1)
     train_op = ht.optim.SGDOptimizer(
         learning_rate=args.learning_rate).minimize(loss)
-    executor = ht.Executor({"train": [loss, train_op]},
+    executor = ht.Executor({"train": [loss, train_op]}, mesh=mesh,
+                           dist_strategy=strategy,
                            mixed_precision="bf16" if args.bf16 else None)
 
     out = executor.run("train")                       # compile + warmup

@@ -32,30 +32,47 @@ def _make_gate(gate_type, embed_dim, num_tokens, num_experts, top_k,
 
 def moe_mlp(x, y_, batch_size, num_tokens, model_dim, hidden_size,
             num_local_experts=2, all2all_size=1, gate_type="top", top_k=2,
-            device_id=0, hierarchical=False, sparse_labels=False):
+            device_id=0, hierarchical=False, sparse_labels=False,
+            expert_parallel=False):
     """MoE classifier (reference test_moe_base/top/hash/ktop1/sam.py).
 
     x: (B, T, D) tokens; y_: (B*T, C) one-hot, or (B*T,) int class ids
     with ``sparse_labels=True`` (C=model_dim one-hot targets are ~1000x
     the host->device bytes of int ids — feed sparse on TPU).
+    ``expert_parallel=True`` uses the mesh-shardable StackedExperts
+    formulation (run under an 'ep' mesh + ht.dist.ExpertParallel; the
+    global expert count is num_local_experts * all2all_size either way).
     Returns (loss, y).
     """
-    experts = [
-        htl.Expert(embed_dim=model_dim, ffn_dim=hidden_size,
-                   dropout_rate=0.1, activation="relu",
-                   name=f"expert_{device_id * num_local_experts + i}")
-        for i in range(num_local_experts)
-    ]
     total_tokens = batch_size * num_tokens
     num_experts = num_local_experts * all2all_size
     gate = _make_gate(gate_type, model_dim, total_tokens, num_experts,
                       top_k, device_id)
     layer_name = "BalanceAssignmentLayer" if gate_type == "balance" \
         else "MoELayer"
-    model = htl.MoELayer(gate=gate, experts=experts, num_tokens=total_tokens,
-                         embed_dim=model_dim, all2all_size=all2all_size,
-                         name=layer_name, top=top_k,
-                         hierarchical=hierarchical)
+    if expert_parallel:
+        assert gate_type != "balance", (
+            "balance-assignment mode uses the per-local-expert "
+            "formulation; run it without expert_parallel")
+        experts = htl.StackedExperts(num_experts, model_dim, hidden_size,
+                                     activation="relu", name="expert")
+        model = htl.MoELayer(gate=gate, experts=experts,
+                             num_tokens=total_tokens, embed_dim=model_dim,
+                             name=layer_name, top=top_k,
+                             hierarchical=hierarchical)
+    else:
+        experts = [
+            htl.Expert(embed_dim=model_dim, ffn_dim=hidden_size,
+                       dropout_rate=0.1, activation="relu",
+                       name=f"expert_{device_id * num_local_experts + i}")
+            for i in range(num_local_experts)
+        ]
+        model = htl.MoELayer(gate=gate, experts=experts,
+                             num_tokens=total_tokens,
+                             embed_dim=model_dim,
+                             all2all_size=all2all_size,
+                             name=layer_name, top=top_k,
+                             hierarchical=hierarchical)
     out = model(x)
     ce = softmaxcrossentropy_sparse_op if sparse_labels \
         else softmaxcrossentropy_op
