@@ -46,20 +46,69 @@ class HetuProfiler:
                 f.write(f"{name} step_time_s={dt:.6f}\n")
         return dt
 
-    def cost_analysis(self, name="train"):
-        """FLOPs / bytes-accessed of the compiled step (XLA cost model)."""
+    def _compiled_step(self, name):
+        """AOT-lower + compile the step once for analysis (a full extra
+        XLA compile — shared by cost_analysis/memory_analysis so asking
+        for both pays it once)."""
+        cached = getattr(self, "_analysis_cache", {}).get(name)
+        if cached is not None:
+            return cached
         sub = self.executor.subexecutor[name]
         if not sub._compiled:
             return None
         fn = next(iter(sub._compiled.values()))
-        # retrieve from the most recent lowering if available
         try:
-            lowered = fn.lower(
+            compiled = fn.lower(
                 self.executor.var_values, self.executor.opt_states,
-                self.executor.step, self.executor.rng, self._synth_feeds())
-            return lowered.compile().cost_analysis()
+                self.executor.step, self.executor.rng,
+                self._synth_feeds()).compile()
         except Exception:
             return None
+        if not hasattr(self, "_analysis_cache"):
+            self._analysis_cache = {}
+        self._analysis_cache[name] = compiled
+        return compiled
+
+    def cost_analysis(self, name="train"):
+        """FLOPs / bytes-accessed of the compiled step (XLA cost model)."""
+        compiled = self._compiled_step(name)
+        if compiled is None:
+            return None
+        try:
+            return compiled.cost_analysis()
+        except Exception:
+            return None
+
+    def memory_analysis(self, name="train"):
+        """HBM footprint of the compiled step — the role of the
+        reference's memory-plan dry-run (memory_pool.py:142 test_memory):
+        bytes for arguments (params+opt state+feeds), outputs, temps, and
+        the generated program, per the XLA allocator.  Returns a dict or
+        None before first compile."""
+        compiled = self._compiled_step(name)
+        if compiled is None:
+            return None
+        try:
+            m = compiled.memory_analysis()
+        except Exception:
+            return None
+        if m is None:
+            return None
+        out = {k: int(getattr(m, k)) for k in (
+            "argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes", "generated_code_size_in_bytes",
+            "alias_size_in_bytes") if hasattr(m, k)}
+        if out:
+            # donation aliases params/opt state into outputs; only the
+            # NON-aliased output bytes (losses, metrics, PS side grads)
+            # are additional live memory at step end
+            out["peak_estimate_bytes"] = (
+                out.get("argument_size_in_bytes", 0)
+                + out.get("temp_size_in_bytes", 0)
+                + out.get("generated_code_size_in_bytes", 0)
+                + max(0, out.get("output_size_in_bytes", 0)
+                      - out.get("alias_size_in_bytes", 0)))
+        return out or None
 
     def _synth_feeds(self):
         return {k: np.zeros(s, np.float32) for k, s in self.feed_shapes.items()}

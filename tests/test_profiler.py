@@ -1,0 +1,54 @@
+"""Profiler surface (reference profiler.py:55-120 HetuProfiler +
+Executor.profile entry executor.py:432-440): step timing, XLA
+cost-analysis FLOPs, and the memory-analysis dry-run that replaces the
+reference memory planner's test_memory simulation (memory_pool.py:142)."""
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.profiler import HetuProfiler
+
+B, IN, HID, OUT = 16, 8, 32, 4
+
+
+def _build():
+    x = ht.placeholder_op("px")
+    y = ht.placeholder_op("py")
+    w1 = ht.init.xavier_uniform((IN, HID), name="pf_w1")
+    w2 = ht.init.xavier_uniform((HID, OUT), name="pf_w2")
+    loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+        ht.matmul_op(ht.relu_op(ht.matmul_op(x, w1)), w2), y), axes=0)
+    train = ht.optim.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    ex = ht.Executor({"train": [loss, train]})
+    return x, y, ex
+
+
+def _feeds(x, y):
+    rng = np.random.RandomState(0)
+    return {x: rng.randn(B, IN).astype(np.float32),
+            y: np.eye(OUT, dtype=np.float32)[rng.randint(0, OUT, B)]}
+
+
+class TestProfiler:
+    def test_step_timing_and_analyses(self):
+        x, y, ex = _build()
+        fd = _feeds(x, y)
+        prof = HetuProfiler(ex, feed_shapes={"px": (B, IN), "py": (B, OUT)})
+        dt = prof.profile_step("train", feed_dict=fd, warmup=1, iters=2)
+        assert dt > 0
+        assert prof.records and prof.records[-1]["step_time_s"] == dt
+
+        cost = prof.cost_analysis("train")
+        assert cost is not None and float(cost["flops"]) > 0
+
+        mem = prof.memory_analysis("train")
+        assert mem is not None
+        # params+opt slots+feeds are real argument bytes
+        n_param_bytes = 4 * (IN * HID + HID * OUT)
+        assert mem["argument_size_in_bytes"] >= n_param_bytes
+        assert mem["peak_estimate_bytes"] >= mem["argument_size_in_bytes"]
+
+    def test_memory_analysis_before_compile_is_none(self):
+        _, _, ex = _build()
+        prof = HetuProfiler(ex, feed_shapes={})
+        assert prof.memory_analysis("train") is None
