@@ -11,12 +11,14 @@ from __future__ import annotations
 from .node import SimpleOp
 
 
-def flash_attention_op(q, k, v, causal=False, block_q=None, block_k=None,
-                       ctx=None):
+def flash_attention_op(q, k, v, causal=False, kv_lens=None, block_q=None,
+                       block_k=None, ctx=None):
     """Fused attention on [B, S, H, D] q/k/v nodes -> [B, S, H, D].
 
-    block_q/block_k default to the kernel's tuned values (single source
-    of truth in kernels/flash_attention.py)."""
+    ``kv_lens``: optional [B] int node — keys/values at positions >=
+    kv_lens[b] are masked (padding mask).  block_q/block_k default to
+    the kernel's tuned values (single source of truth in
+    kernels/flash_attention.py)."""
     from ..kernels.flash_attention import flash_attention
 
     kw = {}
@@ -25,10 +27,11 @@ def flash_attention_op(q, k, v, causal=False, block_q=None, block_k=None,
     if block_k is not None:
         kw["block_k"] = block_k
 
-    def fn(q, k, v):
-        return flash_attention(q, k, v, causal=causal, **kw)
+    def fn(q, k, v, lens=None):
+        return flash_attention(q, k, v, causal=causal, kv_lens=lens, **kw)
 
-    return SimpleOp(fn, q, k, v, name="FlashAttention", ctx=ctx)
+    inputs = (q, k, v) + ((kv_lens,) if kv_lens is not None else ())
+    return SimpleOp(fn, *inputs, name="FlashAttention", ctx=ctx)
 
 
 def ring_attention_op(q, k, v, mesh, axis="cp", causal=False, ctx=None):

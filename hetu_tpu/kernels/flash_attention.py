@@ -56,8 +56,14 @@ def _fit_block(block, length):
     return b
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, bq, bk, n_kv):
+def _fwd_kernel(*refs, scale, causal, masked, bq, bk, n_kv):
+    if masked:
+        (kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_ref, l_ref, acc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_ref, l_ref, acc_ref) = refs
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -71,8 +77,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     if causal:
         # block (qi, kj) is live unless every q position < every kv position
         run = (kj * bk) <= (qi * bq + bq - 1)
+    if masked:
+        # blocks entirely past this sequence's kv length are dead
+        run = jnp.logical_and(run, kj * bk < kvlen_ref[b])
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _compute():
         q = q_ref[0]          # [bq, D]
         k = k_ref[0]          # [bk, D]
@@ -81,10 +90,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             q, k, (((1,), (1,)), ((), ())),
             precision=_prec(q.dtype),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
+        if causal or masked:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kv_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+            live = jnp.ones((bq, bk), jnp.bool_)
+            if causal:
+                live = q_pos >= kv_pos
+            if masked:
+                live = jnp.logical_and(live, kv_pos < kvlen_ref[b])
+            s = jnp.where(live, s, NEG_INF)
         m_prev = m_ref[:, 0:1]                      # [bq, 1]
         l_prev = l_ref[:, 0:1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
@@ -116,21 +130,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0, 0] = lse
 
 
-def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
-    """q, k, v: [BH, S, D] -> o: [BH, S, D]."""
+def _flash_fwd(q, k, v, kv_lens, *, causal, block_q, block_k, interpret):
+    """q, k, v: [BH, S, D] (+ optional kv_lens [BH]) -> o: [BH, S, D]."""
     BH, S, D = q.shape
     Sk = k.shape[1]
     bq = _fit_block(block_q, S)
     bk = _fit_block(block_k, Sk)
     n_q, n_kv = S // bq, Sk // bk
     scale = D ** -0.5
+    masked = kv_lens is not None
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, n_kv=n_kv)
+        _fwd_kernel, scale=scale, causal=causal, masked=masked,
+        bq=bq, bk=bk, n_kv=n_kv)
+    lens_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)] if masked else []
+    lens_arg = (kv_lens,) if masked else ()
     return pl.pallas_call(
         kernel,
         grid=(BH, n_q, n_kv),
-        in_specs=[
+        in_specs=lens_spec + [
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
@@ -149,30 +167,40 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((bq, D), jnp.float32),        # output accumulator
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*lens_arg, q, k, v)
 
 
 # --------------------------------------------------------------------------- #
 # fused backward (FlashAttention-2): recompute P per block from (q, k, lse)
 # --------------------------------------------------------------------------- #
 
-def _recompute_p(q, k, lse, *, scale, causal, qi, kj, bq, bk):
+def _recompute_p(q, k, lse, *, scale, causal, qi, kj, bq, bk, kvlen=None):
     """[bq, bk] probabilities for one block pair, fp32."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         precision=_prec(q.dtype),
         preferred_element_type=jnp.float32) * scale
     p = jnp.exp(s - lse[:, None])
-    if causal:
+    if causal or kvlen is not None:
         q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         kv_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        p = jnp.where(q_pos >= kv_pos, p, 0.0)
+        live = jnp.ones((bq, bk), jnp.bool_)
+        if causal:
+            live = q_pos >= kv_pos
+        if kvlen is not None:
+            live = jnp.logical_and(live, kv_pos < kvlen)
+        p = jnp.where(live, p, 0.0)
     return p
 
 
-def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, bq, bk, n_q):
+def _bwd_dkv_kernel(*refs, scale, causal, masked, bq, bk, n_q):
+    if masked:
+        (kvlen_ref, q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    b = pl.program_id(0)
     kj = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -184,8 +212,10 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     run = True
     if causal:
         run = (qi * bq + bq - 1) >= (kj * bk)
+    if masked:
+        run = jnp.logical_and(run, kj * bk < kvlen_ref[b])
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _compute():
         q = q_ref[0]
         do = do_ref[0]
@@ -194,7 +224,8 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         k = k_ref[0]
         v = v_ref[0]
         p = _recompute_p(q, k, lse, scale=scale, causal=causal,
-                         qi=qi, kj=kj, bq=bq, bk=bk)
+                         qi=qi, kj=kj, bq=bq, bk=bk,
+                         kvlen=kvlen_ref[b] if masked else None)
         # dV += P^T dO
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -217,8 +248,14 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, bq, bk, n_kv):
+def _bwd_dq_kernel(*refs, scale, causal, masked, bq, bk, n_kv):
+    if masked:
+        (kvlen_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -229,8 +266,10 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     run = True
     if causal:
         run = (kj * bk) <= (qi * bq + bq - 1)
+    if masked:
+        run = jnp.logical_and(run, kj * bk < kvlen_ref[b])
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _compute():
         q = q_ref[0]
         do = do_ref[0]
@@ -239,7 +278,8 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         p = _recompute_p(q, k, lse, scale=scale, causal=causal,
-                         qi=qi, kj=kj, bq=bq, bk=bk)
+                         qi=qi, kj=kj, bq=bq, bk=bk,
+                         kvlen=kvlen_ref[b] if masked else None)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             precision=_prec(do.dtype),
@@ -256,7 +296,8 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, kv_lens, o, lse, g, *, causal, block_q, block_k,
+               interpret):
     """[BH, S, D] gradients via the fused kernels."""
     BH, S, D = q.shape
     Sk = k.shape[1]
@@ -264,14 +305,17 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret):
     bk = _fit_block(block_k, Sk)
     n_q, n_kv = S // bq, Sk // bk
     scale = D ** -0.5
+    masked = kv_lens is not None
+    lens_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)] if masked else []
+    lens_arg = (kv_lens,) if masked else ()
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]              # [BH, 1, S]
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, n_q=n_q),
+                          masked=masked, bq=bq, bk=bk, n_q=n_q),
         grid=(BH, n_kv, n_q),
-        in_specs=[
+        in_specs=lens_spec + [
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),   # q
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),   # dO
             pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),   # lse
@@ -292,13 +336,13 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, g, lse, delta, k, v)
+    )(*lens_arg, q, g, lse, delta, k, v)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, n_kv=n_kv),
+                          masked=masked, bq=bq, bk=bk, n_kv=n_kv),
         grid=(BH, n_q, n_kv),
-        in_specs=[
+        in_specs=lens_spec + [
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),   # k
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),   # v
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),   # q
@@ -310,7 +354,7 @@ def _flash_bwd(q, k, v, o, lse, g, *, causal, block_q, block_k, interpret):
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(k, v, q, g, lse, delta)
+    )(*lens_arg, k, v, q, g, lse, delta)
     return dq, dk, dv
 
 
@@ -318,51 +362,80 @@ def _use_interpret():
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, masked, causal, block_q, block_k):
+    # kv_lens rides inside q's tuple when masked (custom_vjp wants a
+    # fixed arity of differentiable args; lens are integers, not
+    # differentiable)
+    q, kv_lens = q if masked else (q, None)
+    o, _ = _flash_fwd(q, k, v, kv_lens, causal=causal, block_q=block_q,
                       block_k=block_k, interpret=_use_interpret())
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+def _flash_fwd_rule(q, k, v, masked, causal, block_q, block_k):
+    q, kv_lens = q if masked else (q, None)
+    o, lse = _flash_fwd(q, k, v, kv_lens, causal=causal, block_q=block_q,
                         block_k=block_k, interpret=_use_interpret())
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, kv_lens, o, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, res, g):
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, g, causal=causal, block_q=block_q,
-                      block_k=block_k, interpret=_use_interpret())
+def _flash_bwd_rule(masked, causal, block_q, block_k, res, g):
+    q, k, v, kv_lens, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, kv_lens, o, lse, g, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=_use_interpret())
+    if masked:
+        import numpy as np
+        zeros_lens = np.zeros(kv_lens.shape, dtype=jax.dtypes.float0)
+        return (dq, zeros_lens), dk, dv
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, *, causal=False, block_q=512, block_k=1024):
+def flash_attention(q, k, v, *, causal=False, kv_lens=None, block_q=512,
+                    block_k=1024):
     """Flash attention on [B, S, H, D] (framework layout).
 
     Differentiable; Pallas kernels forward AND backward (interpret mode
-    off-TPU).  Default blocks are tuned on v5e: 512x1024 is 1.8-2.4x
-    faster than the unfused softmax(QK^T)V chain at S=4k-8k causal and
-    at parity for S=512, with O(S) instead of O(S^2) memory; 128x128
-    blocks underutilize the MXU (2-4x slower than these defaults).
+    off-TPU).  ``kv_lens`` [B] int32 masks keys/values at positions >=
+    kv_lens[b] (the BERT-style padding mask); blocks wholly past the
+    length are skipped, so ragged batches also save FLOPs.  Default
+    blocks are tuned on v5e: 512x1024 is 1.8-2.4x faster than the
+    unfused softmax(QK^T)V chain at S=4k-8k causal and at parity for
+    S=512, with O(S) instead of O(S^2) memory; 128x128 blocks
+    underutilize the MXU (2-4x slower than these defaults).
     """
     B, S, H, D = q.shape
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
-    o = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k)
+    if kv_lens is not None:
+        lens = jnp.repeat(kv_lens.astype(jnp.int32), H)      # [B*H]
+        o = _flash((fold(q), lens), fold(k), fold(v), True, causal,
+                   block_q, block_k)
+    else:
+        o = _flash(fold(q), fold(k), fold(v), False, causal,
+                   block_q, block_k)
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
-def mha_reference(q, k, v, *, causal=False):
+def mha_reference(q, k, v, *, causal=False, kv_lens=None):
     """Exact attention oracle on [B, S, H, D] for tests."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    Sq, Sk = q.shape[1], k.shape[1]
     if causal:
-        Sq, Sk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((Sq, Sk), bool))
         s = jnp.where(mask[None, None], s, NEG_INF)
+    if kv_lens is not None:
+        live = jnp.arange(Sk)[None, :] < kv_lens[:, None]    # [B, Sk]
+        s = jnp.where(live[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    if kv_lens is not None:
+        # fully-padded rows: softmax over all-NEG_INF degenerates to
+        # uniform; the kernel emits exactly 0 there — match it
+        out = out * (kv_lens > 0)[:, None, None, None]
+    return out

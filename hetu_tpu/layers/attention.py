@@ -32,6 +32,7 @@ class MultiHeadAttention(BaseLayer):
         # the graph: reshapes use -1 so the layer works at any (local)
         # batch, e.g. inside a dp-sharded shard_map pipeline body
         self.keep_prob = 1.0 - dropout_rate
+        self.name = name
         self.use_flash = use_flash
         self.causal = causal
         self.block_q = block_q
@@ -46,6 +47,19 @@ class MultiHeadAttention(BaseLayer):
         self.bv = init.zeros((self.h,), name=name + "_v_bias")
         self.bo = init.zeros((self.h,), name=name + "_proj_bias")
 
+    def _causal_mask(self):
+        node = getattr(self, "_causal_mask_node", None)
+        if node is None:
+            import numpy as np
+            from ..graph.ops_misc import Variable
+            from ..kernels.flash_attention import NEG_INF
+            tri = np.where(np.tril(np.ones((self.seq, self.seq), bool)),
+                           0.0, NEG_INF).astype(np.float32)
+            node = self._causal_mask_node = Variable(
+                f"{self.name}_causal_mask", value=tri[None, None],
+                trainable=False)
+        return node
+
     def _split_heads(self, x):
         # (B*S, H) -> (B, nh, S, hd).  -1 keeps the batch dim symbolic:
         # under a dp-sharded shard_map (e.g. the SPMD pipeline body) the
@@ -53,8 +67,13 @@ class MultiHeadAttention(BaseLayer):
         x = array_reshape_op(x, [-1, self.seq, self.nh, self.hd])
         return transpose_op(x, [0, 2, 1, 3])
 
-    def __call__(self, x, attention_mask=None):
-        """x: (B*S, H) flattened hidden states; mask: additive (B,1,1,S)."""
+    def __call__(self, x, attention_mask=None, kv_lens=None):
+        """x: (B*S, H) flattened hidden states; mask: additive (B,1,1,S).
+        ``kv_lens``: [B] int node of valid key/value lengths — the
+        BERT-style padding mask in the form the flash kernel consumes
+        (mutually exclusive with ``attention_mask``)."""
+        assert attention_mask is None or kv_lens is None, (
+            "pass either an additive attention_mask or kv_lens, not both")
         if self.use_flash and attention_mask is None \
                 and self.keep_prob == 1.0:
             from ..graph.ops_attention import flash_attention_op
@@ -66,15 +85,24 @@ class MultiHeadAttention(BaseLayer):
             k = bshd(linear_op(x, self.wk, self.bk))
             v = bshd(linear_op(x, self.wv, self.bv))
             o = flash_attention_op(q, k, v, causal=self.causal,
+                                   kv_lens=kv_lens,
                                    block_q=self.block_q,
                                    block_k=self.block_k)
             o = array_reshape_op(o, [-1, self.h])
             return linear_op(o, self.wo, self.bo)
+        if kv_lens is not None:
+            # unfused fallback: lens -> additive (B, 1, 1, S) mask
+            from .reshape import lens_to_additive_mask
+            attention_mask = lens_to_additive_mask(kv_lens, self.seq)
         q = self._split_heads(linear_op(x, self.wq, self.bq))
         k = self._split_heads(linear_op(x, self.wk, self.bk))
         v = self._split_heads(linear_op(x, self.wv, self.bv))
         scores = batch_matmul_op(q, k, trans_B=True)
         scores = mul_byconst_op(scores, 1.0 / math.sqrt(self.hd))
+        if self.causal:
+            # the flash path masks inside the kernel; the unfused chain
+            # needs the explicit additive triangle
+            scores = scores + broadcastto_op(self._causal_mask(), scores)
         if attention_mask is not None:
             scores = scores + broadcastto_op(attention_mask, scores)
         probs = softmax_op(scores)
@@ -83,4 +111,7 @@ class MultiHeadAttention(BaseLayer):
         ctxv = batch_matmul_op(probs, v)  # (B, nh, S, hd)
         ctxv = transpose_op(ctxv, [0, 2, 1, 3])
         ctxv = array_reshape_op(ctxv, [-1, self.h])
+        if kv_lens is not None:
+            from .reshape import zero_empty_rows
+            ctxv = zero_empty_rows(ctxv, kv_lens, self.seq)
         return linear_op(ctxv, self.wo, self.bo)

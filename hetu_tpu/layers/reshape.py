@@ -1,7 +1,8 @@
-"""Reshape layer (reference layers/reshape.py)."""
+"""Reshape layer (reference layers/reshape.py) + small shape helpers."""
 
 from .base import BaseLayer
 from ..graph import array_reshape_op
+from ..graph.node import SimpleOp
 
 
 class Reshape(BaseLayer):
@@ -10,3 +11,31 @@ class Reshape(BaseLayer):
 
     def __call__(self, x):
         return array_reshape_op(x, self.shape)
+
+
+def lens_to_additive_mask(kv_lens, seq_len):
+    """[B] int lengths -> additive (B, 1, 1, S) mask (0 where live,
+    NEG_INF where padded) for the unfused attention path."""
+    import jax.numpy as jnp
+    from ..kernels.flash_attention import NEG_INF
+
+    def fn(lens):
+        live = jnp.arange(seq_len)[None, :] < lens[:, None]
+        return jnp.where(live, 0.0, NEG_INF).astype(jnp.float32)[
+            :, None, None, :]
+
+    return SimpleOp(fn, kv_lens, name="LensMask")
+
+
+def zero_empty_rows(ctxv, kv_lens, seq_len):
+    """Zero the attention context of fully-padded sequences (kv_lens==0):
+    an all-masked softmax degenerates to uniform weights, which would
+    leak a mean-of-V output (and grads) out of empty rows — the flash
+    kernel emits exactly 0 there, and both paths must agree."""
+    import jax.numpy as jnp
+
+    def fn(c, lens):
+        live = jnp.repeat(lens > 0, seq_len).astype(c.dtype)
+        return c * live[:, None]
+
+    return SimpleOp(fn, ctxv, kv_lens, name="ZeroEmptyRows")

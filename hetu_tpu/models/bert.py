@@ -116,9 +116,10 @@ class BertLayer:
                                     name=name + "_output")
         self.out_ln = layers.LayerNorm(c.hidden_size, name=name + "_out_ln")
 
-    def __call__(self, hidden, attention_mask=None):
+    def __call__(self, hidden, attention_mask=None, kv_lens=None):
         c = self.config
-        attn = self.attention(hidden, attention_mask=attention_mask)
+        attn = self.attention(hidden, attention_mask=attention_mask,
+                              kv_lens=kv_lens)
         if c.hidden_dropout_prob > 0:
             attn = dropout_op(attn, 1.0 - c.hidden_dropout_prob)
         hidden = self.attn_ln(hidden + attn)
@@ -161,13 +162,21 @@ class BertModel:
         m = array_reshape_op(attention_mask, [c.batch_size, 1, 1, c.seq_len])
         return mul_byconst_op(addbyconst_op(opposite_op(m), 1.0), -10000.0)
 
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 kv_lens=None):
+        """``kv_lens`` [B] int node: valid-prefix lengths — keeps the
+        flash kernel active under padding (an additive attention_mask
+        forces the unfused path).  Mutually exclusive with
+        attention_mask."""
+        assert attention_mask is None or kv_lens is None, (
+            "pass either attention_mask or kv_lens, not both")
         hidden = self.embeddings(input_ids, token_type_ids)
         add_mask = None
         if attention_mask is not None:
             add_mask = self.attention_mask_from_input(attention_mask)
         for layer in self.encoder_layers:
-            hidden = layer(hidden, attention_mask=add_mask)
+            hidden = layer(hidden, attention_mask=add_mask,
+                           kv_lens=kv_lens)
         return hidden, self.pooler(hidden)
 
 
@@ -197,10 +206,11 @@ class BertForPreTraining:
         self.nsp = layers.Linear(c.hidden_size, 2, name=name + "_nsp")
 
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 masked_lm_labels=None, next_sentence_label=None):
+                 masked_lm_labels=None, next_sentence_label=None,
+                 kv_lens=None):
         c = self.config
         seq_out, pooled = self.bert(input_ids, token_type_ids,
-                                    attention_mask)
+                                    attention_mask, kv_lens=kv_lens)
         h = self.transform_ln(gelu_op(self.transform(seq_out)))
         # tied decoder: logits = h @ word_emb^T + bias
         logits = matmul_op(h, self.bert.embeddings.word_embeddings,
@@ -226,9 +236,10 @@ class BertForMaskedLM:
         self.config = config
 
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 masked_lm_labels=None):
+                 masked_lm_labels=None, kv_lens=None):
         c = self.config
-        out = self.pretraining(input_ids, token_type_ids, attention_mask)
+        out = self.pretraining(input_ids, token_type_ids, attention_mask,
+                               kv_lens=kv_lens)
         logits, _ = out
         if masked_lm_labels is None:
             return logits
@@ -251,9 +262,10 @@ class BertForSequenceClassification:
                                         name=name + "_classifier")
 
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 labels=None):
+                 labels=None, kv_lens=None):
         c = self.config
-        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                              kv_lens=kv_lens)
         if c.hidden_dropout_prob > 0:
             pooled = dropout_op(pooled, 1.0 - c.hidden_dropout_prob)
         logits = self.classifier(pooled)

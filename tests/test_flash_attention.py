@@ -25,6 +25,64 @@ def test_flash_matches_exact(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_kv_lens_matches_exact(causal):
+    """Padding mask (BERT-style): keys/values past kv_lens[b] are dead;
+    forward AND all three grads must match the masked oracle."""
+    q, k, v = _qkv(7)
+    lens = jnp.asarray([13, 0], jnp.int32)   # partial + fully padded
+    got = flash_attention(q, k, v, causal=causal, kv_lens=lens,
+                          block_q=16, block_k=16)
+    want = mha_reference(q, k, v, causal=causal, kv_lens=lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+    def loss_f(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, kv_lens=lens,
+                                block_q=16, block_k=16) ** 2).sum()
+
+    def loss_r(q, k, v):
+        return (mha_reference(q, k, v, causal=causal,
+                              kv_lens=lens) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_layer_kv_lens_matches_unfused(causal):
+    """MultiHeadAttention(kv_lens=...): the flash path and the unfused
+    lens->additive-mask fallback must train identically — including the
+    causal triangle, which the unfused chain must apply explicitly."""
+    import hetu_tpu as ht
+
+    hidden, nh = 32, 2
+    rng = np.random.RandomState(0)
+    X = rng.randn(B * S, hidden).astype(np.float32)
+    # one partial and one fully-padded sequence: the empty row must emit
+    # zero context (and zero grads) on BOTH paths
+    L = np.array([13, 0], np.int32)
+
+    def run(use_flash):
+        x = ht.placeholder_op("x")
+        lens = ht.placeholder_op("l")
+        attn = ht.layers.MultiHeadAttention(
+            hidden, nh, S, B, use_flash=use_flash, causal=causal,
+            block_q=16, block_k=16, name="mkv")
+        out = attn(x, kv_lens=lens)
+        loss = ht.reduce_mean_op(ht.mul_op(out, out), axes=[0, 1])
+        train = ht.optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]}, seed=3)
+        return [float(ex.run("train", feed_dict={x: X, lens: L})[0])
+                for _ in range(4)]
+
+    np.testing.assert_allclose(run(True), run(False),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_flash_single_block():
     q, k, v = _qkv(1)
     got = flash_attention(q, k, v, block_q=128, block_k=128)

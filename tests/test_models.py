@@ -124,6 +124,49 @@ class TestBert:
         }
         _check(_train_steps(loss, train, feeds, n_steps=5))
 
+    def test_kv_lens_flash_matches_additive_mask(self):
+        """Padded BERT: the flash kernel's kv_lens path, the unfused
+        lens->mask fallback, and the reference-style additive (B,S) 0/1
+        mask must all produce the same trajectory."""
+        B, S = 4, 32
+        rng = np.random.RandomState(0)
+        IDS = rng.randint(0, 100, (B, S)).astype(np.int32)
+        LENS = np.array([32, 20, 7, 1], np.int32)
+        PREFIX = (np.arange(S)[None, :] < LENS[:, None]).astype(np.float32)
+        LBL = rng.randint(0, 2, (B,)).astype(np.int32)
+
+        def run(flash, use_lens):
+            cfg = models.BertConfig(
+                vocab_size=100, hidden_size=32, num_hidden_layers=1,
+                num_attention_heads=2, intermediate_size=64,
+                seq_len=S, batch_size=B, hidden_dropout_prob=0.0,
+                attention_probs_dropout_prob=0.0,
+                use_flash_attention=flash)
+            ids = ht.placeholder_op("ids")
+            lbl = ht.placeholder_op("lbl")
+            model = models.BertForSequenceClassification(cfg, num_labels=2)
+            feeds = {ids: IDS, lbl: LBL}
+            if use_lens:
+                lens = ht.placeholder_op("lens")
+                loss, _ = model(ids, labels=lbl, kv_lens=lens)
+                feeds[lens] = LENS
+            else:
+                mask = ht.placeholder_op("mask")
+                loss, _ = model(ids, labels=lbl, attention_mask=mask)
+                feeds[mask] = PREFIX
+            train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+            ex = ht.Executor({"train": [loss, train]}, seed=1)
+            return [float(ex.run("train", feed_dict=feeds)[0])
+                    for _ in range(4)]
+
+        flash_lens = run(True, True)
+        unfused_lens = run(False, True)
+        additive = run(False, False)
+        np.testing.assert_allclose(flash_lens, unfused_lens,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(flash_lens, additive,
+                                   rtol=1e-3, atol=1e-4)
+
     def test_sequence_classification(self):
         cfg = models.BertConfig(
             vocab_size=64, hidden_size=16, num_hidden_layers=1,
