@@ -254,6 +254,210 @@ class TestCommOpsIdentityOffMesh:
             np.testing.assert_allclose(r, B_)
 
 
+class TestMoEOps:
+    """Direct numerics for the dispatch/gating kernels' op surface
+    (reference LayoutTransform.cu / ReverseLayoutTransform.cu /
+    GroupTopKIdx.cu / SamGroupSum.cu / SamMax.cu semantics)."""
+
+    N, E, CAP, D = 4, 2, 2, 3
+    TOK = R.randn(4, 3).astype(np.float32)
+    IDX = np.array([0, 1, 0, 1], np.float32)     # top-1 expert per token
+    LOC = np.array([0, 0, 1, 1], np.float32)     # slot within expert
+
+    def test_layout_roundtrip(self):
+        x = ht.placeholder_op("x")
+        i = ht.placeholder_op("i")
+        l = ht.placeholder_op("l")
+        disp = ht.layout_transform_op(x, [i], [l], self.CAP, self.E)
+        comb = ht.reverse_layout_transform_no_gate_op(
+            disp, [i], [l], self.CAP, self.E)
+        ex = ht.Executor({"t": [disp, comb]})
+        d, c = ex.run("t", feed_dict={x: self.TOK, i: self.IDX,
+                                      l: self.LOC},
+                      convert_to_numpy_ret_vals=True)
+        want = np.zeros((self.E * self.CAP, self.D), np.float32)
+        for t in range(self.N):
+            want[int(self.IDX[t]) * self.CAP + int(self.LOC[t])] = \
+                self.TOK[t]
+        np.testing.assert_allclose(d, want)
+        np.testing.assert_allclose(c, self.TOK)   # combine inverts
+
+    def test_reverse_layout_gate_weighted(self):
+        x = ht.placeholder_op("x")
+        i = ht.placeholder_op("i")
+        l = ht.placeholder_op("l")
+        g = ht.placeholder_op("g")
+        gates = np.array([0.5, 1.0, 0.25, 2.0], np.float32)
+        disp = ht.layout_transform_op(x, [i], [l], self.CAP, self.E)
+        comb = ht.reverse_layout_transform_op(
+            disp, [i], [l], [g], self.CAP, self.E)
+        ex = ht.Executor({"t": [comb]})
+        (c,) = ex.run("t", feed_dict={x: self.TOK, i: self.IDX,
+                                      l: self.LOC, g: gates},
+                      convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(c, gates[:, None] * self.TOK)
+
+    def test_capacity_overflow_drops(self):
+        x = ht.placeholder_op("x")
+        i = ht.placeholder_op("i")
+        l = ht.placeholder_op("l")
+        idx = np.zeros(4, np.float32)             # all to expert 0
+        loc = np.array([0, 1, 2, 3], np.float32)  # 2 overflow (cap=2)
+        disp = ht.layout_transform_op(x, [i], [l], self.CAP, self.E)
+        ex = ht.Executor({"t": [disp]})
+        (d,) = ex.run("t", feed_dict={x: self.TOK, i: idx, l: loc},
+                      convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(d[0], self.TOK[0])
+        np.testing.assert_allclose(d[1], self.TOK[1])
+        np.testing.assert_allclose(d[2:], 0.0)    # dropped, not wrapped
+
+    def test_topk_and_group_gating_ops(self):
+        scores = R.randn(4, 8).astype(np.float32)
+        grp = np.array([0, 1, 1, 0], np.float32)
+        s = ht.placeholder_op("s")
+        gp = ht.placeholder_op("gp")
+        outs = [ht.topk_idx_op(s, topk=2),
+                ht.group_topk_idx_op(s, gp, topk=1, num_local_gpus=4),
+                ht.sam_group_sum_op(s, 2),
+                ht.unique_indices_op(gp)]
+        ex = ht.Executor({"t": outs})
+        tk, gtk, sgs, uq = ex.run("t", feed_dict={s: scores, gp: grp},
+                                  convert_to_numpy_ret_vals=True)
+        want_tk = np.argsort(-scores, axis=1)[:, :2]
+        np.testing.assert_allclose(np.sort(tk, 1), np.sort(want_tk, 1))
+        # group top-1 searches only [g*4, (g+1)*4)
+        gtk_flat = np.asarray(gtk).reshape(-1)
+        for t in range(4):
+            lo = int(grp[t]) * 4
+            assert lo <= gtk_flat[t] < lo + 4
+            assert scores[t, int(gtk_flat[t])] == \
+                scores[t, lo:lo + 4].max()
+        np.testing.assert_allclose(
+            sgs, scores.reshape(4, 2, 4).sum(-1), rtol=1e-5)
+        np.testing.assert_allclose(np.sort(uq[:2]), [0.0, 1.0])
+        np.testing.assert_allclose(uq[2:], -1.0)
+
+    def test_sam_max(self):
+        scores = R.randn(3, 8).astype(np.float32)
+        grp = np.array([0, 1, 0], np.float32)
+        tki = np.array([1, 5, 2], np.float32)
+        s = ht.placeholder_op("s")
+        gp = ht.placeholder_op("gp")
+        tk = ht.placeholder_op("tk")
+        out = ht.sam_max_op(s, gp, tk, 4)
+        ex = ht.Executor({"t": [out]})
+        (res,) = ex.run("t", feed_dict={s: scores, gp: grp, tk: tki},
+                        convert_to_numpy_ret_vals=True)
+        for t in range(3):
+            ref = scores[t, int(tki[t])]
+            lo = int(grp[t]) * 4
+            for e in range(8):
+                in_grp = lo <= e < lo + 4
+                want = 0.0 if in_grp or scores[t, e] <= ref \
+                    else scores[t, e] - ref
+                np.testing.assert_allclose(res[t, e], want, rtol=1e-5)
+
+
+class TestConvAndNormHelpers:
+    def test_conv2d_add_bias(self):
+        x = R.randn(2, 3, 5, 5).astype(np.float32)
+        w = R.randn(4, 3, 3, 3).astype(np.float32)
+        b = R.randn(4).astype(np.float32)
+        xn, wn, bn = (ht.placeholder_op(n) for n in "xwb")
+        out = ht.conv2d_add_bias_op(xn, wn, bn, stride=1, padding=1)
+        base = ht.conv2d_op(xn, wn, stride=1, padding=1)
+        ex = ht.Executor({"t": [out, base]})
+        got, plain = ex.run("t", feed_dict={xn: x, wn: w, bn: b},
+                            convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(got, plain + b.reshape(1, -1, 1, 1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_broadcast_and_reducesum(self):
+        b = R.randn(3).astype(np.float32)
+        t = R.randn(2, 3, 4, 4).astype(np.float32)
+        bn, tn = ht.placeholder_op("b"), ht.placeholder_op("t")
+        outs = [ht.conv2d_broadcastto_op(bn, tn),
+                ht.conv2d_reducesum_op(tn),
+                ht.addmm_gradient_op(tn, axis=0)]
+        ex = ht.Executor({"t": outs})
+        bc, rs, ag = ex.run("t", feed_dict={bn: b, tn: t},
+                            convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(
+            bc, np.broadcast_to(b.reshape(1, 3, 1, 1), t.shape))
+        np.testing.assert_allclose(rs, t.sum((0, 2, 3)), rtol=1e-5)
+        np.testing.assert_allclose(ag, t.sum(0), rtol=1e-5)
+
+    def test_batch_norm_train_vs_eval_stats(self):
+        x = R.randn(8, 3, 4, 4).astype(np.float32)
+        xn = ht.placeholder_op("x")
+        sc = ht.Variable("bn_scale", value=np.ones(3, np.float32))
+        bi = ht.Variable("bn_bias", value=np.zeros(3, np.float32))
+        out = ht.batch_normalization_op(xn, sc, bi, eps=1e-5)
+        # eval subgraph (no optimizer): running stats = fresh (0 mean,
+        # 1 var) -> identity up to eps
+        ex = ht.Executor({"t": [out]})
+        (res,) = ex.run("t", feed_dict={xn: x},
+                        convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(res, x / np.sqrt(1 + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+        # train subgraph: batch statistics
+        loss = ht.reduce_mean_op(ht.mul_op(out, out), axes=[0, 1, 2, 3])
+        tr = ht.optim.SGDOptimizer(learning_rate=0.0).minimize(loss)
+        ex2 = ht.Executor({"train": [out, tr]})
+        res2 = np.asarray(ex2.run("train", feed_dict={xn: x})[0])
+        mean = x.mean((0, 2, 3), keepdims=True)
+        var = x.var((0, 2, 3), keepdims=True)
+        np.testing.assert_allclose(res2, (x - mean) / np.sqrt(var + 1e-5),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_dropout2d_masks_whole_channels(self):
+        x = np.ones((4, 8, 5, 5), np.float32)
+        xn = ht.placeholder_op("x")
+        out = ht.dropout2d_op(xn, 0.5)
+        loss = ht.reduce_mean_op(out, axes=[0, 1, 2, 3])
+        tr = ht.optim.SGDOptimizer(learning_rate=0.0).minimize(
+            ht.reduce_mean_op(ht.mul_op(out, out), axes=[0, 1, 2, 3]))
+        ex = ht.Executor({"t": [out, loss, tr]})
+        res = np.asarray(ex.run("t", feed_dict={xn: x})[0])
+        # spatial dropout: each (n, c) channel is all-zero or all-scaled
+        per_chan = res.reshape(4 * 8, -1)
+        assert all(np.all(r == 0) or np.all(r == r[0]) for r in per_chan)
+
+
+class TestTransferAndPSAnnotations:
+    def test_identity_shims(self):
+        x = ht.placeholder_op("x")
+        outs = [ht.datah2d_op(x), ht.datad2h_op(x),
+                ht.parameterServerCommunicate_op(x)]
+        ex = ht.Executor({"t": outs})
+        res = ex.run("t", feed_dict={x: B_}, convert_to_numpy_ret_vals=True)
+        for r in res:
+            np.testing.assert_allclose(r, B_)
+
+    def test_ps_sparse_pull_is_gather(self):
+        table = ht.Variable("pspull_table", value=A)
+        ids = ht.placeholder_op("ids")
+        out = ht.parameterServerSparsePull_op(table, ids)
+        ex = ht.Executor({"t": [out]})
+        ii = np.array([3, 0, 1], np.int32)
+        (res,) = ex.run("t", feed_dict={ids: ii},
+                        convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(res, A[ii])
+
+
+def test_slice_assign_matrix():
+    a = ht.placeholder_op("a")
+    b = ht.placeholder_op("b")
+    out = ht.slice_assign_matrix_op(a, b, (0, 1), (2, 2), (1, 0))
+    ex = ht.Executor({"t": [out]})
+    other = R.randn(4, 6).astype(np.float32)
+    (res,) = ex.run("t", feed_dict={a: B_, b: other},
+                    convert_to_numpy_ret_vals=True)
+    want = B_.copy()
+    want[0:2, 1:3] = other[1:3, 0:2]
+    np.testing.assert_allclose(res, want)
+
+
 def test_slice_assign_and_by_matrix():
     x = ht.placeholder_op("x")
     out = ht.slice_assign_op(x, 9.0, (1, 2), (2, 3))
