@@ -980,8 +980,13 @@ class Executor:
             os.replace(tmp, loaders_file)
 
         if async_:
-            ck = self._async_ckptr = ocp.AsyncCheckpointer(
-                ocp.StandardCheckpointHandler())
+            # one AsyncCheckpointer per executor, reused across saves —
+            # a fresh instance per save would churn its thread pool and
+            # leak resources over a long run if any close were missed
+            ck = getattr(self, "_async_ckptr", None)
+            if ck is None:
+                ck = self._async_ckptr = ocp.AsyncCheckpointer(
+                    ocp.StandardCheckpointHandler())
             ck.save(path, args=ocp.args.StandardSave(
                 self._orbax_state()), force=True)
 
@@ -997,7 +1002,26 @@ class Executor:
                 ckptr.save(path, self._orbax_state(), force=True)
             publish()
 
-    def wait_for_checkpoint(self):
+    def close(self):
+        """Release executor-held host resources (the async-checkpoint
+        thread pool).  Safe to call more than once; subsequent saves
+        re-create what they need."""
+        self.wait_for_checkpoint(close=True)
+
+    def __del__(self):
+        # best-effort backstop for executors discarded without close():
+        # an un-closed AsyncCheckpointer keeps its thread pool (and can
+        # keep the interpreter alive at exit)
+        try:
+            if getattr(self, "_async_ckptr", None) is not None:
+                self.close()
+        except Exception:
+            pass
+
+    def wait_for_checkpoint(self, close=False):
+        """Join any in-flight async save.  The checkpointer instance is
+        kept for reuse by later saves; pass ``close=True`` (teardown) to
+        release its thread pool."""
         t = getattr(self, "_sidecar_thread", None)
         if t is not None:
             t.join()
@@ -1005,8 +1029,56 @@ class Executor:
         ck = getattr(self, "_async_ckptr", None)
         if ck is not None:
             ck.wait_until_finished()
-            ck.close()
-            self._async_ckptr = None
+            if close:
+                ck.close()
+                self._async_ckptr = None
+
+    def _restore_superset(self, ocp, path, target):
+        """Restore a checkpoint whose tree holds keys the current build no
+        longer has (forward compat): target = current abstract leaves where
+        keys overlap, on-disk shape/dtype for the rest.  Returns the
+        restored state (extras included — callers filter) or None."""
+        try:
+            with ocp.StandardCheckpointer() as ckptr:
+                meta = ckptr.metadata(path)
+            # StepMetadata -> TreeMetadata -> nested {key: ArrayMetadata}
+            tree = getattr(getattr(meta, "item_metadata", meta),
+                           "tree", None)
+            if tree is None:
+                return None
+            tree = dict(tree)
+
+            # the on-disk tree must COVER the target: a checkpoint missing
+            # current keys is a real mismatch (renamed param, wrong model)
+            # that must surface as the original error, not silently
+            # restore partial state
+            def covered(t, m):
+                if isinstance(t, dict):
+                    return isinstance(m, dict) and all(
+                        k in m and covered(v, m[k]) for k, v in t.items())
+                return not isinstance(m, dict)
+
+            if not covered(target, tree):
+                return None
+
+            t2 = dict(target)
+            # legacy in-tree dataloader scalars ride along (cheap); every
+            # OTHER extra (e.g. materialized causal masks — potentially
+            # hundreds of MB) is skipped outright by the partial restore,
+            # never read or materialized
+            if "dataloaders" in tree and "dataloaders" not in t2:
+                t2["dataloaders"] = jax.tree_util.tree_map(
+                    lambda m: jax.ShapeDtypeStruct(
+                        tuple(m.shape), np.dtype(m.dtype)),
+                    tree["dataloaders"])
+            with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+                return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+                    item=t2,
+                    restore_args=ocp.checkpoint_utils
+                    .construct_restore_args(t2),
+                    partial_restore=True))
+        except Exception:
+            return None
 
     def load_sharded(self, path):
         """Restore an orbax checkpoint, placing each leaf directly with
@@ -1039,12 +1111,21 @@ class Executor:
             with ocp.StandardCheckpointer() as ckptr:
                 state = ckptr.restore(path, target)
         except Exception as core_err:
+            # Orbax needs an exact tree match, so a checkpoint whose tree
+            # is a SUPERSET of the current state fails the target above —
+            # e.g. non-trainable Variables an older build stored that this
+            # build computes in-trace (causal masks), or in-tree dataloader
+            # state.  Rebuild the target from the checkpoint's own
+            # metadata (current abstract leaf where keys overlap, on-disk
+            # shape/dtype for the extras), restore, and discard extras.
+            state = self._restore_superset(ocp, path, target)
+            if state is not None:
+                loader_states = state.pop("dataloaders", loader_states)
             # checkpoints from builds that stored dataloader state INSIDE
-            # the orbax tree (orbax needs an exact tree match, so the
-            # core-only target above fails on them): retry with that
-            # subtree mirrored from each schema those builds ever wrote.
-            # If none matches, surface the original error — don't let the
-            # compat chain mask a real shape/dtype problem.
+            # the orbax tree: retry with that subtree mirrored from each
+            # schema those builds ever wrote.  If nothing matches, surface
+            # the original error — don't let the compat chain mask a real
+            # shape/dtype problem.
             def loader_target(keys):
                 # np dtypes: orbax stored the in-tree python scalars as
                 # int64/bool_, not jax's int32 default
@@ -1054,18 +1135,18 @@ class Executor:
                         for k, v in st.items() if k in keys}
                     for name, st in self._loader_states().items()}
 
-            state = None
-            for keys in (("consumed", "seed", "shuffle"),
-                         ("consumed", "seed")):
-                t2 = dict(target)
-                t2["dataloaders"] = loader_target(keys)
-                try:
-                    with ocp.StandardCheckpointer() as ckptr:
-                        state = ckptr.restore(path, t2)
-                    loader_states = state.pop("dataloaders", None)
-                    break
-                except Exception:
-                    state = None
+            if state is None:
+                for keys in (("consumed", "seed", "shuffle"),
+                             ("consumed", "seed")):
+                    t2 = dict(target)
+                    t2["dataloaders"] = loader_target(keys)
+                    try:
+                        with ocp.StandardCheckpointer() as ckptr:
+                            state = ckptr.restore(path, t2)
+                        loader_states = state.pop("dataloaders", None)
+                        break
+                    except Exception:
+                        state = None
             if state is None:
                 raise core_err
         params = state["params"]

@@ -17,6 +17,10 @@ import jax.numpy as jnp
 
 from ..context import get_current_context
 
+# rng stream index space: [0, n_topo) for topo-tracked nodes; untracked
+# nodes are shifted far above any realistic topo position (see rng_for)
+_UNTRACKED_RNG_OFFSET = 1 << 24
+
 
 class TraceContext:
     """Per-trace state threaded through ``Op.compute`` calls.
@@ -49,8 +53,14 @@ class TraceContext:
     def rng_for(self, node) -> jax.Array:
         assert self._rng is not None, (
             "op %s needs an RNG key but the trace has none" % node)
-        return jax.random.fold_in(
-            self._rng, self.rng_ids.get(node.id, node.id))
+        stream = self.rng_ids.get(node.id)
+        if stream is None:
+            # Untracked node: raw global ids share the small-int range with
+            # topo positions, so fold in a disjoint offset — otherwise an
+            # untracked rng consumer could silently share a dropout stream
+            # with a topo-indexed one.
+            stream = node.id + _UNTRACKED_RNG_OFFSET
+        return jax.random.fold_in(self._rng, stream)
 
     def has_axis(self, name) -> bool:
         return name in self.axis_env

@@ -8,7 +8,42 @@ generic VJPOp fallback.
 
 from __future__ import annotations
 
-from .node import SimpleOp
+from .node import Op, SimpleOp
+
+
+class CausalMaskOp(Op):
+    """Additive causal mask ``(1, 1, S, S)`` built in-trace from iota
+    comparisons (as the flash kernel does) — never materialized as a stored
+    Variable, so it costs no checkpoint bytes and is fused by XLA into the
+    consuming add.  Emits the trace's mixed-precision policy dtype, exactly
+    as a stored-Variable mask would have entered via the executor's input
+    cast — otherwise a f32 mask would silently promote the whole unfused
+    attention tail under a bf16 policy."""
+
+    def __init__(self, seq_len, neg, ctx=None):
+        super().__init__(name="CausalMask", ctx=ctx)
+        self.seq_len = seq_len
+        self.neg = neg
+
+    def compute(self, input_vals, tc):
+        import jax
+        import jax.numpy as jnp
+        S = self.seq_len
+        dtype = (getattr(tc.config, "mixed_precision", None)
+                 if tc.config is not None else None) or jnp.float32
+        i = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        j = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        return jnp.where(j <= i, 0.0, self.neg).astype(dtype)[None, None]
+
+    def gradient(self, output_grad):
+        return []
+
+
+def causal_mask_op(seq_len, neg=None, ctx=None):
+    if neg is None:
+        from ..kernels.flash_attention import NEG_INF
+        neg = NEG_INF
+    return CausalMaskOp(seq_len, neg, ctx=ctx)
 
 
 def flash_attention_op(q, k, v, causal=False, kv_lens=None, block_q=None,

@@ -48,16 +48,13 @@ class MultiHeadAttention(BaseLayer):
         self.bo = init.zeros((self.h,), name=name + "_proj_bias")
 
     def _causal_mask(self):
+        # built in-trace (iota comparisons) rather than stored as a
+        # Variable: an SxS float triangle per layer would be donated
+        # through every step and serialized into every checkpoint
         node = getattr(self, "_causal_mask_node", None)
         if node is None:
-            import numpy as np
-            from ..graph.ops_misc import Variable
-            from ..kernels.flash_attention import NEG_INF
-            tri = np.where(np.tril(np.ones((self.seq, self.seq), bool)),
-                           0.0, NEG_INF).astype(np.float32)
-            node = self._causal_mask_node = Variable(
-                f"{self.name}_causal_mask", value=tri[None, None],
-                trainable=False)
+            from ..graph.ops_attention import causal_mask_op
+            node = self._causal_mask_node = causal_mask_op(self.seq)
         return node
 
     def _split_heads(self, x):

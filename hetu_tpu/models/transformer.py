@@ -129,12 +129,8 @@ class Transformer:
         self.tgt_pos = Variable(
             name + "_tgt_pos", value=_sinusoid_table(cfg.tgt_len, h),
             trainable=False)
-        causal = np.triu(np.full((cfg.tgt_len, cfg.tgt_len), -1e9,
-                                 dtype=np.float32), k=1)
-        self.causal_mask = Variable(
-            name + "_causal_mask",
-            value=causal.reshape(1, 1, cfg.tgt_len, cfg.tgt_len),
-            trainable=False)
+        from ..graph.ops_attention import causal_mask_op
+        self.causal_mask = causal_mask_op(cfg.tgt_len, neg=-1e9)
 
         self.enc = []
         for i in range(cfg.num_layers):

@@ -152,3 +152,75 @@ class TestShardedCheckpoint:
         got = [float(np.asarray(ex2.run(
             "train", feed_dict={x: a, y: b})[0])) for a, b in bs[2:]]
         np.testing.assert_allclose(got, base, atol=1e-6)
+        ex.close()
+        ex2.close()
+
+    def test_restore_tolerates_extra_on_disk_keys(self, tmp_path):
+        """Forward compat: a checkpoint written by a build that stored
+        extra non-trainable Variables (e.g. materialized causal masks,
+        superseded by in-trace ops) must still restore — the superset
+        path rebuilds the target from orbax metadata and discards the
+        extras."""
+        bs = batches(6)
+
+        def build_extra(with_mask):
+            x = ht.placeholder_op("x")
+            y = ht.placeholder_op("y")
+            w1 = ht.init.xavier_uniform((IN, HID), name="xk_fc1_weight")
+            b1 = ht.init.zeros((HID,), name="xk_fc1_bias")
+            wh = ht.init.xavier_uniform((HID, OUT), name="xk_head")
+            h = ht.gelu_op(ht.linear_op(x, w1, b1))
+            if with_mask:
+                from hetu_tpu.graph.ops_misc import Variable
+                mask = Variable("xk_legacy_mask",
+                                value=np.zeros((1, HID), np.float32),
+                                trainable=False)
+                h = h + ht.broadcastto_op(mask, h)
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(ht.matmul_op(h, wh), y), axes=0)
+            train = ht.optim.AdamOptimizer(learning_rate=0.01).minimize(loss)
+            return x, y, loss, train
+
+        x, y, loss, train = build_extra(True)
+        ex = ht.Executor({"train": [loss, train]})
+        for a, b in bs[:3]:
+            ex.run("train", feed_dict={x: a, y: b})
+        ex.save(str(tmp_path), sharded=True)
+        base = [float(np.asarray(ex.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[3:]]
+
+        x, y, loss, train = build_extra(False)   # mask key gone
+        ex2 = ht.Executor({"train": [loss, train]})
+        ex2.load(str(tmp_path))
+        got = [float(np.asarray(ex2.run(
+            "train", feed_dict={x: a, y: b})[0])) for a, b in bs[3:]]
+        np.testing.assert_allclose(got, base, atol=1e-6)
+
+    def test_restore_rejects_missing_on_disk_keys(self, tmp_path):
+        """The superset path must NOT mask a checkpoint that lacks current
+        params (renamed param / wrong model) — that is a real error."""
+        bs = batches(2)
+        x, y, loss, train = build("mk")
+        ex = ht.Executor({"train": [loss, train]})
+        ex.run("train", feed_dict={x: bs[0][0], y: bs[0][1]})
+        ex.save(str(tmp_path), sharded=True)
+
+        def build_renamed():
+            x = ht.placeholder_op("x")
+            y = ht.placeholder_op("y")
+            w1 = ht.init.xavier_uniform((IN, HID), name="mk_fc1_weight")
+            b1 = ht.init.zeros((HID,), name="mk_fc1_bias")
+            w2 = ht.init.xavier_uniform((HID, IN), name="mk_fc2_RENAMED")
+            wh = ht.init.xavier_uniform((IN, OUT), name="mk_head")
+            h = ht.gelu_op(ht.linear_op(x, w1, b1))
+            h = ht.matmul_op(h, w2)
+            loss = ht.reduce_mean_op(
+                ht.softmaxcrossentropy_op(ht.matmul_op(h, wh), y), axes=0)
+            train = ht.optim.AdamOptimizer(
+                learning_rate=0.01).minimize(loss)
+            return x, y, loss, train
+
+        x, y, loss, train = build_renamed()
+        ex2 = ht.Executor({"train": [loss, train]})
+        with pytest.raises(Exception, match="(?i)match|structure|diff"):
+            ex2.load(str(tmp_path))
