@@ -69,12 +69,16 @@ def flash_attention_op(q, k, v, causal=False, kv_lens=None, block_q=None,
     return SimpleOp(fn, *inputs, name="FlashAttention", ctx=ctx)
 
 
-def ring_attention_op(q, k, v, mesh, axis="cp", causal=False, ctx=None):
+def ring_attention_op(q, k, v, mesh, axis="cp", causal=False, impl=None,
+                      ctx=None):
     """Ring attention over a sequence-sharded 'cp' mesh axis (long-context
-    path, SURVEY.md §5.7 — new capability vs the reference)."""
+    path, SURVEY.md §5.7 — new capability vs the reference).  ``impl``:
+    'flash' (fused Pallas block kernel — the TPU default), 'exact', or
+    None = auto by backend."""
     from ..parallel.context_parallel import ring_attention
 
     def fn(q, k, v):
-        return ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal)
+        return ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal,
+                              impl=impl)
 
     return SimpleOp(fn, q, k, v, name="RingAttention", ctx=ctx)

@@ -297,8 +297,12 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, bq, bk, n_kv):
 
 
 def _flash_bwd(q, k, v, kv_lens, o, lse, g, *, causal, block_q, block_k,
-               interpret):
-    """[BH, S, D] gradients via the fused kernels."""
+               interpret, g_lse=None):
+    """[BH, S, D] gradients via the fused kernels.
+
+    ``g_lse``: optional cotangent of the lse output (ring attention's
+    combine differentiates it); folds into delta since d lse/d s = P,
+    giving dS = P*(dP - delta + g_lse)."""
     BH, S, D = q.shape
     Sk = k.shape[1]
     bq = _fit_block(block_q, S)
@@ -309,7 +313,10 @@ def _flash_bwd(q, k, v, kv_lens, o, lse, g, *, causal, block_q, block_k,
     lens_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)] if masked else []
     lens_arg = (kv_lens,) if masked else ()
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)[:, None, :]              # [BH, 1, S]
+                    axis=-1)                          # [BH, S]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
+    delta = delta[:, None, :]                         # [BH, 1, S]
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -393,6 +400,59 @@ def _flash_bwd_rule(masked, causal, block_q, block_k, res, g):
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_stats(q, k, v, causal, block_q, block_k):
+    """Like ``_flash`` but also returns the per-row logsumexp — the
+    combination statistic ring attention needs to merge per-KV-block
+    partial outputs (o_i, lse_i) across rotations."""
+    o, lse = _flash_fwd(q, k, v, None, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=_use_interpret())
+    return o, lse[:, 0, :]
+
+
+def _flash_stats_fwd_rule(q, k, v, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, None, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=_use_interpret())
+    return (o, lse[:, 0, :]), (q, k, v, o, lse)
+
+
+def _flash_stats_bwd_rule(causal, block_q, block_k, res, g):
+    # With lse = m + log l an OUTPUT carrying cotangent g_lse, the FA2
+    # dS formula gains a P*g_lse term: dS = P*(dP - delta + g_lse) —
+    # i.e. the same kernels with delta shifted by -g_lse (d lse/d s = P).
+    q, k, v, o, lse = res
+    g_o, g_lse = g
+    dq, dk, dv = _flash_bwd(
+        q, k, v, None, o, lse, g_o, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=_use_interpret(), g_lse=g_lse)
+    return dq, dk, dv
+
+
+_flash_stats.defvjp(_flash_stats_fwd_rule, _flash_stats_bwd_rule)
+
+
+def flash_attention_with_lse(q, k, v, *, causal=False, block_q=512,
+                             block_k=1024):
+    """Flash attention on [B, S, H, D] returning (o, lse).
+
+    ``o`` is [B, S, H, D]; ``lse`` is [B, H, S] float32 per-row
+    logsumexp (``-1e30`` on rows with no live keys).  Differentiable in
+    both outputs — the building block for ring attention's cross-block
+    combine."""
+    B, S, H, D = q.shape
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+    o, lse = _flash_stats(fold(q), fold(k), fold(v), causal,
+                          block_q, block_k)
+    o = o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    lse = lse.reshape(B, H, S)
+    # dead rows carry +1e30 from the kernel (so exp(s-lse)=0 in its own
+    # backward); for cross-block combination they must read as "empty"
+    lse = jnp.where(lse >= -NEG_INF / 2, NEG_INF, lse)
+    return o, lse
 
 
 def flash_attention(q, k, v, *, causal=False, kv_lens=None, block_q=512,
