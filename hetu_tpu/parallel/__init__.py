@@ -10,7 +10,8 @@ from .mesh import (
 )
 from . import pipeline
 from .pipeline import (
-    spmd_pipeline, stack_stage_params, shard_stacked_params,
+    spmd_pipeline, spmd_pipeline_1f1b, stack_stage_params,
+    shard_stacked_params,
     gpipe_schedule, one_f_one_b_schedule, PipelineStage, PipelineTrainer,
 )
 from . import context_parallel

@@ -46,7 +46,7 @@ from jax import shard_map
 
 def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
                   axis="pp", checkpoint_stages=True, mb_spec=None,
-                  stage_takes_tick=False, manual_axes=None):
+                  stage_takes_index=False, manual_axes=None):
     """Run ``microbatches`` through a pipeline of S stages over mesh axis
     ``axis`` in one SPMD program.
 
@@ -64,9 +64,12 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
       mb_spec: PartitionSpec for the microbatch array (default fully
         replicated).  Pass e.g. ``P(None, 'dp')`` on a (pp, dp) mesh to
         run one pipeline per data-parallel replica.
-      stage_takes_tick: call ``stage_fn(params, x, t)`` with the schedule
-        tick t — lets callers decorrelate per-microbatch state (e.g.
-        dropout RNG: microbatch index = t - stage).
+      stage_takes_index: call ``stage_fn(params, x, m)`` with the
+        MICROBATCH index m (= tick - stage, clipped to [0, M)) — lets
+        callers decorrelate per-microbatch state (e.g. dropout RNG).
+        Keyed by m rather than the raw tick so that a recompute of the
+        same microbatch under a different schedule (the 1F1B backward)
+        reproduces the exact same randomness.
       manual_axes: axes the shard_map is MANUAL over (default: all).
         Passing {'pp'} leaves the other mesh axes to GSPMD, so tensor-
         parallel shardings on the stage params partition the in-stage
@@ -78,9 +81,10 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
     The schedule: tick t, device d computes microbatch ``t - d`` (when in
     range); total ticks T = M + S - 1; bubble fraction (S-1)/T, identical
     to GPipe.  Backward through the scan gives the reversed schedule, so
-    memory behavior matches GPipe (all activations live) unless
-    ``checkpoint_stages`` trades them for recompute — the same trade the
-    reference's 1F1B makes by scheduling.
+    memory behavior matches GPipe (O(M + S) live boundary activations)
+    unless ``checkpoint_stages`` trades stage INTERNALS for recompute.
+    For the O(S) activation high-water schedule use
+    ``spmd_pipeline_1f1b``.
     """
     S = mesh.shape[axis]
     M = microbatches.shape[0]
@@ -102,7 +106,8 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
             inp = jax.lax.dynamic_index_in_dim(
                 mb, jnp.clip(t, 0, M - 1), keepdims=False)
             x = jnp.where(stage == 0, inp, state)
-            y = fn(params, x, t) if stage_takes_tick else fn(params, x)
+            m_idx = jnp.clip(t - stage, 0, M - 1)
+            y = fn(params, x, m_idx) if stage_takes_index else fn(params, x)
             # last stage emits microbatch t - (S-1); masked unconditional
             # write (lax.cond is off the table: branches would differ in
             # device-varyingness under shard_map's vma tracking)
@@ -133,6 +138,215 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, *, mesh,
         per_device, mesh=mesh,
         in_specs=(pspec, rep), out_specs=rep, **kw,
     )(stage_params, microbatches)
+
+
+def spmd_pipeline_1f1b(stage_fn, stage_params, microbatches, *, mesh,
+                       axis="pp", mb_spec=None, manual_axes=None):
+    """1F1B pipeline: same contract as ``spmd_pipeline`` with
+    ``stage_takes_index=True``, but the backward pass runs a genuine
+    staggered one-forward-one-backward schedule whose activation
+    high-water is **O(S) in-flight microbatches per device** instead of
+    the O(M + S) saved scan carries that differentiating a forward-only
+    scan produces.
+
+    Reference counterpart: the generator 1F1B scheduler + bounded
+    in-flight buffer recycling of pipedream_subexecutor.py:25-48,213-221
+    (driven per-op from the host there; here the whole staggered schedule
+    is one XLA program).
+
+    Mechanics (custom VJP, two phases):
+
+    * primal/forward: the plain forward pipeline scan (nothing saved for
+      AD).  The microbatch-input residual is saved RESHARDED over the
+      pipeline axis — ``[S, M/S, ...]`` with spec ``P(axis)`` — so each
+      device retains only M/S boundary inputs, not the full replicated
+      [M, ...] (which would itself be the O(M) cost 1F1B exists to
+      avoid).  Device 0 fetches its per-tick ingest slot from the owner
+      via a masked psum; the cotangents dys / d(xs) move the same way.
+    * backward: one combined scan of T = M + 2S - 1 ticks.  Each tick a
+      device (1) re-forwards microbatch ``f = t - d`` and passes the
+      boundary activation to its successor — storing the stage INPUT in a
+      circular buffer of ``K = min(M, 2S-1)`` slots — and (2) runs the
+      VJP of microbatch ``b = t - (2S-1-d)`` from the buffered input,
+      consuming the cotangent rotated back from its successor and
+      accumulating its stage's param grads.  Stage internals are
+      rematerialized inside the per-tick VJP, so per-device live
+      activation state is K boundary slots + M/S input residuals + one
+      stage's internals — vs the M+S-1 saved carries of differentiating
+      the forward scan (buffer recycling = the slot reuse of
+      pipedream_subexecutor.py:213-221).
+
+    Per-microbatch-per-stage cost is one extra forward vs the
+    remat-gpipe lowering (re-forward for the rotation + VJP recompute),
+    plus three boundary-sized psums per tick for the sharded-residual
+    traffic — the price of the O(S) buffer with boundary-only storage.
+
+    When M is not a multiple of S the residual stays replicated (the
+    schedule is unchanged; only the memory bound loosens to M + 2S).
+
+    The math is IDENTICAL to gpipe (grads summed over all microbatches,
+    one update), so trajectories match to summation-order noise.
+    """
+    S = mesh.shape[axis]
+    M = microbatches.shape[0]
+    K = min(M, 2 * S - 1)
+    msh = M // S if (M % S == 0 and S > 1) else None   # per-device slots
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    rep = mb_spec if mb_spec is not None \
+        else P(*([None] * microbatches.ndim))
+    shard_res = P(axis, *rep)        # [S, M/S, ...] over the pipe axis
+    kw = {}
+    if manual_axes is not None:
+        kw["axis_names"] = frozenset(manual_axes)
+
+    def fwd_only(params, mb):
+        return spmd_pipeline(stage_fn, params, mb, mesh=mesh, axis=axis,
+                             checkpoint_stages=False, mb_spec=mb_spec,
+                             stage_takes_index=True,
+                             manual_axes=manual_axes)
+
+    def reshard(arr):
+        """[M, ...] -> [S, M/S, ...] placed one block per pipe device."""
+        return jax.lax.with_sharding_constraint(
+            arr.reshape((S, msh) + arr.shape[1:]),
+            NamedSharding(mesh, shard_res))
+
+    @jax.custom_vjp
+    def pipe(params, mb):
+        return fwd_only(params, mb)
+
+    def pipe_fwd(params, mb):
+        ys = fwd_only(params, mb)
+        return ys, (params, reshard(mb) if msh else mb)
+
+    def pipe_bwd(res, dys):
+        params, res_mb = res
+
+        def per_device(params, mb, dys):
+            # sharded layout: mb/dys leaves [1, M/S, ...]; replicated
+            # fallback: [M, ...]
+            params = jax.tree_util.tree_map(lambda p: p[0], params)
+            if msh:
+                mb, dys = mb[0], dys[0]
+            d = jax.lax.axis_index(axis)
+            T = M + 2 * S - 1
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+            def fetch(shard, m):
+                """Value for global microbatch m out of the pp-sharded
+                [M/S, ...] block (masked psum from the owner); replicated
+                fallback reads directly.  ``m`` must be UNIFORM across
+                the pipe axis — a device-varying index would make each
+                device contribute a different row and the psum would mix
+                microbatches."""
+                if not msh:
+                    return jax.lax.dynamic_index_in_dim(shard, m,
+                                                        keepdims=False)
+                v = jax.lax.dynamic_index_in_dim(shard, m % msh,
+                                                 keepdims=False)
+                v = jnp.where(d == m // msh, v, jnp.zeros_like(v))
+                return jax.lax.psum(v, axis)
+
+            zero_x = jnp.zeros(mb.shape[1:], mb.dtype)
+            carry0 = (
+                zero_x,                                    # fwd rotation
+                jnp.zeros(dys.shape[1:], dys.dtype),       # bwd rotation
+                jnp.zeros((K,) + mb.shape[1:], mb.dtype),  # K-slot buffer
+                jax.tree_util.tree_map(jnp.zeros_like, params),
+                jnp.zeros_like(mb),                        # d(xs) shard
+            )
+
+            def tick(carry, t):
+                y_in, dx_in, buf, dpar, dxs = carry
+
+                # ---- backward slot: microbatch b = t - (2S-1-d).
+                # Read the residual BEFORE the forward slot writes: when
+                # K slots wrap, read and write hit the same slot on the
+                # same tick.
+                b = t - (2 * S - 1 - d)
+                b_act = jnp.logical_and(b >= 0, b < M)
+                b_safe = jnp.clip(b, 0, M - 1)
+                x_res = jax.lax.dynamic_index_in_dim(buf, b_safe % K,
+                                                     keepdims=False)
+                # device S-1's backward microbatch is t - S: a UNIFORM
+                # index (fetch requires one; b_safe is device-varying)
+                g_top = fetch(dys, jnp.clip(t - S, 0, M - 1))
+                g_in = jnp.where(d == S - 1, g_top, dx_in)
+                g_in = jnp.where(b_act, g_in, jnp.zeros_like(g_in))
+                _, vjp = jax.vjp(
+                    lambda p, xx: stage_fn(p, xx, b_safe), params, x_res)
+                dp, dx = vjp(g_in)
+                dpar = jax.tree_util.tree_map(
+                    lambda a, g: a + jnp.where(b_act, g, 0).astype(
+                        a.dtype), dpar, dp)
+                # deliver device 0's d(input) — its backward microbatch
+                # is the uniform index t - (2S-1) — to the shard owner
+                m0 = t - (2 * S - 1)
+                m0_act = jnp.logical_and(m0 >= 0, m0 < M)
+                m0_safe = jnp.clip(m0, 0, M - 1)
+                if msh:
+                    dxb = jax.lax.psum(
+                        jnp.where(d == 0, dx, jnp.zeros_like(dx)), axis)
+                    slot = m0_safe % msh
+                    keep = jnp.logical_and(m0_act, d == m0_safe // msh)
+                else:
+                    dxb = dx
+                    slot = m0_safe
+                    keep = jnp.logical_and(m0_act, d == 0)
+                old_dx = jax.lax.dynamic_index_in_dim(dxs, slot,
+                                                      keepdims=False)
+                dxs = jax.lax.dynamic_update_index_in_dim(
+                    dxs, jnp.where(keep, dxb, old_dx), slot, 0)
+
+                # ---- forward slot: microbatch f = t - d (same flow as
+                # the forward pipeline; here it feeds the residual buffer
+                # and the successor's next tick)
+                f = t - d
+                f_act = jnp.logical_and(f >= 0, f < M)
+                f_safe = jnp.clip(f, 0, M - 1)
+                # device 0 ingests microbatch t: a uniform fetch index
+                x_f = jnp.where(d == 0, fetch(mb, jnp.clip(t, 0, M - 1)),
+                                y_in)
+                y = stage_fn(params, x_f, f_safe)
+                old_slot = jax.lax.dynamic_index_in_dim(buf, f_safe % K,
+                                                        keepdims=False)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(f_act, x_f, old_slot), f_safe % K, 0)
+
+                y_out = jax.lax.ppermute(y, axis, fwd_perm)
+                dx_out = jax.lax.ppermute(dx, axis, bwd_perm)
+                return (y_out, dx_out, buf, dpar, dxs), None
+
+            (_, _, _, dpar, dxs), _ = jax.lax.scan(
+                tick, carry0, jnp.arange(T))
+            if msh:
+                dxs = dxs[None]        # restore the sharded leading dim
+            else:
+                dxs = jax.lax.psum(
+                    jnp.where(d == 0, dxs, jnp.zeros_like(dxs)), axis)
+            dpar = jax.tree_util.tree_map(lambda g: g[None], dpar)
+            return dpar, dxs
+
+        # check_vma=False: this IS the backward — no AD flows through it,
+        # so vma tracking buys nothing and would reject the masked
+        # device-varying selects
+        res_spec = shard_res if msh else rep
+        dxs_s = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(pspec, res_spec, res_spec), out_specs=(pspec,
+                                                            res_spec),
+            check_vma=False, **kw,
+        )
+        dpar, dxs = dxs_s(params, res_mb,
+                          reshard(dys) if msh else dys)
+        if msh:
+            dxs = dxs.reshape((M,) + dxs.shape[2:])
+        return dpar, dxs
+
+    pipe.defvjp(pipe_fwd, pipe_bwd)
+    return pipe(stage_params, microbatches)
 
 
 def stack_stage_params(per_stage_params):

@@ -15,18 +15,28 @@ Two lowerings, chosen automatically from the partitioner's plan
    repeated body (e.g. N identical transformer blocks), and the mode is a
    synchronous schedule ('gpipe'/'1f1b').  Body-block params are stacked
    ``[S, R/S, ...]`` and sharded over 'pp'; microbatches flow through
-   ``spmd_pipeline`` (lax.scan + ppermute); the non-uniform ends —
-   embedding in front, head+loss behind — run OUTSIDE the pipeline loop,
-   vmapped over microbatches (this is the non-uniform-stage story: the
-   reference folds them into first/last stage; here they are simply not
-   part of the rotation).  Differentiating through the scan yields the
-   reverse schedule, so fwd+bwd+update is one XLA program.
+   the scan+ppermute pipeline; the non-uniform ends — embedding in
+   front, head+loss behind — run OUTSIDE the pipeline loop, vmapped over
+   microbatches (the reference folds them into first/last stage; here
+   their big tensors are instead SHARDED over the otherwise-idle 'pp'
+   axis, see ``_shard_end_params_over_pp``, so neither their params nor
+   their optimizer state are replicated per stage).  Two schedules:
+
+   * 'gpipe' (``spmd_pipeline``): differentiate through the forward
+     scan; activation high-water O(M + S) saved boundary carries.
+   * '1f1b' (``spmd_pipeline_1f1b``): custom-VJP staggered
+     one-forward-one-backward schedule; activation high-water O(S)
+     in-flight boundary slots per device — the real PipeDream/1F1B
+     memory property (pipedream_subexecutor.py:25-48), proven by
+     ``profiler.memory_analysis`` in test_pipeline_executor.
 
 2. **Microbatch scan** — no 'pp' mesh axis or no uniform body.  The step
    jits a ``lax.scan`` over microbatches: 'gpipe'/'1f1b' accumulate grads
    and update once (their loss trajectory is IDENTICAL to the
    non-pipelined step, which is what the reference's tier-2 equivalence
-   suite asserts); 'pipedream' applies per-microbatch updates in the scan
+   suite asserts; with no 'pp' axis there are no stages, so '1f1b' has
+   no schedule to stagger and is gpipe by construction); 'pipedream'
+   applies per-microbatch updates in the scan
    carry (reference per-in-flight-microbatch weight semantics collapse to
    sequential per-microbatch SGD when the program is a single SPMD step);
    'hetpipe' is 'pipedream' plus a host-side PS delta-sync every
@@ -51,7 +61,7 @@ from .graph.autodiff import find_topo_sort
 from .graph.ops_misc import PlaceholderOp
 from .optimizer import OptimizerOp
 from .parallel.partition import partition
-from .parallel.pipeline import spmd_pipeline
+from .parallel.pipeline import spmd_pipeline, spmd_pipeline_1f1b
 
 
 def _tree_add(a, b):
@@ -405,13 +415,15 @@ class PipelineSubExecutor:
 
             base_rng = jax.random.fold_in(rngs[0], 7)
 
-            def stage_fn(plist, x, t):
+            def stage_fn(plist, x, m):
                 # plist leaves [rps, ...].  RNG decorrelates over stage,
-                # schedule tick (microbatch = t - stage), and block index
-                # — without this every block/microbatch would reuse the
-                # template nodes' dropout masks.
+                # microbatch index, and block index — without this every
+                # block/microbatch would reuse the template nodes'
+                # dropout masks.  Keyed by MICROBATCH (not tick) so the
+                # 1F1B backward's recompute reproduces the forward's
+                # randomness exactly.
                 r = jax.random.fold_in(base_rng, jax.lax.axis_index("pp"))
-                r = jax.random.fold_in(r, t)
+                r = jax.random.fold_in(r, m)
 
                 def blk(h, pr_bi):
                     pr, bi = pr_bi
@@ -424,11 +436,19 @@ class PipelineSubExecutor:
                 h, _ = jax.lax.scan(blk, x, (plist, jnp.arange(rps)))
                 return h
 
-            ys = spmd_pipeline(stage_fn, stacked, xs, mesh=mesh,
-                               axis="pp",
-                               mb_spec=P(*([None] * (xs.ndim))),
-                               stage_takes_tick=True,
-                               manual_axes={"pp"})
+            if self.mode == "1f1b":
+                # real staggered 1F1B: O(S) activation high-water via the
+                # custom-VJP schedule (vs gpipe's O(M+S) saved carries)
+                ys = spmd_pipeline_1f1b(stage_fn, stacked, xs, mesh=mesh,
+                                        axis="pp",
+                                        mb_spec=P(*([None] * (xs.ndim))),
+                                        manual_axes={"pp"})
+            else:
+                ys = spmd_pipeline(stage_fn, stacked, xs, mesh=mesh,
+                                   axis="pp",
+                                   mb_spec=P(*([None] * (xs.ndim))),
+                                   stage_takes_index=True,
+                                   manual_axes={"pp"})
 
             def post_one(y, fmb, r):
                 tc = TraceContext(params={}, rng=jax.random.fold_in(r, 13),
