@@ -109,6 +109,7 @@ class Dataloader:
         self.name = str(name)
         self.dp_rank = None
         self.dp_nrank = None
+        self._shard = None
         self.parts = None
         self._initialized = False
         self._ring = None
@@ -124,6 +125,16 @@ class Dataloader:
     def set_mp_parts(self, cur_part, parts):
         self.cur_part = cur_part
         self.parts = parts
+
+    def set_batch_shard(self, lo, hi):
+        """Multi-host: keep only rows [lo, hi) of every (full) batch —
+        the rows this process's addressable devices hold under the feed
+        sharding.  Epoch/shuffle bookkeeping stays GLOBAL (identical on
+        every process), so the union of all processes' shards is exactly
+        the single-process batch and trajectories match; each process
+        slices, coerces, and device_puts only 1/P of the bytes
+        (reference per-worker dp-sharded loaders, dataloader.py:22-28)."""
+        self._shard = (int(lo), int(hi))
 
     # -------------------------------------------------------- #
 
@@ -239,7 +250,13 @@ class Dataloader:
             remaining = self.samples_num
         size = min(self.batch_size, remaining) if not self.drop_last \
             else self.batch_size
-        batch = self.data[self.seq[self.index:self.index + size]]
+        sel = self.seq[self.index:self.index + size]
+        if self._shard is not None and size == self.batch_size:
+            # slice BEFORE the gather: only this process's rows are
+            # fancy-indexed/copied (partial tails stay global — their
+            # row split would not line up with the full-batch sharding)
+            sel = sel[self._shard[0]:self._shard[1]]
+        batch = self.data[sel]
         self.index += size
         self.batch_id += 1
         if not self.drop_last and self.index >= self.samples_num:
@@ -271,6 +288,10 @@ class DataloaderOp(Op):
     def set_dp_rank(self, dp_rank, dp_nrank):
         for dl in self.dataloaders.values():
             dl.set_dp_rank(dp_rank, dp_nrank)
+
+    def set_batch_shard(self, lo, hi):
+        for dl in self.dataloaders.values():
+            dl.set_batch_shard(lo, hi)
 
     def get_batch_num(self, name):
         self.dataloaders[name].init_states()

@@ -107,6 +107,32 @@ def _build_and_run(mesh, layout="dp"):
     return _run_traj(loss, train, mesh, strategy, x, y, batches)
 
 
+def _build_and_run_loader(mesh):
+    """Dataloader-fed DP model.  Multi-host: each process's loader must
+    produce ONLY its addressable batch rows (VERDICT r2 item 5 — the
+    identical-global-batch convention does not scale host feed work).
+    Returns (losses, shard) where shard is the loader's (lo, hi) row
+    range (None single-process)."""
+    import hetu_tpu as ht
+
+    W1, W2, batches = _make_data()
+    xs = np.concatenate([a for a, _ in batches])
+    ys = np.concatenate([b for _, b in batches])
+    x = ht.dataloader_op([ht.Dataloader(xs, BATCH, "train")])
+    y = ht.dataloader_op([ht.Dataloader(ys, BATCH, "train")])
+    w1 = ht.Variable("w1", value=W1)
+    w2 = ht.Variable("w2", value=W2)
+    h = ht.relu_op(ht.matmul_op(x, w1))
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(h, w2), y), axes=0)
+    train = ht.optim.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    ex = ht.Executor({"train": [loss, train]}, mesh=mesh)
+    losses = [float(np.asarray(ex.run("train")[0]))
+              for _ in range(STEPS)]
+    shard = x.dataloaders["train"]._shard
+    return losses, shard
+
+
 def _worker(rank, port, layout, q):
     try:
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
@@ -119,6 +145,10 @@ def _worker(rank, port, layout, q):
         from hetu_tpu.launcher import distributed_init
         distributed_init()
         from hetu_tpu.parallel.mesh import make_mesh
+        if layout == "dp_loader":
+            losses, shard = _build_and_run_loader(make_mesh({"dp": 2}))
+            q.put((rank, {"losses": losses, "shard": shard}))
+            return
         mesh = make_mesh({layout: 2})        # one device per process
         losses = _build_and_run(mesh, layout)
         q.put((rank, losses))
@@ -264,6 +294,42 @@ with open({str(tmp_path)!r} + "/out_" + rank + ".json", "w") as f:
     t1 = json.loads((tmp_path / "out_1.json").read_text())
     np.testing.assert_allclose(t0, t1, rtol=0, atol=0)
     np.testing.assert_allclose(t0, _build_and_run(None), atol=1e-5)
+
+
+def test_per_process_loader_shards_are_disjoint_and_equivalent():
+    """VERDICT r2 item 5: dataloader-fed multi-host DP — each process's
+    loader produces only its addressable batch rows (disjoint, covering
+    the batch), and the trajectory still matches the single-process
+    loader run exactly."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = _free_port()
+    procs = [ctx.Process(target=_worker, args=(r, port, "dp_loader", q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, val = q.get(timeout=240)
+            results[rank] = val
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    for rank, val in results.items():
+        assert isinstance(val, dict), f"rank {rank}: {val}"
+    s0, s1 = results[0]["shard"], results[1]["shard"]
+    assert s0 is not None and s1 is not None, (s0, s1)
+    # disjoint and jointly covering the global batch
+    assert sorted([tuple(s0), tuple(s1)]) == [(0, BATCH // 2),
+                                              (BATCH // 2, BATCH)]
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=0, atol=0)
+    base, base_shard = _build_and_run_loader(None)
+    assert base_shard is None
+    np.testing.assert_allclose(results[0]["losses"], base, atol=1e-5)
 
 
 @pytest.mark.parametrize("layout", ["dp", "tp", "cp"])
