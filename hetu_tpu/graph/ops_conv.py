@@ -30,11 +30,14 @@ def conv2d_op(a, w, stride=1, padding=0, ctx=None):
         padding = (padding, padding)
 
     def f(x, k):
+        # no preferred_element_type: conv's transpose rule feeds the f32
+        # cotangent back into a conv with the bf16 filter and trips the
+        # same-dtype check (unlike dot_general's); the MXU accumulates
+        # conv partials in f32 regardless, so nothing is lost
         return jax.lax.conv_general_dilated(
             x, k, window_strides=tuple(stride),
             padding=[(padding[0], padding[0]), (padding[1], padding[1])],
-            dimension_numbers=_DIMNUMS,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            dimension_numbers=_DIMNUMS)
     return _simple("Conv2d", f, a, w, ctx=ctx)
 
 
@@ -45,11 +48,11 @@ def conv2d_add_bias_op(a, w, bias, stride=1, padding=0, ctx=None):
         padding = (padding, padding)
 
     def f(x, k, b):
+        # see conv2d_op on the absent preferred_element_type
         y = jax.lax.conv_general_dilated(
             x, k, window_strides=tuple(stride),
             padding=[(padding[0], padding[0]), (padding[1], padding[1])],
-            dimension_numbers=_DIMNUMS,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            dimension_numbers=_DIMNUMS)
         return y + b.reshape(1, -1, 1, 1)
     return _simple("Conv2dAddBias", f, a, w, bias, ctx=ctx)
 

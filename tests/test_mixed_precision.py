@@ -65,6 +65,34 @@ class TestMixedPrecision:
             ids: np.array([[1, 2], [3, 4]], np.int32)})
         assert np.asarray(res[0]).dtype == np.float32
 
+    def test_bf16_conv_bn_trains(self):
+        """Conv + BatchNorm under bf16: the conv transpose rule rejects a
+        preferred_element_type=f32 cotangent against a bf16 filter
+        (caught benching ResNet-18 bf16) — pin the whole conv/BN train
+        step working under the policy."""
+        x = ht.placeholder_op("cmp_x")
+        y = ht.placeholder_op("cmp_y")
+        h = ht.conv2d_op(x, ht.init.xavier_uniform((8, 3, 3, 3),
+                                                   name="cmp_k"),
+                         stride=1, padding=1)
+        h = ht.layers.BatchNorm(8, name="cmp_bn")(h)
+        h = ht.relu_op(h)
+        h = ht.reduce_mean_op(h, [2, 3])
+        logits = ht.matmul_op(h, ht.init.xavier_uniform(
+            (8, 4), name="cmp_w"))
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_op(logits, y), axes=0)
+        train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]},
+                         mixed_precision="bf16")
+        rng = np.random.RandomState(0)
+        xb = rng.randn(8, 3, 16, 16).astype(np.float32)
+        yb = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 8)]
+        tr = [float(np.asarray(ex.run("train", feed_dict={x: xb, y: yb})[0]))
+              for _ in range(5)]
+        assert np.all(np.isfinite(tr))
+        assert tr[-1] < tr[0]
+
     def test_batchnorm_running_stats_stay_fp32(self):
         x = ht.placeholder_op("mp_bn_x")
         bn = ht.layers.BatchNorm(4, name="mp_bn")
