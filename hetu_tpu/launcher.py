@@ -43,18 +43,36 @@ def _free_port():
     return port
 
 
-def _start_ps_process(port):
+def _start_ps_process(port, extra_env=None):
     proc = multiprocessing.get_context("spawn").Process(
-        target=_ps_main, args=(port,), daemon=True)
+        target=_ps_main, args=(port, extra_env), daemon=True)
     proc.start()
     _procs.append(proc)
     return proc
 
 
-def _ps_main(port):
+def _ps_main(port, extra_env=None):
+    # env set in the CHILD only — mutating the launcher's own environ
+    # would leak role variables (e.g. HETU_SCHEDULER_ADDR) into later
+    # in-process PSClient.get() resolution
+    os.environ.update(extra_env or {})
     os.environ["HETU_PS_PORT"] = str(port)
     from .ps.server import PSServer
     PSServer.serve_from_env()
+
+
+def _scheduler_main(port):
+    os.environ["HETU_SCHEDULER_PORT"] = str(port)
+    from .ps.server import Scheduler
+    Scheduler.serve_from_env()
+
+
+def _start_scheduler_process(port):
+    proc = multiprocessing.get_context("spawn").Process(
+        target=_scheduler_main, args=(port,), daemon=True)
+    proc.start()
+    _procs.append(proc)
+    return proc
 
 
 def _wait_ps(host, port, timeout=20.0):
@@ -129,19 +147,34 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655):
     # the chief)
     ps_host = next(iter(config.servers), config.chief or "localhost")
     ps_addrs = []
+    sched_addr = None
     if config.enable_PS:
         base_port = int(os.environ.get("HETU_PS_PORT", DEFAULT_PS_PORT))
+        # scheduler rendezvous (ps-lite Postoffice role): servers
+        # register; workers can resolve the group dynamically.  Static
+        # HETU_PS_ADDRS is still exported and takes precedence — the
+        # scheduler is the contract for deployments where ports are not
+        # known up front.
+        sched_port = _free_port()
+        _start_scheduler_process(sched_port)
+        _wait_ps("localhost", sched_port)
+        sched_addr = f"{config.chief or 'localhost'}:{sched_port}"
         idx = 0
         for host, n in config.servers.items():
             for _ in range(n):
                 port = base_port + idx
+                env_extra = {"HETU_SCHEDULER_ADDR":
+                             f"localhost:{sched_port}"
+                             if host in local_names else sched_addr,
+                             "HETU_PS_INDEX": str(idx),
+                             "HETU_PS_ADVERTISE": f"{host}:{port}"}
                 idx += 1
                 if host in local_names:
-                    _start_ps_process(port)
+                    _start_ps_process(port, env_extra)
                 else:
                     _ssh_spawn(host, [
                         sys.executable, "-m", "hetu_tpu.launcher",
-                        "--serve-ps", str(port)])
+                        "--serve-ps", str(port)], env=env_extra)
                 ps_addrs.append(f"{host}:{port}")
         ps_host, ps_port = ps_addrs[0].rsplit(":", 1)
         ps_port = int(ps_port)
@@ -159,6 +192,9 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655):
                               coordinator)
             if ps_addrs:
                 env["HETU_PS_ADDRS"] = ",".join(ps_addrs)
+                env["HETU_PS_NSERVERS"] = str(len(ps_addrs))
+            if sched_addr:
+                env["HETU_SCHEDULER_ADDR"] = sched_addr
             if host in local_names:
                 p = subprocess.Popen(command, env=env)
                 _procs.append(p)
@@ -229,12 +265,17 @@ def main(argv=None):
                         help="local worker count (no yaml)")
     parser.add_argument("--serve-ps", type=int, default=None,
                         help=argparse.SUPPRESS)  # internal: PS role
+    parser.add_argument("--serve-scheduler", type=int, default=None,
+                        help=argparse.SUPPRESS)  # internal: rendezvous
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
 
     if args.serve_ps is not None:
         _ps_main(args.serve_ps)
+        return 0
+    if args.serve_scheduler is not None:
+        _scheduler_main(args.serve_scheduler)
         return 0
     if not args.command:
         parser.error("no worker command given")

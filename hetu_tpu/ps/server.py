@@ -336,42 +336,13 @@ class PSServer:
     def serve_from_env(cls):
         port = int(os.environ.get("HETU_PS_PORT", "23455"))
         server = cls.get()
-        server.serve_tcp(port)
+        tcp = server.serve_tcp(port, block=False)
+        # announce to the rendezvous scheduler, if one is configured
+        _register_with_scheduler(port)
+        tcp.serve_forever()
 
     def serve_tcp(self, port, block=True):
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                try:
-                    while True:
-                        raw = _recv_msg(self.request)
-                        if raw is None:
-                            return
-                        method, args, kwargs = pickle.loads(raw)
-                        try:
-                            result = getattr(outer, method)(*args, **kwargs)
-                            payload = pickle.dumps(
-                                (True, result),
-                                protocol=pickle.HIGHEST_PROTOCOL)
-                        except Exception as e:  # noqa: BLE001
-                            payload = pickle.dumps(
-                                (False, repr(e)),
-                                protocol=pickle.HIGHEST_PROTOCOL)
-                        _send_msg(self.request, payload)
-                except (ConnectionResetError, BrokenPipeError):
-                    return
-
-        class Threaded(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._tcp = Threaded(("0.0.0.0", port), Handler)
-        if block:
-            self._tcp.serve_forever()
-        else:
-            t = threading.Thread(target=self._tcp.serve_forever, daemon=True)
-            t.start()
+        self._tcp = _serve_object_tcp(self, port, block)
         return self._tcp
 
     def shutdown(self):
@@ -658,13 +629,155 @@ def _recv_exact(sock, n):
     return buf      # pickle.loads takes the bytearray without a copy
 
 
+def _serve_object_tcp(obj, port, block=True):
+    """Serve ``obj``'s public methods over the length-prefixed TCP
+    framing.  Requests come in two shapes:
+
+    * legacy ``(method, args, kwargs)``;
+    * ``('__req2__', client_id, seq, method, args, kwargs)`` — the
+      reliable framing the hardened client sends.  The server keeps a
+      one-slot replay cache per client: a request whose seq was already
+      served gets the CACHED response replayed instead of re-applying the
+      method (ps-lite resender.h parity — without this, a client retry
+      after a lost response would double-apply a push)."""
+    import collections as _collections
+    replay = _collections.OrderedDict()   # client_id -> (seq, payload)
+    replay_cv = threading.Condition()
+    _MAX_CLIENTS = 1024                   # LRU bound: one slot per client
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                while True:
+                    raw = _recv_msg(self.request)
+                    if raw is None:
+                        return
+                    msg = pickle.loads(raw)
+                    cid = seq = None
+                    if isinstance(msg, tuple) and msg \
+                            and msg[0] == "__req2__":
+                        _, cid, seq, method, args, kwargs = msg
+                        with replay_cv:
+                            cached = replay.get(cid)
+                            if cached is not None and cached[0] == seq:
+                                # retransmit of an IN-FLIGHT request
+                                # (payload None): wait for the original
+                                # to finish, then replay its response —
+                                # never execute twice
+                                while cached is not None and \
+                                        cached[0] == seq and \
+                                        cached[1] is None:
+                                    replay_cv.wait(1.0)
+                                    cached = replay.get(cid)
+                                if cached is not None and \
+                                        cached[0] == seq:
+                                    _send_msg(self.request, cached[1])
+                                    continue
+                            replay[cid] = (seq, None)   # mark in flight
+                            replay.move_to_end(cid)
+                            while len(replay) > _MAX_CLIENTS:
+                                replay.popitem(last=False)
+                    else:
+                        method, args, kwargs = msg
+                    try:
+                        result = getattr(obj, method)(*args, **kwargs)
+                        payload = pickle.dumps(
+                            (True, result),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+                    except Exception as e:  # noqa: BLE001
+                        payload = pickle.dumps(
+                            (False, repr(e)),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+                    if cid is not None:
+                        with replay_cv:
+                            replay[cid] = (seq, payload)
+                            replay_cv.notify_all()
+                    _send_msg(self.request, payload)
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                return
+
+    class Threaded(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = Threaded(("0.0.0.0", port), Handler)
+    if block:
+        srv.serve_forever()
+    else:
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+    return srv
+
+
 class Scheduler:
-    """Role parity with ps-lite's scheduler (Postoffice): with the TCP
-    transport, workers connect directly to servers, so the scheduler only
-    serves the rendezvous file/port mapping."""
+    """Rendezvous role (ps-lite Postoffice/scheduler parity): servers
+    REGISTER themselves; workers BLOCK until the expected server group is
+    complete and receive the address list.  With the TCP transport,
+    workers then connect directly to servers — the scheduler is only the
+    bootstrap, exactly the reference scheduler's role.
+
+    Env contract: servers set HETU_SCHEDULER_ADDR (+ optional
+    HETU_PS_INDEX / HETU_PS_ADVERTISE) and register on startup; workers
+    with HETU_SCHEDULER_ADDR and no static HETU_PS_ADDR(S) resolve the
+    group via ``get_servers`` (expected count HETU_PS_NSERVERS)."""
+
+    def __init__(self):
+        self._servers = {}           # index -> addr
+        self._cv = threading.Condition()
+
+    def register_server(self, index, addr):
+        with self._cv:
+            self._servers[int(index)] = str(addr)
+            self._cv.notify_all()
+        return True
+
+    def get_servers(self, expected, timeout=60.0):
+        """Block until ``expected`` servers registered; return addresses
+        ordered by server index.  TimeoutError (surfaced client-side as a
+        server error) when the group never completes."""
+        deadline = time.time() + float(timeout)
+        with self._cv:
+            while len(self._servers) < int(expected):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"scheduler rendezvous: {len(self._servers)}/"
+                        f"{expected} servers registered within {timeout}s")
+                self._cv.wait(remaining)
+            return [a for _, a in sorted(self._servers.items())]
+
+    def num_servers(self):
+        with self._cv:
+            return len(self._servers)
+
+    def serve_tcp(self, port, block=True):
+        self._tcp = _serve_object_tcp(self, port, block)
+        return self._tcp
+
+    def shutdown(self):
+        if getattr(self, "_tcp", None) is not None:
+            self._tcp.shutdown()
+            self._tcp = None
 
     @classmethod
     def serve_from_env(cls):
-        # single-server deployments need no rendezvous; multi-server
-        # sharding reuses the same code with a static port map.
-        pass
+        port = int(os.environ.get("HETU_SCHEDULER_PORT", "23454"))
+        cls().serve_tcp(port)
+
+
+def _register_with_scheduler(port):
+    """Server-side registration (called by serve_from_env when a
+    scheduler is configured)."""
+    sched = os.environ.get("HETU_SCHEDULER_ADDR")
+    if not sched:
+        return
+    from .client import _TCPTransport
+    host, sport = sched.rsplit(":", 1)
+    t = _TCPTransport(host, int(sport))
+    index = int(os.environ.get("HETU_PS_INDEX", "0"))
+    adv = os.environ.get("HETU_PS_ADVERTISE",
+                         f"{socket.gethostname()}:{port}")
+    t.call("register_server", index, adv)
+    t.close()
+
+

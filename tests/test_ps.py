@@ -92,3 +92,145 @@ def test_embedding_version_sync(local_client):
     ids, rows, vers = c.sync_embedding("he", np.arange(4), np.zeros(4), 0)
     assert set(ids.tolist()) == {0, 1}
     assert (vers > 0).all()
+
+
+# --------------------------------------------------------------------- #
+# transport hardening (VERDICT r2 item 7; ps-lite resender.h /
+# postoffice.h parity)
+# --------------------------------------------------------------------- #
+
+import os
+import socket as _socket
+import subprocess as _subprocess
+import sys as _sys
+import time as _time
+
+from hetu_tpu.ps.client import PSConnectionError
+from hetu_tpu.ps.server import Scheduler
+
+
+def _free_port():
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestTransportHardening:
+    def test_dead_server_raises_not_hangs(self):
+        """Request to a port nobody listens on: clean PSConnectionError
+        within the retry budget, never a hang."""
+        t = _TCPTransport("127.0.0.1", _free_port(), timeout=1.0,
+                          connect_timeout=0.5, retries=2)
+        t0 = _time.time()
+        with pytest.raises(PSConnectionError, match="failed after 2"):
+            t.call("pull", "nope")
+        assert _time.time() - t0 < 10.0
+
+    def test_server_killed_mid_training_surfaces_cleanly(self, tmp_path):
+        """Fault injection: a Hybrid training run whose PS process is
+        SIGKILLed mid-step must raise PSConnectionError on the next PS
+        round trip (reference failure mode: hang / pickle error)."""
+        port = _free_port()
+        srv = _subprocess.Popen(
+            [_sys.executable, "-m", "hetu_tpu.launcher",
+             "--serve-ps", str(port)],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            deadline = _time.time() + 20
+            while _time.time() < deadline:
+                try:
+                    s = _socket.create_connection(("127.0.0.1", port), 0.5)
+                    s.close()
+                    break
+                except OSError:
+                    _time.sleep(0.1)
+            t = _TCPTransport("127.0.0.1", port, timeout=2.0,
+                              connect_timeout=1.0, retries=2)
+            c = PSClient(transport=t)
+            c.parameter_init("fi_w", (4, 2), "constant", 0.0, opt="sgd",
+                             opt_args={"learning_rate": 0.1})
+            out = c.sd_pushpull("fi_w", np.array([0, 1]),
+                                np.ones((2, 2), np.float32))
+            assert out.shape == (2, 2)
+            srv.kill()
+            srv.wait()
+            with pytest.raises(PSConnectionError):
+                c.sd_pushpull("fi_w", np.array([0, 1]),
+                              np.ones((2, 2), np.float32))
+            c.finalize()
+        finally:
+            if srv.poll() is None:
+                srv.kill()
+                srv.wait()
+
+    def test_retry_does_not_double_apply(self):
+        """Resender parity: a retransmitted request (same client seq,
+        e.g. after a lost response) must get the CACHED response replayed
+        — the push is applied exactly once."""
+        PSServer._instance = None
+        server = PSServer.get()
+        port = _free_port()
+        tcp = server.serve_tcp(port, block=False)
+        try:
+            t = _TCPTransport("127.0.0.1", port)
+            t.call("param_init", "dup_w", (3,), "constant", 1.0)
+            t.call("push", "dup_w", np.ones(3, np.float32))
+            # simulate the retransmit: rewind the client seq so the next
+            # call reuses the seq the server just served
+            t._state().seq -= 1
+            t.call("push", "dup_w", np.ones(3, np.float32))
+            np.testing.assert_allclose(server.pull("dup_w"), 2.0)  # not 3
+            t.close()
+        finally:
+            server.shutdown()
+            PSServer._instance = None
+
+
+class TestScheduler:
+    def test_rendezvous_blocks_until_group_complete(self):
+        sched = Scheduler()
+        port = _free_port()
+        sched.serve_tcp(port, block=False)
+        try:
+            t = _TCPTransport("127.0.0.1", port)
+            t.call("register_server", 1, "hostB:1001")
+            # incomplete group times out with a clear error
+            with pytest.raises(RuntimeError, match="rendezvous"):
+                t.call("get_servers", 2, 0.2)
+            t.call("register_server", 0, "hostA:1000")
+            addrs = t.call("get_servers", 2, 5.0)
+            assert addrs == ["hostA:1000", "hostB:1001"]   # index order
+            t.close()
+        finally:
+            sched.shutdown()
+
+    def test_client_resolves_group_via_scheduler(self, monkeypatch):
+        """Worker with only HETU_SCHEDULER_ADDR set discovers the server
+        and trains against it."""
+        sched = Scheduler()
+        sport = _free_port()
+        sched.serve_tcp(sport, block=False)
+        PSServer._instance = None
+        server = PSServer.get()
+        pport = _free_port()
+        tcp = server.serve_tcp(pport, block=False)
+        try:
+            t = _TCPTransport("127.0.0.1", sport)
+            t.call("register_server", 0, f"127.0.0.1:{pport}")
+            t.close()
+            monkeypatch.delenv("HETU_PS_ADDR", raising=False)
+            monkeypatch.delenv("HETU_PS_ADDRS", raising=False)
+            monkeypatch.setenv("HETU_SCHEDULER_ADDR", f"127.0.0.1:{sport}")
+            monkeypatch.setenv("HETU_PS_NSERVERS", "1")
+            PSClient._instance = None
+            c = PSClient.get()
+            c.parameter_init("sched_w", (2,), "constant", 5.0)
+            np.testing.assert_allclose(c.pull("sched_w"), 5.0)
+            c.finalize()
+        finally:
+            sched.shutdown()
+            server.shutdown()
+            PSServer._instance = None
+            PSClient._instance = None
