@@ -345,8 +345,10 @@ class SubExecutor:
                 # host-side; the in-trace gather re-expands them
                 # (device-side dedup — the host link carries U unique
                 # rows, not B*T positions; reference dedups on GPU via
-                # IndexedSlices, src/ops/IndexedSlices.cu)
-                uniq = _cast_in(feeds["__psuniq__" + node.name])
+                # IndexedSlices, src/ops/IndexedSlices.cu).  Unique rows
+                # are keyed per TABLE (several lookups share one fetch);
+                # the expansion map is per lookup.
+                uniq = _cast_in(feeds["__psuniq__" + node.inputs[0].name])
                 inv = feeds["__psinv__" + node.name]
                 vals[id(node)] = jnp.take(uniq, inv, axis=0)
             elif isinstance(node, DataloaderOp):
@@ -377,15 +379,19 @@ class SubExecutor:
                     [vals[id(i)] for i in node.inputs], tc)
         # dedup the embedding grads on DEVICE: segment-sum per-position
         # rows into the unique-row slots so phase B ships U rows back,
-        # mirroring the forward's unique-row feed
-        for lk in self.ps_lookups:
-            var = lk.inputs[0].name
+        # mirroring the forward's unique-row feed.  The adjoint carries
+        # vocab ids (possibly concatenated across several lookups into
+        # the table); searchsorted against the sorted unique-id feed maps
+        # them to slots.
+        for var in {lk.inputs[0].name for lk in self.ps_lookups}:
             if var in side_outputs and var in self.executor.ps_sparse_vars:
-                inv = feeds["__psinv__" + lk.name].reshape(-1)
-                rows = side_outputs[var]
-                upad = feeds["__psuniq__" + lk.name].shape[0]
+                ids, rows = side_outputs[var]
+                uniq_ids = feeds["__psuniqids__" + var]
+                slot = jnp.searchsorted(uniq_ids,
+                                        ids.astype(uniq_ids.dtype))
                 g_uniq = jnp.zeros(
-                    (upad, rows.shape[-1]), rows.dtype).at[inv].add(rows)
+                    (uniq_ids.shape[0], rows.shape[-1]),
+                    rows.dtype).at[slot].add(rows)
                 if mp is not None:
                     # grads were computed in the policy dtype; shipping
                     # them D2H at that width halves the host-link bytes
@@ -486,17 +492,20 @@ class SubExecutor:
         per batch; the in-trace gather re-expands to B*T positions."""
         ex = self.executor
         ps_ids = {}
+        by_var = {}
         for lk in self.ps_lookups:
-            var_name = lk.inputs[0].name
-            src = lk.inputs[1]
-            ids = np.asarray(feeds[src.name])
-            pre = self._prefetched.pop(lk.name, None)
-            if pre is not None and np.array_equal(pre[0], ids):
-                _, uniq, inv, fut = pre
+            by_var.setdefault(lk.inputs[0].name, []).append(lk)
+        for var_name, lks in by_var.items():
+            id_arrays = [np.asarray(feeds[lk.inputs[1].name])
+                         for lk in lks]
+            all_flat = np.concatenate(
+                [a.reshape(-1).astype(np.int64) for a in id_arrays])
+            pre = self._prefetched.pop(var_name, None)
+            if pre is not None and np.array_equal(pre[0], all_flat):
+                _, uniq, fut = pre
                 rows = fut.result()
             else:
-                uniq, inv = np.unique(
-                    ids.reshape(-1).astype(np.int64), return_inverse=True)
+                uniq = np.unique(all_flat)
                 rows = ex.ps_lookup(var_name, uniq)
             rows = np.asarray(rows, np.float32).reshape(len(uniq), -1)
             mp = ex.config.mixed_precision
@@ -509,9 +518,18 @@ class SubExecutor:
                 rows = np.concatenate(
                     [rows, np.zeros((upad - len(uniq), rows.shape[1]),
                                     rows.dtype)])
-            feeds["__psuniq__" + lk.name] = rows
-            feeds["__psinv__" + lk.name] = \
-                inv.reshape(ids.shape).astype(np.int32)
+            # sorted unique ids, padded with a +inf-like sentinel so the
+            # device searchsorted stays within a sorted array.  int32:
+            # jax (x64 off) would silently demote an int64 feed and
+            # overflow the sentinel into the middle of the "sorted" array
+            uniq_pad = np.full(upad, np.iinfo(np.int32).max, np.int32)
+            uniq_pad[:len(uniq)] = uniq
+            feeds["__psuniq__" + var_name] = rows
+            feeds["__psuniqids__" + var_name] = uniq_pad
+            for lk, ids in zip(lks, id_arrays):
+                inv = np.searchsorted(uniq, ids.reshape(-1))
+                feeds["__psinv__" + lk.name] = \
+                    inv.reshape(ids.shape).astype(np.int32)
             ps_ids[var_name] = uniq
         # dense-PS params ('PS' mode): refresh from the server so other
         # workers' pushes are visible (BSP/SSP pacing via config.bsp)
@@ -548,20 +566,24 @@ class SubExecutor:
         if not ex.config.prefetch or not self.ps_lookups:
             return
         from .dataloader import DataloaderOp
+        by_var = {}
         for lk in self.ps_lookups:
-            src = lk.inputs[1]
-            if not isinstance(src, DataloaderOp):
+            by_var.setdefault(lk.inputs[0].name, []).append(lk)
+        for var_name, lks in by_var.items():
+            srcs = [lk.inputs[1] for lk in lks]
+            if not all(isinstance(s, DataloaderOp) for s in srcs):
                 continue
             try:
-                ids = np.asarray(src.peek_arr(self.name))
+                id_arrays = [np.asarray(s.peek_arr(self.name))
+                             for s in srcs]
             except Exception:
                 continue
-            var_name = lk.inputs[0].name
-            uniq, inv = np.unique(
-                ids.reshape(-1).astype(np.int64), return_inverse=True)
+            all_flat = np.concatenate(
+                [a.reshape(-1).astype(np.int64) for a in id_arrays])
+            uniq = np.unique(all_flat)
             fut = ex.ps_lookup_async(var_name, uniq)
             if fut is not None:
-                self._prefetched[lk.name] = (ids, uniq, inv, fut)
+                self._prefetched[var_name] = (all_flat, uniq, fut)
 
 
 def _opt_sharding_like(ex, opt_states):
@@ -693,16 +715,15 @@ class Executor:
                 continue
             cons = consumers.get(id(node), [])
             # a table can live on the PS iff its device value is only ever
-            # needed row-wise: lookups and sparse adjoints.  Exactly ONE
-            # lookup: with two, autodiff sums the IndexedSlices adjoints
-            # through a dense SumOp (needs the device table) and the
-            # id<->grad pairing per lookup is lost — multi-lookup tables
-            # stay on device (Hybrid) / go dense-PS ('PS' mode).
+            # needed row-wise: lookups and sparse adjoints.  ANY number of
+            # lookups composes — autodiff keeps multi-lookup adjoints
+            # sparse (merge_indexed_slices concat) and phase A fetches the
+            # union of their ids once per table.
             n_lookups = sum(1 for c in cons
                             if isinstance(c, EmbeddingLookupOp)
                             and c.inputs[0] is node)
             sparse_ok = getattr(node, "is_embed", False) and \
-                n_lookups == 1 and all(
+                n_lookups >= 1 and all(
                 (isinstance(c, (EmbeddingLookupOp, IndexedSlicesOp))
                  and c.inputs[0] is node) or isinstance(c, OptimizerOp)
                 for c in cons)
@@ -734,15 +755,17 @@ class Executor:
                 # HET cache: the worker applies SGD scaling locally and the
                 # server raw-accumulates the pushed deltas (hetu_cache
                 # write-back semantics) — other optimizers would need their
-                # slot state inside every cache line
+                # slot state inside every cache line.  LR SCHEDULES are
+                # fine: each push scales by the pushing step's lr_value
+                # (ps_update reads the step index), so scheduled-SGD
+                # deltas accumulate exactly like the dense path.
                 if opt is not None and (type(opt) is not SGDOptimizer
-                                        or opt.l2reg
-                                        or hasattr(opt.learning_rate,
-                                                   "value")):
+                                        or opt.l2reg):
                     raise NotImplementedError(
                         "the HET cache path accumulates -lr*grad deltas; "
-                        "only plain SGD with a scalar LR is supported on "
-                        "cached embeddings (reference hetu_cache ditto)")
+                        "only SGD (fixed or scheduled LR, no l2) is "
+                        "supported on cached embeddings (reference "
+                        "hetu_cache ditto)")
                 # the HET cache's versioned sync protocol needs the whole
                 # table on ONE server; with a sharded client the table
                 # lives whole on its home server of the group

@@ -41,6 +41,12 @@ def sum_node_list(node_list):
         return None
     if len(node_list) == 1:
         return node_list[0]
+    if all(isinstance(n, IndexedSlicesOp) for n in node_list) and \
+            len({id(n.inputs[0]) for n in node_list}) == 1:
+        # several lookups into one table: keep the adjoint SPARSE by
+        # concatenating (ids, rows) — consumers merge duplicates
+        from .ops_embed import merge_indexed_slices
+        return merge_indexed_slices(node_list)
     return SumOp(node_list)
 
 

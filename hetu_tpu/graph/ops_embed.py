@@ -65,6 +65,31 @@ class IndexedSlicesOp(Op):
         raise NotImplementedError
 
 
+def merge_indexed_slices(slices, ctx=None):
+    """Sparse SUM of several IndexedSlices adjoints on the SAME table:
+    concatenate (ids, rows) — scatter-add is order-free, and every
+    consumer (optimizer sparse update, PS side-output, densify) already
+    merges duplicate ids.  This keeps multi-lookup embedding tables
+    sparse end-to-end (reference densifies via executor.py:1119-1127
+    SumOp; its IndexedSlices dedup kernel then re-sparsifies)."""
+    table = slices[0].inputs[0]
+    assert all(s.inputs[0] is table for s in slices)
+
+    def cat_ids(*xs):
+        return jnp.concatenate(
+            [x.astype(jnp.int32).reshape(-1) for x in xs])
+
+    def cat_rows(*xs):
+        return jnp.concatenate(
+            [x.reshape(-1, x.shape[-1]) for x in xs])
+
+    ids = _simple("ConcatIds", cat_ids, *[s.ids_node for s in slices],
+                  nondiff=True, ctx=ctx)
+    vals = _simple("ConcatRows", cat_rows,
+                   *[s.values_node for s in slices], ctx=ctx)
+    return IndexedSlicesOp(table, ids, vals, ctx=ctx)
+
+
 def unique_indices_op(ids, ctx=None):
     """Deduplicated indices padded with -1 (reference ndarray.py deduplicate).
     Static output shape = input shape (worst case all-unique)."""

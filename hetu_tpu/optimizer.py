@@ -378,11 +378,17 @@ class OptimizerOp(Op):
         for i, var in enumerate(self.var_list):
             if var.name in ps_vars:
                 if i in self.sparse_inputs:
-                    rows = grad_vals[i][1]
+                    ids, rows = grad_vals[i]
+                    ids = ids.astype(jnp.int32).reshape(-1)
                     rows = rows.reshape(-1, rows.shape[-1])
                     if grad_scale is not None:
                         rows = rows * grad_scale
-                    side_outputs[var.name] = rows.astype(jnp.float32)
+                    # (vocab ids, per-position rows): the executor's
+                    # device-side dedup maps ids -> unique-row slots, so
+                    # several lookups into one table compose (their
+                    # adjoints arrive concatenated)
+                    side_outputs[var.name] = (ids,
+                                              rows.astype(jnp.float32))
                 else:
                     g = grad_vals[i]
                     if grad_scale is not None:
