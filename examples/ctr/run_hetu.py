@@ -53,6 +53,10 @@ def main():
                         choices=["wdl_adult", "wdl_criteo", "dcn_criteo",
                                  "deepfm_criteo", "dc_criteo"])
     parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--data-path", default=None,
+                        help="dir with reference-format criteo files "
+                             "(train_*.npy / train.txt / train.csv); "
+                             "synthetic data when unset")
     parser.add_argument("--num-steps", type=int, default=100)
     parser.add_argument("--feature-dim", type=int, default=100000,
                         help="embedding rows (Criteo full: 33762577)")
@@ -101,8 +105,17 @@ def main():
         # executor overlaps the NEXT batch's PS/cache embedding lookup
         # with the current step (placeholder feeds cannot be peeked)
         n_pool = 32
-        d, s, y = synthetic_criteo(rng, n_pool * args.batch_size,
-                                   args.feature_dim, args.zipf)
+        if args.data_path:
+            # reference-format local criteo (train_*.npy / train.txt /
+            # train.csv — hetu_tpu.data.load_criteo)
+            from hetu_tpu.data import load_criteo
+            d, s, y = load_criteo(args.data_path)
+            args.feature_dim = max(args.feature_dim, int(s.max()) + 1)
+            logger.info("loaded criteo from %s: %d rows, %d features",
+                        args.data_path, len(y), args.feature_dim)
+        else:
+            d, s, y = synthetic_criteo(rng, n_pool * args.batch_size,
+                                       args.feature_dim, args.zipf)
         dense = ht.dataloader_op([ht.Dataloader(d, args.batch_size,
                                                 "train")])
         sparse = ht.dataloader_op([ht.Dataloader(s, args.batch_size,

@@ -31,7 +31,25 @@ def main():
     parser.add_argument("--num-steps", type=int, default=100)
     parser.add_argument("--learning-rate", type=float, default=0.01)
     parser.add_argument("--negative-ratio", type=int, default=4)
+    parser.add_argument("--data-path", default=None,
+                        help="dir with reference-format movielens "
+                             "ratings.csv / ratings.dat; synthetic "
+                             "interactions when unset")
     args = parser.parse_args()
+
+    rng = np.random.RandomState(0)
+    bs = args.batch_size
+    data = None
+    if args.data_path:
+        # reference-format movielens (ratings.csv / ratings.dat —
+        # hetu_tpu.data.load_movielens)
+        from hetu_tpu.data import load_movielens
+        us, its, labs, nu, ni = load_movielens(
+            args.data_path, num_negatives=args.negative_ratio)
+        args.num_users, args.num_items = nu, ni
+        data = (us, its, labs.reshape(-1, 1))
+        logger.info("loaded movielens from %s: %d triples, %d users, "
+                    "%d items", args.data_path, len(us), nu, ni)
 
     user = ht.placeholder_op("user_input")
     item = ht.placeholder_op("item_input")
@@ -40,15 +58,17 @@ def main():
         user, item, y_, num_users=args.num_users, num_items=args.num_items,
         lr=args.learning_rate)
     executor = ht.Executor({"train": [loss, pred, train_op]})
-
-    rng = np.random.RandomState(0)
-    bs = args.batch_size
     t0 = time.time()
     for step in range(args.num_steps):
-        users = rng.randint(0, args.num_users, (bs,)).astype(np.int32)
-        items = rng.randint(0, args.num_items, (bs,)).astype(np.int32)
-        labels = (rng.rand(bs, 1) < 1.0 / (1 + args.negative_ratio))\
-            .astype(np.float32)
+        if data is not None:
+            sel = rng.randint(0, len(data[0]), bs)
+            users, items, labels = (data[0][sel], data[1][sel],
+                                    data[2][sel])
+        else:
+            users = rng.randint(0, args.num_users, (bs,)).astype(np.int32)
+            items = rng.randint(0, args.num_items, (bs,)).astype(np.int32)
+            labels = (rng.rand(bs, 1) < 1.0 / (1 + args.negative_ratio))\
+                .astype(np.float32)
         out = executor.run("train", feed_dict={
             user: users, item: items, y_: labels})
         if step % 20 == 0 or step == args.num_steps - 1:

@@ -1,0 +1,171 @@
+"""Real-dataset parsers (VERDICT r2 item 9): reference-format local files
+must parse into the model contracts (reference
+examples/ctr/models/load_data.py, examples/rec/movielens.py).  Fixtures
+are tiny files written in the exact on-disk formats."""
+
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.data import (
+    load_criteo, load_adult, load_movielens, WDL_ADULT_WIDE_DIM,
+)
+
+
+def _write_criteo_txt(path, n=20, seed=0):
+    """train.txt: tab-separated label, 13 int dense, 26 hex cats, some
+    fields empty (criteo has many missing values)."""
+    rng = np.random.RandomState(seed)
+    with open(os.path.join(path, "train.txt"), "w") as f:
+        for i in range(n):
+            label = rng.randint(0, 2)
+            dense = [("" if rng.rand() < 0.2 else str(rng.randint(0, 100)))
+                     for _ in range(13)]
+            cats = [("" if rng.rand() < 0.1 else
+                     format(rng.randint(0, 8), "08x"))
+                    for _ in range(26)]
+            f.write("\t".join([str(label)] + dense + cats) + "\n")
+
+
+class TestCriteo:
+    def test_raw_txt_parses(self, tmp_path):
+        _write_criteo_txt(str(tmp_path))
+        dense, sparse, labels = load_criteo(str(tmp_path))
+        assert dense.shape == (20, 13) and dense.dtype == np.float32
+        assert sparse.shape == (20, 26) and sparse.dtype == np.int32
+        assert labels.shape == (20, 1)
+        # log(x+1) transform: all finite, nonneg for the >= 0 inputs
+        assert np.isfinite(dense).all()
+        # cumulative per-column offsets: ids strictly grouped by column
+        for j in range(25):
+            assert sparse[:, j].max() < sparse[:, j + 1].min() or \
+                sparse[:, j + 1].size == 0
+
+    def test_preprocessed_npy_roundtrip(self, tmp_path):
+        _write_criteo_txt(str(tmp_path))
+        dense, sparse, labels = load_criteo(str(tmp_path))
+        np.save(tmp_path / "train_dense_feats.npy", dense)
+        np.save(tmp_path / "train_sparse_feats.npy", sparse)
+        np.save(tmp_path / "train_labels.npy", labels)
+        d2, s2, l2 = load_criteo(str(tmp_path))   # .npy takes precedence
+        np.testing.assert_array_equal(d2, dense)
+        np.testing.assert_array_equal(s2, sparse)
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_criteo(str(tmp_path / "nope"))
+
+    def test_trains_wdl_criteo(self, tmp_path):
+        """Parsed fixture drives the actual CTR model one step."""
+        from hetu_tpu.models import ctr as ctr_models
+        _write_criteo_txt(str(tmp_path), n=16)
+        dense, sparse, labels = load_criteo(str(tmp_path))
+        feature_dim = int(sparse.max()) + 1
+        d = ht.placeholder_op("cd")
+        s = ht.placeholder_op("cs")
+        y = ht.placeholder_op("cy")
+        loss, pred, _lab, train = ctr_models.wdl_criteo(
+            d, s, y, feature_dimension=feature_dim, embedding_size=4)
+        ex = ht.Executor({"train": [loss, train]})
+        y2 = np.concatenate([1 - labels, labels], axis=1).astype(np.float32)
+        out = ex.run("train", feed_dict={d: dense, s: sparse, y: y2})
+        assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))
+
+
+class TestAdult:
+    _ROW = ("39, State-gov, 77516, Bachelors, 13, Never-married, "
+            "Adm-clerical, Not-in-family, White, Male, 2174, 0, 40, "
+            "United-States, <=50K")
+    _ROW2 = ("50, Self-emp-not-inc, 83311, Bachelors, 13, "
+             "Married-civ-spouse, Exec-managerial, Husband, White, Male, "
+             "0, 0, 13, United-States, >50K")
+
+    def test_parses_to_wdl_contract(self, tmp_path):
+        with open(tmp_path / "train.csv", "w") as f:
+            for _ in range(4):
+                f.write(self._ROW + "\n")
+                f.write(self._ROW2 + "\n")
+        x_deep, x_wide, y = load_adult(str(tmp_path))
+        assert x_deep.shape == (8, 12)
+        assert x_wide.shape == (8, WDL_ADULT_WIDE_DIM)
+        assert y.shape == (8, 2)
+        # labels: alternating <=50K / >50K
+        np.testing.assert_array_equal(y[:, 1], [0, 1] * 4)
+        # embedding ids stay inside wdl_adult's [50, 8] tables
+        assert x_deep[:, :8].max() < 50
+
+    def test_trains_wdl_adult(self, tmp_path):
+        from hetu_tpu.models import ctr as ctr_models
+        with open(tmp_path / "train.csv", "w") as f:
+            for _ in range(8):
+                f.write(self._ROW + "\n")
+                f.write(self._ROW2 + "\n")
+        x_deep, x_wide, y = load_adult(str(tmp_path))
+        X_deep = [ht.placeholder_op(f"ad{i}") for i in range(12)]
+        X_wide = ht.placeholder_op("aw")
+        y_ = ht.placeholder_op("ay")
+        loss, pred, _lab, train = ctr_models.wdl_adult(X_deep, X_wide, y_)
+        ex = ht.Executor({"train": [loss, train]})
+        feeds = {X_wide: x_wide, y_: y}
+        for i in range(8):
+            feeds[X_deep[i]] = x_deep[:, i].astype(np.int32)
+        for i in range(8, 12):
+            feeds[X_deep[i]] = x_deep[:, i].astype(np.float32)
+        out = ex.run("train", feed_dict=feeds)
+        assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))
+
+
+class TestMovielens:
+    def test_ratings_csv(self, tmp_path):
+        with open(tmp_path / "ratings.csv", "w") as f:
+            f.write("userId,movieId,rating,timestamp\n")
+            # user 1: items 10, 20 (20 is latest -> held out)
+            f.write("1,10,4.0,100\n")
+            f.write("1,20,5.0,200\n")
+            # user 2: items 10, 30
+            f.write("2,30,3.0,50\n")
+            f.write("2,10,4.5,400\n")
+        u, it, lab, nu, ni = load_movielens(str(tmp_path),
+                                            num_negatives=1)
+        assert nu == 2 and ni == 3
+        # 2 training positives (one per user; latest held out), each
+        # with 1 negative
+        assert len(u) == 4
+        assert lab.sum() == 2.0
+        # negatives never collide with the user's seen set
+        seen = {0: {0, 1}, 1: {2, 0}}
+        for uu, ii, ll in zip(u, it, lab):
+            if ll == 0.0:
+                assert ii not in seen[int(uu)]
+
+    def test_ratings_dat_ml1m(self, tmp_path):
+        with open(tmp_path / "ratings.dat", "w") as f:
+            f.write("1::1193::5::978300760\n")
+            f.write("1::661::3::978302109\n")
+            f.write("2::1193::4::978301968\n")
+        u, it, lab, nu, ni = load_movielens(str(tmp_path),
+                                            num_negatives=0)
+        assert nu == 2 and ni == 2
+        assert len(u) == 1          # one non-held-out positive
+
+    def test_trains_ncf(self, tmp_path):
+        from hetu_tpu.models.ncf import neural_mf
+        rng = np.random.RandomState(0)
+        with open(tmp_path / "ratings.csv", "w") as f:
+            f.write("userId,movieId,rating,timestamp\n")
+            for u in range(1, 9):
+                for i in rng.choice(30, 6, replace=False):
+                    f.write(f"{u},{i+1},4.0,{rng.randint(1000)}\n")
+        users, items, labels, nu, ni = load_movielens(str(tmp_path))
+        up = ht.placeholder_op("mu")
+        ip = ht.placeholder_op("mi")
+        yp = ht.placeholder_op("my")
+        loss, pred, train = neural_mf(up, ip, yp, num_users=nu,
+                                      num_items=ni)
+        ex = ht.Executor({"train": [loss, train]})
+        out = ex.run("train", feed_dict={
+            up: users, ip: items,
+            yp: labels.reshape(-1, 1)})
+        assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))
