@@ -380,14 +380,16 @@ def _convert_jaxpr(ctx, jaxpr, in_names):
 # --------------------------------------------------------------- entry
 
 def export(executor, inputs, outputs, path, name="hetu_tpu",
-           feed_shapes=None):
+           feed_shapes=None, opset=OPSET_VERSION):
     """Export the inference subgraph computing `outputs` from `inputs`.
 
     `executor` supplies parameter values (executor.var_values); `inputs`
     are placeholder nodes (or names); `outputs` are graph nodes.  Mirrors
     reference export(executor, inputs, outputs, path) (hetu2onnx.py:27).
     `feed_shapes` maps input name -> shape when the executor has not run
-    yet (otherwise shapes come from node.shape hints).
+    yet (otherwise shapes come from node.shape hints).  ``opset`` stamps
+    the emitted opset_import (the op surface used is stable across
+    13-18, so any of those versions loads elsewhere).
     """
     from ..executor import SubExecutor
     from ..graph.node import TraceContext, Op
@@ -451,7 +453,7 @@ def export(executor, inputs, outputs, path, name="hetu_tpu",
     model = ModelProto(ir_version=_IR_VERSION, producer_name="hetu_tpu",
                        producer_version="0.1", graph=graph,
                        opset_import=[OperatorSetIdProto(
-                           domain="", version=OPSET_VERSION)])
+                           domain="", version=opset)])
     P.save_model(model, path)
     return model
 
