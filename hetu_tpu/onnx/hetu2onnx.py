@@ -31,7 +31,8 @@ _IR_VERSION = 8
 
 
 class _Ctx:
-    def __init__(self):
+    def __init__(self, opset=OPSET_VERSION):
+        self.opset = opset
         self.nodes = []          # NodeProto list
         self.initializers = []   # TensorProto list
         self.names = {}          # jaxpr Var -> onnx name
@@ -139,9 +140,19 @@ def _handle(ctx, eqn, invals):
         eq = ctx.emit("Equal", names)
         return [ctx.emit("Not", [eq])]
     if prim in _REDUCE:
-        axes = ctx.add_const(np.asarray(params["axes"], np.int64))
-        return [ctx.emit(_REDUCE[prim], [names[0], axes],
-                         attrs={"keepdims": 0})]
+        # axes moved from attribute to input at opset 13 for ReduceSum
+        # but only at opset 18 for Max/Min/Prod — emit the form the
+        # stamped opset actually allows
+        as_input = ctx.opset >= 18 or \
+            (prim == "reduce_sum" and ctx.opset >= 13)
+        if as_input:
+            axes = ctx.add_const(np.asarray(params["axes"], np.int64))
+            return [ctx.emit(_REDUCE[prim], [names[0], axes],
+                             attrs={"keepdims": 0})]
+        return [ctx.emit(_REDUCE[prim], [names[0]],
+                         attrs={"keepdims": 0,
+                                "axes": [int(a)
+                                         for a in params["axes"]]})]
     if prim == "rsqrt":
         s = ctx.emit("Sqrt", [names[0]])
         return [ctx.emit("Reciprocal", [s])]
@@ -427,7 +438,7 @@ def export(executor, inputs, outputs, path, name="hetu_tpu",
         executor, nm)) for nm in in_names}
     closed = jax.make_jaxpr(fwd)(feed_struct)
 
-    ctx = _Ctx()
+    ctx = _Ctx(opset=opset)
     # params appear as consts of the closed jaxpr
     const_names = []
     used_names = set()

@@ -268,20 +268,34 @@ class TestCrossFramework:
     @pytest.mark.parametrize("opset", [13, 17, 18])
     def test_export_reimport_across_opsets(self, tmp_path, opset):
         """Our exporter stamps any of opset 13-18 and the file re-imports
-        with identical numerics."""
+        with identical numerics.  softmax forces a reduce_max, whose
+        axes moved from attribute (<=17) to input (18) — assert the
+        emitted NodeProto uses the form the stamped opset allows."""
         from hetu_tpu.onnx import hetu2onnx
         from hetu_tpu.onnx.onnx2hetu import load_onnx, load_model
         x = ht.placeholder_op("x")
         w1 = ht.init.xavier_uniform((6, 16), name=f"xw1_{opset}")
         w2 = ht.init.xavier_uniform((16, 3), name=f"xw2_{opset}")
-        out = ht.matmul_op(ht.gelu_op(ht.matmul_op(x, w1)), w2)
+        out = ht.softmax_op(
+            ht.matmul_op(ht.gelu_op(ht.matmul_op(x, w1)), w2))
         ex = ht.Executor({"fwd": [out]})
         xb = np.random.RandomState(0).randn(4, 6).astype(np.float32)
         want = np.asarray(ex.run("fwd", feed_dict={x: xb})[0])
         p = str(tmp_path / f"m{opset}.onnx")
         hetu2onnx.export(ex, [x], [out], p, feed_shapes={"x": (4, 6)},
                          opset=opset)
-        assert load_model(p).opset_import[0].version == opset
+        model = load_model(p)
+        assert model.opset_import[0].version == opset
+        reduces = [n for n in model.graph.node
+                   if n.op_type in ("ReduceMax", "ReduceMin",
+                                    "ReduceProd")]
+        assert reduces, "softmax should have emitted a ReduceMax"
+        for n in reduces:
+            has_axes_attr = any(a.name == "axes" for a in n.attribute)
+            if opset >= 18:
+                assert len(n.input) == 2 and not has_axes_attr
+            else:
+                assert len(n.input) == 1 and has_axes_attr
         outs2, ph2, w2_ = load_onnx(p)
         ex2 = ht.Executor({"fwd": outs2})
         ex2.load_dict(w2_)
