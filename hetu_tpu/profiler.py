@@ -97,7 +97,8 @@ class HetuProfiler:
         out = {k: int(getattr(m, k)) for k in (
             "argument_size_in_bytes", "output_size_in_bytes",
             "temp_size_in_bytes", "generated_code_size_in_bytes",
-            "alias_size_in_bytes") if hasattr(m, k)}
+            "alias_size_in_bytes", "peak_memory_in_bytes")
+            if hasattr(m, k)}
         if out:
             # donation aliases params/opt state into outputs; only the
             # NON-aliased output bytes (losses, metrics, PS side grads)
