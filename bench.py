@@ -250,10 +250,31 @@ def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
 
 
 def bench_bert_base(platform, reduced):
-    """BERT-base TRUE: 12 layers, seq 512 (BASELINE config 2 for real)."""
-    return _bench_lm(platform, reduced, layers_n=12, seq=512,
-                     per_chip_batch=int(os.environ.get(
-                         "HETU_BENCH_BERT_BATCH", "32")), iters=10)
+    """BERT-base TRUE: 12 layers, seq 512 (BASELINE config 2 for real).
+
+    Auto-tunes the per-chip batch over {32, 48, 64} with short probes
+    (batch is the main MFU lever at this depth; OOM candidates are
+    skipped), then measures the winner properly.  Override with
+    HETU_BENCH_BERT_BATCH to pin a single batch."""
+    fixed = os.environ.get("HETU_BENCH_BERT_BATCH")
+    if fixed is not None or reduced:
+        return _bench_lm(platform, reduced, layers_n=12, seq=512,
+                         per_chip_batch=int(fixed or 32), iters=10)
+    probes = {}
+    for b in (32, 48, 64):
+        try:
+            r = _bench_lm(platform, reduced, layers_n=12, seq=512,
+                          per_chip_batch=b, iters=3)
+            probes[b] = r["value"]
+        except Exception as e:
+            probes[b] = f"{type(e).__name__}"[:60]
+    numeric = {b: v for b, v in probes.items()
+               if isinstance(v, (int, float))}
+    best = max(numeric, key=numeric.get) if numeric else 32
+    out = _bench_lm(platform, reduced, layers_n=12, seq=512,
+                    per_chip_batch=best, iters=10)
+    out["batch_probe_samples_per_sec"] = probes
+    return out
 
 
 def bench_bert4l(platform, reduced):
