@@ -180,7 +180,12 @@ def _build_lm(batch, seq, hidden, heads, layers_n, vocab, use_flash, mesh,
         wo = ht.layers.Linear(hidden * 4, hidden, name=f"l{i}_ffn_wo")
         h = ht.layers.LayerNorm(hidden, name=f"l{i}_ln2")(
             h + wo(ht.gelu_op(wi(h))))
-    logits = ht.layers.Linear(hidden, vocab, name="lm_head")(h)
+    # LM head TIED to the token embedding, as the reference BERT ties its
+    # decoder (examples/nlp/bert/hetu_bert.py:421) — and as honest MFU
+    # accounting requires: an untied gather-only table would otherwise
+    # inflate the 6*P*T numerator with params that never hit the MXU.
+    head_bias = ht.init.zeros((vocab,), name="lm_head_bias")
+    logits = ht.linear_op(h, emb.embedding_table, head_bias, trans_B=True)
     loss = ht.reduce_mean_op(
         ht.softmaxcrossentropy_sparse_op(
             logits, ht.array_reshape_op(labels, [batch * seq])), axes=0)
@@ -224,10 +229,14 @@ def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
 
     # Analytic FLOPs (XLA cost_analysis would require re-lowering and
     # RE-COMPILING the whole step just to read a number — minutes on TPU).
-    # 6*P*T covers the parameter matmuls fwd+bwd; the attention
-    # score/context matmuls add 12*B*S^2*H per layer.
-    n_params = sum(int(np.prod(v.shape)) for v in ex.var_values.values())
-    flops = 6.0 * n_params * (batch * seq) \
+    # Honest MFU accounting: count ONLY matmul-participating weights —
+    # 12*H^2 per layer (4 attention projections + 8 FFN) plus the H*V
+    # head matmul (whose weight is the tied embedding table, counted
+    # once).  Embedding gathers, position adds, LayerNorms, biases and
+    # the softmax-xent are real work the numerator deliberately ignores.
+    # The attention score/context matmuls add 12*B*S^2*H per layer.
+    matmul_params = 12.0 * hidden * hidden * layers_n + hidden * vocab
+    flops = 6.0 * matmul_params * (batch * seq) \
         + layers_n * 12.0 * batch * seq * seq * hidden
     kind, tflops_chip, mfu = _mfu(flops, dt, n_chips, platform)
     out = {

@@ -21,6 +21,24 @@ import jax
 import jax.numpy as jnp
 
 
+def materialize_barrier(out):
+    """Force device completion by fetching one scalar of ``out`` to the
+    host.  ``jax.block_until_ready`` is NOT a reliable barrier on every
+    backend we run on — through the axon TPU tunnel it returns before
+    execution finishes (measured in round 3: a repeat-call matmul probe
+    reported 167x the chip's physical peak) — while a host fetch of a
+    result element cannot return early.  EVERY array leaf is fetched
+    (one scalar each): outputs may come from SEPARATE dispatches (e.g. a
+    PS-mode step's phases), and awaiting only one would let the others
+    float past the timer."""
+    val = None
+    for leaf in jax.tree_util.tree_leaves(out):
+        if leaf is None or not hasattr(leaf, "dtype") or leaf.size == 0:
+            continue
+        val = np.asarray(jnp.ravel(leaf)[0])
+    return val
+
+
 class HetuProfiler:
     def __init__(self, executor=None, feed_shapes=None, log_file=None):
         self.executor = executor
@@ -34,11 +52,11 @@ class HetuProfiler:
         sub = self.executor.subexecutor[name]
         for _ in range(warmup):
             res = sub.run(feed_dict)
-        jax.block_until_ready([r for r in res if r is not None])
+        materialize_barrier(res)
         t0 = time.perf_counter()
         for _ in range(iters):
             res = sub.run(feed_dict)
-        jax.block_until_ready([r for r in res if r is not None])
+        materialize_barrier(res)
         dt = (time.perf_counter() - t0) / iters
         self.records.append({"name": name, "step_time_s": dt})
         if self.log_file:
@@ -148,11 +166,14 @@ class NCCLProfiler:
             return shard_map(lambda v: jax.lax.psum(v, axis), mesh=self.mesh,
                              in_specs=P(axis), out_specs=P(axis))(x)
 
-        f(x).block_until_ready()
+        materialize_barrier(f(x))
         t0 = time.perf_counter()
-        for _ in range(iters):
-            r = f(x)
-        r.block_until_ready()
+        for i in range(iters):
+            # distinct input + per-call fetch: successive f(x) calls are
+            # independent dispatches, and identical ones can be memoized
+            # (see materialize_barrier's docstring for the tunnel model)
+            r = f(x.at[0].set(i + 1))
+            materialize_barrier(r)
         dt = (time.perf_counter() - t0) / iters
         bytes_moved = 2 * (n - 1) / n * x.nbytes
         return {"axis": axis, "time_s": dt,
