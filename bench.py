@@ -38,27 +38,16 @@ import time
 
 import numpy as np
 
-# bf16 peak TFLOP/s per chip by device kind substring (public specs)
-_PEAK_TFLOPS = [
-    ("v6", 918.0),          # Trillium / v6e
-    ("v5p", 459.0),
-    ("v5", 197.0),          # v5e / "TPU v5 lite"
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _TPU_LAST_FILE = os.path.join(_HERE, "BENCH_TPU_LAST.json")
 _MATRIX_FILE = os.path.join(_HERE, "BENCH_MATRIX.json")
 
 
 def _peak_tflops(device_kind: str):
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_TFLOPS:
-        if sub in kind:
-            return peak
-    return None
+    """bf16 spec peak for the MFU denominator — single source of truth
+    lives next to the calibration's physics ceiling."""
+    from hetu_tpu.planner.chip_calibration import spec_peak_tflops
+    return spec_peak_tflops(device_kind)
 
 
 _PROBE_SRC = """
@@ -184,11 +173,20 @@ def _build_lm(batch, seq, hidden, heads, layers_n, vocab, use_flash, mesh,
     # decoder (examples/nlp/bert/hetu_bert.py:421) — and as honest MFU
     # accounting requires: an untied gather-only table would otherwise
     # inflate the 6*P*T numerator with params that never hit the MXU.
+    # The head matmul + xent run CHUNKED (tied_lm_head_xent_op) so the
+    # [B*S, vocab] logits chain never hits HBM in full; set
+    # HETU_BENCH_UNFUSED_HEAD=1 to A/B the materialized path.
     head_bias = ht.init.zeros((vocab,), name="lm_head_bias")
-    logits = ht.linear_op(h, emb.embedding_table, head_bias, trans_B=True)
-    loss = ht.reduce_mean_op(
-        ht.softmaxcrossentropy_sparse_op(
-            logits, ht.array_reshape_op(labels, [batch * seq])), axes=0)
+    flat_labels = ht.array_reshape_op(labels, [batch * seq])
+    if os.environ.get("HETU_BENCH_UNFUSED_HEAD"):
+        logits = ht.linear_op(h, emb.embedding_table, head_bias,
+                              trans_B=True)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_sparse_op(logits, flat_labels), axes=0)
+    else:
+        loss = ht.reduce_mean_op(
+            ht.tied_lm_head_xent_op(h, emb.embedding_table, head_bias,
+                                    flat_labels), axes=0)
     train = ht.optim.AdamOptimizer(learning_rate=1e-4).minimize(loss)
     # bf16 compute / fp32 masters: the MXU path
     ex = ht.Executor({"train": [loss, train]}, mixed_precision="bf16",

@@ -47,6 +47,7 @@ from .ops_loss import (
     softmaxcrossentropy_op, softmaxcrossentropy_sparse_op, crossentropy_op,
     crossentropy_sparse_op, binarycrossentropy_op,
     binarycrossentropywithlogits_op, nll_loss_op, mseloss_op,
+    tied_lm_head_xent_op,
 )
 from .ops_embed import (
     EmbeddingLookupOp, embedding_lookup_op, IndexedSlicesOp,

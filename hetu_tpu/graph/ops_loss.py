@@ -99,3 +99,115 @@ def nll_loss_op(log_probs, labels, ctx=None):
 def mseloss_op(preds, labels, ctx=None):
     return _simple("MSELoss", lambda p, y: jnp.mean((p - y) ** 2), preds, labels,
                    ctx=ctx)
+
+
+# --------------------------------------------------------------------- #
+# fused LM-head + softmax-xent (chunked over rows)
+# --------------------------------------------------------------------- #
+
+def _xent_chunk_shapes(N, n_chunks):
+    C = -(-N // n_chunks)
+    return C, C * n_chunks - N
+
+
+def _chunked_xent_fwd(h, W, b, y, ignored_index, n_chunks):
+    """Per-row loss of ``softmax_xent(h @ W.T + b, y)`` without ever
+    materializing the full [N, V] logits: a scan over row chunks keeps
+    only one [C, V] block live (fp32, for a numerically better
+    logsumexp than the unfused bf16 path)."""
+    N, H = h.shape
+    C, pad = _xent_chunk_shapes(N, n_chunks)
+    y = y.astype(jnp.int32)
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        y = jnp.pad(y, (0, pad), constant_values=ignored_index)
+    hs = h.reshape(n_chunks, C, H)
+    ys = y.reshape(n_chunks, C)
+
+    def body(_, hy):
+        hc, yc = hy
+        logits = jnp.matmul(hc, W.T,
+                            preferred_element_type=jnp.float32)
+        logits = logits + b.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        safe = jnp.where(yc == ignored_index, 0, yc)
+        ll = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        return None, jnp.where(yc == ignored_index, 0.0, lse - ll)
+
+    _, losses = jax.lax.scan(body, None, (hs, ys))
+    return losses.reshape(n_chunks * C)[:N]
+
+
+def _chunked_xent_bwd(gr, h, W, b, y, ignored_index, n_chunks):
+    """(dh, dW, db) for _chunked_xent_fwd, recomputing each logits chunk
+    instead of reading a stored [N, V] gradient tensor.  dW/db
+    accumulate in fp32 scan carries."""
+    N, H = h.shape
+    V = W.shape[0]
+    C, pad = _xent_chunk_shapes(N, n_chunks)
+    y = y.astype(jnp.int32)
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        y = jnp.pad(y, (0, pad), constant_values=ignored_index)
+        gr = jnp.pad(gr, (0, pad))
+    hs = h.reshape(n_chunks, C, H)
+    ys = y.reshape(n_chunks, C)
+    grs = gr.reshape(n_chunks, C)
+
+    def body(carry, hyg):
+        dW, db = carry
+        hc, yc, gc = hyg
+        logits = jnp.matmul(hc, W.T,
+                            preferred_element_type=jnp.float32)
+        logits = logits + b.astype(jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        safe = jnp.where(yc == ignored_index, 0, yc)
+        onehot = jax.nn.one_hot(safe, V, dtype=p.dtype)
+        live = (yc != ignored_index).astype(p.dtype) * gc.astype(p.dtype)
+        dlog = (p - onehot) * live[:, None]
+        dlog_mm = dlog.astype(W.dtype)      # MXU path for both matmuls
+        dh_c = jnp.matmul(dlog_mm, W,
+                          preferred_element_type=jnp.float32)
+        dW = dW + jnp.matmul(dlog_mm.T, hc,
+                             preferred_element_type=jnp.float32)
+        db = db + dlog.sum(axis=0)
+        return (dW, db), dh_c.astype(h.dtype)
+
+    (dW, db), dhs = jax.lax.scan(
+        body, (jnp.zeros((V, H), jnp.float32),
+               jnp.zeros((V,), jnp.float32)), (hs, ys, grs))
+    dh = dhs.reshape(n_chunks * C, H)[:N]
+    return dh, dW.astype(W.dtype), db.astype(b.dtype)
+
+
+def tied_lm_head_xent_op(h, table, bias, labels, ignored_index=-1,
+                         n_chunks=16, ctx=None):
+    """Fused LM head + sparse softmax cross-entropy, chunked over rows.
+
+    Equivalent to ``softmaxcrossentropy_sparse_op(linear_op(h, table,
+    bias, trans_B=True), labels)`` but the [N, V] logits (and their
+    gradient) never hit HBM in full — at BERT scale that tensor chain is
+    gigabytes per step, pure memory-bandwidth cost the reference pays
+    with a dedicated CUDA kernel pair instead
+    (src/ops/SoftmaxCrossEntropySparse.cu).  The three gradient nodes
+    share one recompute scan (XLA CSE merges their identical bodies, the
+    same mechanism VJPOp relies on — ops_misc.py:92).
+    """
+    def f(hh, W, b, yy):
+        return _chunked_xent_fwd(hh, W, b, yy, ignored_index, n_chunks)
+
+    def grad_rule(n, g):
+        hh, W, b, yy = n.inputs
+
+        def mk(idx, name):
+            return _simple(
+                name,
+                lambda gv, hv, Wv, bv, yv:
+                _chunked_xent_bwd(gv, hv, Wv, bv, yv,
+                                  ignored_index, n_chunks)[idx],
+                g, hh, W, b, yy)
+        return [mk(0, "TiedXentGradH"), mk(1, "TiedXentGradW"),
+                mk(2, "TiedXentGradB"), None]
+
+    return _simple("TiedXentChunked", f, h, table, bias, labels,
+                   grad_rule=grad_rule, ctx=ctx)

@@ -263,3 +263,43 @@ def test_embedding_lookup():
     ex = ht.Executor({"test": [out]})
     (res,) = ex.run("test", feed_dict={x: ids}, convert_to_numpy_ret_vals=True)
     np.testing.assert_allclose(res, table_np[ids])
+
+
+def test_tied_lm_head_xent_chunked_equivalence():
+    """tied_lm_head_xent_op == linear_op(trans_B) + sparse xent, through
+    training: losses AND trained (table, bias) match the unfused
+    composition, including an ignored row and a pad-needing N."""
+    import hetu_tpu as ht
+
+    rng = np.random.RandomState(0)
+    N, H, V = 48, 16, 37          # N % n_chunks != 0 -> padding path
+    hv = rng.randn(N, H).astype(np.float32)
+    Wv = (rng.randn(V, H) * 0.1).astype(np.float32)
+    bv = (rng.randn(V) * 0.1).astype(np.float32)
+    yv = rng.randint(0, V, N).astype(np.int32)
+    yv[5] = -1                    # ignored row contributes nothing
+
+    def build(fused):
+        h = ht.placeholder_op("h")
+        y = ht.placeholder_op("y")
+        W = ht.Variable("W", value=Wv.copy())
+        b = ht.Variable("b", value=bv.copy())
+        if fused:
+            vec = ht.tied_lm_head_xent_op(h, W, b, y, n_chunks=16)
+        else:
+            vec = ht.softmaxcrossentropy_sparse_op(
+                ht.linear_op(h, W, b, trans_B=True), y)
+        loss = ht.reduce_mean_op(vec, axes=0)
+        train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]})
+        ls = [float(np.asarray(ex.run("train",
+                                      feed_dict={h: hv, y: yv})[0]))
+              for _ in range(5)]
+        return ls, np.asarray(ex.var_values["W"]), \
+            np.asarray(ex.var_values["b"])
+
+    l_ref, W_ref, b_ref = build(False)
+    l_fus, W_fus, b_fus = build(True)
+    np.testing.assert_allclose(l_ref, l_fus, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(W_ref, W_fus, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(b_ref, b_fus, rtol=2e-4, atol=2e-5)
