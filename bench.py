@@ -173,20 +173,22 @@ def _build_lm(batch, seq, hidden, heads, layers_n, vocab, use_flash, mesh,
     # decoder (examples/nlp/bert/hetu_bert.py:421) — and as honest MFU
     # accounting requires: an untied gather-only table would otherwise
     # inflate the 6*P*T numerator with params that never hit the MXU.
-    # The head matmul + xent run CHUNKED (tied_lm_head_xent_op) so the
-    # [B*S, vocab] logits chain never hits HBM in full; set
-    # HETU_BENCH_UNFUSED_HEAD=1 to A/B the materialized path.
+    # Default is the materialized head: the chunked fused head
+    # (tied_lm_head_xent_op) measured 14% SLOWER at BERT-base scale on
+    # the v5e (its fp32 dW scan carry outweighs the saved logits
+    # traffic) — it is a MEMORY tool for vocab/batch scales where the
+    # [B*S, vocab] chain doesn't fit.  HETU_BENCH_FUSED_HEAD=1 A/Bs it.
     head_bias = ht.init.zeros((vocab,), name="lm_head_bias")
     flat_labels = ht.array_reshape_op(labels, [batch * seq])
-    if os.environ.get("HETU_BENCH_UNFUSED_HEAD"):
+    if os.environ.get("HETU_BENCH_FUSED_HEAD"):
+        loss = ht.reduce_mean_op(
+            ht.tied_lm_head_xent_op(h, emb.embedding_table, head_bias,
+                                    flat_labels), axes=0)
+    else:
         logits = ht.linear_op(h, emb.embedding_table, head_bias,
                               trans_B=True)
         loss = ht.reduce_mean_op(
             ht.softmaxcrossentropy_sparse_op(logits, flat_labels), axes=0)
-    else:
-        loss = ht.reduce_mean_op(
-            ht.tied_lm_head_xent_op(h, emb.embedding_table, head_bias,
-                                    flat_labels), axes=0)
     train = ht.optim.AdamOptimizer(learning_rate=1e-4).minimize(loss)
     # bf16 compute / fp32 masters: the MXU path
     ex = ht.Executor({"train": [loss, train]}, mixed_precision="bf16",
@@ -206,7 +208,13 @@ def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
         iters = 3
     batch = per_chip_batch * n_chips
     mesh = make_mesh({"dp": n_chips}) if n_chips > 1 else None
-    use_flash = platform == "tpu" or reduced
+    # flash attention wins on long sequences (the 32k config NEEDS it);
+    # at seq 512 the fused kernel measured ~8% SLOWER than XLA's batched
+    # attention on the v5e (its per-block matmuls contract over only
+    # head_dim=64 while the saved probs traffic is ~1 ms/layer), so the
+    # crossover is taken at 1024.  Reduced (CPU) scale keeps flash on so
+    # the kernel path stays exercised in verification runs.
+    use_flash = (platform == "tpu" and seq >= 1024) or reduced
     flash_err = None
     try:
         ex = _build_lm(batch, seq, hidden, heads, layers_n, vocab,

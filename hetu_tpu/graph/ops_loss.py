@@ -113,8 +113,10 @@ def _xent_chunk_shapes(N, n_chunks):
 def _chunked_xent_fwd(h, W, b, y, ignored_index, n_chunks):
     """Per-row loss of ``softmax_xent(h @ W.T + b, y)`` without ever
     materializing the full [N, V] logits: a scan over row chunks keeps
-    only one [C, V] block live (fp32, for a numerically better
-    logsumexp than the unfused bf16 path)."""
+    only one [C, V] block live.  The block stays in the compute dtype
+    (bf16 under mixed precision, matching the unfused path's numerics);
+    the logsumexp/softmax reductions run in fp32 via casts that fuse
+    into the reductions."""
     N, H = h.shape
     C, pad = _xent_chunk_shapes(N, n_chunks)
     y = y.astype(jnp.int32)
@@ -126,12 +128,17 @@ def _chunked_xent_fwd(h, W, b, y, ignored_index, n_chunks):
 
     def body(_, hy):
         hc, yc = hy
+        # logits stay in the compute dtype (matching the unfused path's
+        # numerics under bf16 mixed precision); the f32 upcast fuses
+        # into the reductions so no f32 [C, V] buffer materializes
         logits = jnp.matmul(hc, W.T,
-                            preferred_element_type=jnp.float32)
-        logits = logits + b.astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                            preferred_element_type=jnp.float32) \
+            .astype(hc.dtype) + b
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1)
         safe = jnp.where(yc == ignored_index, 0, yc)
-        ll = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        ll = jnp.take_along_axis(logits, safe[:, None],
+                                 axis=-1)[:, 0].astype(jnp.float32)
         return None, jnp.where(yc == ignored_index, 0.0, lse - ll)
 
     _, losses = jax.lax.scan(body, None, (hs, ys))
@@ -158,19 +165,22 @@ def _chunked_xent_bwd(gr, h, W, b, y, ignored_index, n_chunks):
         dW, db = carry
         hc, yc, gc = hyg
         logits = jnp.matmul(hc, W.T,
-                            preferred_element_type=jnp.float32)
-        logits = logits + b.astype(jnp.float32)
-        p = jax.nn.softmax(logits, axis=-1)
+                            preferred_element_type=jnp.float32) \
+            .astype(hc.dtype) + b
+        # softmax with f32 reductions but a compute-dtype [C, V] buffer
+        # (the f32 casts fuse into the reductions/matmul epilogues)
+        m = jnp.max(logits.astype(jnp.float32), axis=-1, keepdims=True)
+        e = jnp.exp(logits.astype(jnp.float32) - m)
+        p = e / e.sum(axis=-1, keepdims=True)
         safe = jnp.where(yc == ignored_index, 0, yc)
         onehot = jax.nn.one_hot(safe, V, dtype=p.dtype)
         live = (yc != ignored_index).astype(p.dtype) * gc.astype(p.dtype)
-        dlog = (p - onehot) * live[:, None]
-        dlog_mm = dlog.astype(W.dtype)      # MXU path for both matmuls
+        dlog_mm = ((p - onehot) * live[:, None]).astype(W.dtype)
         dh_c = jnp.matmul(dlog_mm, W,
                           preferred_element_type=jnp.float32)
         dW = dW + jnp.matmul(dlog_mm.T, hc,
                              preferred_element_type=jnp.float32)
-        db = db + dlog.sum(axis=0)
+        db = db + dlog_mm.astype(jnp.float32).sum(axis=0)
         return (dW, db), dh_c.astype(h.dtype)
 
     (dW, db), dhs = jax.lax.scan(
@@ -181,7 +191,7 @@ def _chunked_xent_bwd(gr, h, W, b, y, ignored_index, n_chunks):
 
 
 def tied_lm_head_xent_op(h, table, bias, labels, ignored_index=-1,
-                         n_chunks=16, ctx=None):
+                         n_chunks=8, ctx=None):
     """Fused LM head + sparse softmax cross-entropy, chunked over rows.
 
     Equivalent to ``softmaxcrossentropy_sparse_op(linear_op(h, table,

@@ -56,6 +56,27 @@ def _fit_block(block, length):
     return b
 
 
+def _causal_kv_index(bq, bk):
+    """kv-block index map with the dead-block DMA skip: above-diagonal
+    (causally dead) kv blocks map to the LAST LIVE block for the q row —
+    pallas skips the DMA when a block's index repeats across grid steps,
+    so the dead half of the grid moves no bytes (compute is separately
+    skipped by pl.when).  At 32k this halves the kv streaming traffic."""
+    def idx(b, i, j):
+        return (b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+    return idx
+
+
+def _causal_q_row(bq, bk, n_q):
+    """q-row mirror of _causal_kv_index for the dkv kernel: below-diagonal
+    (dead) q rows map to the FIRST LIVE row, upper-clamped to n_q - 1 for
+    cross-attention where kv runs longer than q (every row of such a
+    column is dead, but the DMA index must stay in range)."""
+    def row(b, j, i):
+        return jnp.maximum(i, jnp.minimum((j * bk) // bq, n_q - 1))
+    return row
+
+
 def _fwd_kernel(*refs, scale, causal, masked, bq, bk, n_kv):
     if masked:
         (kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -145,13 +166,19 @@ def _flash_fwd(q, k, v, kv_lens, *, causal, block_q, block_k, interpret):
         bq=bq, bk=bk, n_kv=n_kv)
     lens_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)] if masked else []
     lens_arg = (kv_lens,) if masked else ()
+
+    if causal:
+        kv_idx = _causal_kv_index(bq, bk)
+    else:
+        def kv_idx(b, i, j):
+            return (b, j, 0)
     return pl.pallas_call(
         kernel,
         grid=(BH, n_q, n_kv),
         in_specs=lens_spec + [
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), kv_idx),
+            pl.BlockSpec((1, bk, D), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -318,15 +345,29 @@ def _flash_bwd(q, k, v, kv_lens, o, lse, g, *, causal, block_q, block_k,
         delta = delta - g_lse.astype(jnp.float32)
     delta = delta[:, None, :]                         # [BH, 1, S]
 
+    if causal:
+        q_row = _causal_q_row(bq, bk, n_q)
+
+        def q_idx(b, j, i):
+            return (b, q_row(b, j, i), 0)
+
+        def stat_idx(b, j, i):
+            return (b, 0, q_row(b, j, i))
+    else:
+        def q_idx(b, j, i):
+            return (b, i, 0)
+
+        def stat_idx(b, j, i):
+            return (b, 0, i)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           masked=masked, bq=bq, bk=bk, n_q=n_q),
         grid=(BH, n_kv, n_q),
         in_specs=lens_spec + [
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),   # dO
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),   # lse
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),   # delta
+            pl.BlockSpec((1, bq, D), q_idx),                       # q
+            pl.BlockSpec((1, bq, D), q_idx),                       # dO
+            pl.BlockSpec((1, 1, bq), stat_idx),                    # lse
+            pl.BlockSpec((1, 1, bq), stat_idx),                    # delta
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),   # k
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),   # v
         ],
@@ -345,13 +386,18 @@ def _flash_bwd(q, k, v, kv_lens, o, lse, g, *, causal, block_q, block_k,
         interpret=interpret,
     )(*lens_arg, q, g, lse, delta, k, v)
 
+    if causal:
+        kv_idx_dq = _causal_kv_index(bq, bk)
+    else:
+        def kv_idx_dq(b, i, j):
+            return (b, j, 0)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           masked=masked, bq=bq, bk=bk, n_kv=n_kv),
         grid=(BH, n_q, n_kv),
         in_specs=lens_spec + [
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),   # k
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, bk, D), kv_idx_dq),                   # k
+            pl.BlockSpec((1, bk, D), kv_idx_dq),                   # v
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),   # q
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),   # dO
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),   # lse

@@ -19,7 +19,8 @@ from .. import layers
 from ..graph import (
     embedding_lookup_op, array_reshape_op, broadcast_shape_op, dropout_op,
     matmul_op, broadcastto_op, relu_op, gelu_op, tanh_op, slice_op,
-    softmaxcrossentropy_sparse_op, reduce_mean_op, reduce_sum_op,
+    softmaxcrossentropy_sparse_op, tied_lm_head_xent_op,
+    reduce_mean_op, reduce_sum_op,
     addbyconst_op, mul_byconst_op, opposite_op, div_op, bool_op,
     full_like_op,
 )
@@ -205,24 +206,32 @@ class BertForPreTraining:
                                        name=name + "_mlm_bias")
         self.nsp = layers.Linear(c.hidden_size, 2, name=name + "_nsp")
 
+    def _mlm_head(self, seq_out):
+        """(h, logits) for the tied MLM decoder.  The logits node is
+        LAZY — training losses go through the fused chunked head on
+        ``h`` instead, so the [B*S, vocab] logits chain is only ever
+        computed if a caller evaluates it."""
+        h = self.transform_ln(gelu_op(self.transform(seq_out)))
+        logits = matmul_op(h, self.bert.embeddings.word_embeddings,
+                           trans_B=True)
+        logits = logits + broadcastto_op(self.decoder_bias, logits)
+        return h, logits
+
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  masked_lm_labels=None, next_sentence_label=None,
                  kv_lens=None):
         c = self.config
         seq_out, pooled = self.bert(input_ids, token_type_ids,
                                     attention_mask, kv_lens=kv_lens)
-        h = self.transform_ln(gelu_op(self.transform(seq_out)))
-        # tied decoder: logits = h @ word_emb^T + bias
-        logits = matmul_op(h, self.bert.embeddings.word_embeddings,
-                           trans_B=True)
-        logits = logits + broadcastto_op(self.decoder_bias, logits)
+        h, logits = self._mlm_head(seq_out)
         nsp_logits = self.nsp(pooled)
         if masked_lm_labels is None:
             return logits, nsp_logits
         mlm_labels_flat = array_reshape_op(masked_lm_labels,
                                            [c.batch_size * c.seq_len])
-        mlm_loss = softmaxcrossentropy_sparse_op(
-            logits, mlm_labels_flat, ignored_index=-1)
+        mlm_loss = tied_lm_head_xent_op(
+            h, self.bert.embeddings.word_embeddings, self.decoder_bias,
+            mlm_labels_flat, ignored_index=-1)
         nsp_loss = softmaxcrossentropy_sparse_op(nsp_logits,
                                                  next_sentence_label)
         loss = (_masked_mean(mlm_loss, mlm_labels_flat)
@@ -238,15 +247,19 @@ class BertForMaskedLM:
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  masked_lm_labels=None, kv_lens=None):
         c = self.config
-        out = self.pretraining(input_ids, token_type_ids, attention_mask,
-                               kv_lens=kv_lens)
-        logits, _ = out
+        p = self.pretraining
+        seq_out, _pooled = p.bert(input_ids, token_type_ids,
+                                  attention_mask, kv_lens=kv_lens)
+        h, logits = p._mlm_head(seq_out)
         if masked_lm_labels is None:
             return logits
         labels_flat = array_reshape_op(masked_lm_labels,
                                        [c.batch_size * c.seq_len])
-        loss = softmaxcrossentropy_sparse_op(logits, labels_flat,
-                                             ignored_index=-1)
+        # fused chunked head for the loss; the logits node stays lazy
+        # unless a caller evaluates it
+        loss = tied_lm_head_xent_op(
+            h, p.bert.embeddings.word_embeddings, p.decoder_bias,
+            labels_flat, ignored_index=-1)
         return _masked_mean(loss, labels_flat), logits
 
 

@@ -195,3 +195,26 @@ def test_flash_bwd_is_fused_pallas():
                             block_k=16))))(q, k, v)
     n = str(jaxpr).count("pallas_call")
     assert n >= 3, f"expected fwd + dkv + dq pallas kernels, found {n}"
+
+
+def test_flash_grads_rectangular_causal():
+    """Causal cross-attention with Sk > Sq: every q row of the later kv
+    columns is dead, which exercises the dkv kernel's upper-clamped
+    dead-row index map (out-of-range block DMA regression)."""
+    rng = np.random.RandomState(17)
+    q = jnp.asarray(rng.randn(2, 32, 2, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 64, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 64, 2, 16), jnp.float32)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=16, block_k=16) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
