@@ -233,16 +233,19 @@ def stable_rng_ids(sub):
     return ids
 
 
-def gather_feeds(sub, feed_dict):
+def gather_feeds(sub, feed_dict, peek=False):
     """Collect dataloader + fed values into a name-keyed dict, coercing
     dtypes host-side.  Device-resident jax.Arrays pass through untouched
-    (np.asarray on them would force a blocking D2H)."""
+    (np.asarray on them would force a blocking D2H).  ``peek`` reads the
+    dataloaders WITHOUT consuming a batch — analysis paths (profiler
+    lower/compile) must not advance the training data position."""
     if not getattr(sub, "_prefetch_wired", False):
         sub._prefetch_wired = True
         _wire_prefetch(sub)
     feeds = {}
     for dl in sub.dataloader_ops:
-        feeds[dl.name] = dl.get_arr(sub.name)
+        feeds[dl.name] = dl.peek_arr(sub.name) if peek \
+            else dl.get_arr(sub.name)
     for node, value in feed_dict.items():
         name = node.name if isinstance(node, Op) else node
         feeds[name] = value

@@ -7,18 +7,15 @@ src/communication/mpi_nccl_communication.cu:152-243), BalanceAssignment.py
 (auction assignment), SamGroupSum.cu / SamMax.cu / GroupTopKIdx.cu (SAM
 gate), Dispatch.py (model-parallel annotation).
 
-Measured on one v5e chip (N=8192 tokens, D=768, E=8, cap=2048, fwd+bwd):
-the scatter dispatch + gather combine cost 3.5 ms of a 67 ms MoE step —
-5%, dominated by the expert FFNs.  A fused Pallas dispatch kernel (the
-reference's LayoutTransform.cu role) would therefore buy <5% and is
-deliberately NOT implemented; XLA's scatter/gather is kept.
-
-TPU-native: dispatch/combine are scatter/gather compositions with static
-capacity (XLA handles them well; a fused Pallas kernel lives in
-hetu_tpu.kernels for the hot path).  All-to-all is ``jax.lax.all_to_all``
-over the 'ep' mesh axis inside shard_map; hierarchical A2A decomposes over
-('dcn', 'ici') axes — the natural mapping of the reference's
-gather→exchange→scatter staging.
+TPU-native: dispatch/combine default to the GShard-style one-hot-matmul
+formulation (_scatter_rows) — MXU work with no data-dependent writes —
+with the row-scatter form behind HETU_MOE_SCATTER_DISPATCH=1; the MoE
+bench A/Bs both on-chip (an earlier round measured scatter dispatch at
+3.5 ms of a 67 ms step on the v5e; a fused Pallas dispatch kernel
+remains not worth it either way).  Combine stays a gather (fast on
+TPU).  All-to-all is ``jax.lax.all_to_all`` over the 'ep' mesh axis
+inside shard_map; hierarchical A2A decomposes over ('dcn', 'ici') axes —
+the natural mapping of the reference's gather→exchange→scatter staging.
 """
 
 from __future__ import annotations
@@ -32,6 +29,58 @@ from .ops_math import _simple
 
 def _flat_int(x):
     return x.reshape(-1).astype(jnp.int32)
+
+
+def _slot_weights(pos_valid_weight, n_slots, dtype):
+    """[N, n_slots] slot-assignment weight matrix from (pos, valid,
+    weight) triples — the GShard-style dense dispatch mask (the dispatch
+    einsum of GShard, arXiv:2006.16668, and Tutel).  Invalid
+    (capacity-dropped) rows map to class -1 == an all-zero one-hot
+    row."""
+    W = None
+    for pos, valid, w in pos_valid_weight:
+        safe = jnp.where(valid, pos, -1)
+        oh = jax.nn.one_hot(safe, n_slots, dtype=dtype)
+        if w is not None:
+            oh = oh * w.reshape(-1, 1).astype(dtype)
+        W = oh if W is None else W + oh
+    return W
+
+
+# above this many mask elements (N * E * cap) the one-hot formulation's
+# [N, n_slots] operand becomes the dominant memory/FLOP cost and the
+# scatter form wins regardless of its lowering: 2^27 elems = 256 MB bf16
+_ONEHOT_DISPATCH_MAX_ELEMS = 1 << 27
+
+
+def _force_scatter_dispatch():
+    import os
+    return bool(os.environ.get("HETU_MOE_SCATTER_DISPATCH"))
+
+
+def _scatter_rows(terms, n_slots, src, dtype, force_scatter=False):
+    """Rows of ``src`` summed into ``n_slots`` buckets.
+
+    Default: one-hot MXU matmul (sum_i onehot(pos_i, weighted)^T @ src)
+    — row scatter-adds can lower to a serialized scatter on TPU, while
+    this formulation is pure matmul work.  The .at[].add scatter form is
+    used instead when (a) the caller forces it (the op reads
+    ``HETU_MOE_SCATTER_DISPATCH=1`` ONCE at construction — the MoE bench
+    A/Bs both on-chip), or (b) the [N, n_slots] mask would exceed
+    _ONEHOT_DISPATCH_MAX_ELEMS, past which the mask's memory/FLOPs
+    dominate the experts themselves (at top-k capacity, mask elements
+    grow as k*N^2)."""
+    N = src.shape[0]
+    if force_scatter or N * n_slots > _ONEHOT_DISPATCH_MAX_ELEMS:
+        out = jnp.zeros((n_slots, src.shape[-1]), dtype)
+        for pos, valid, w in terms:
+            rows = src if w is None else w.reshape(-1, 1).astype(dtype) * src
+            safe = jnp.where(valid, pos, n_slots)
+            out = out.at[safe].add(rows, mode="drop")
+        return out
+    W = _slot_weights(terms, n_slots, dtype)
+    return jnp.matmul(W.T, src,
+                      preferred_element_type=jnp.float32).astype(dtype)
 
 
 class LayoutTransformOp(Op):
@@ -49,17 +98,17 @@ class LayoutTransformOp(Op):
         self.capacity = int(capacity)
         self.topK = len(indices_s)
         self.total_experts = int(total_experts)
+        self.force_scatter = _force_scatter_dispatch()
 
     def jax_fn(self, x, *idx_loc):
         k, cap = self.topK, self.capacity
-        out = jnp.zeros((self.total_experts * cap, x.shape[-1]), x.dtype)
+        terms = []
         for i in range(k):
             idx = _flat_int(idx_loc[i])
             loc = _flat_int(idx_loc[k + i])
-            pos = idx * cap + loc
-            pos = jnp.where(loc < cap, pos, self.total_experts * cap)
-            out = out.at[pos].add(x, mode="drop")
-        return out
+            terms.append((idx * cap + loc, loc < cap, None))
+        return _scatter_rows(terms, self.total_experts * cap, x, x.dtype,
+                             force_scatter=self.force_scatter)
 
     def gradient(self, output_grad):
         k = self.topK
@@ -161,19 +210,21 @@ class ReverseLayoutTransformGradientDataOp(Op):
         self.capacity = int(capacity)
         self.topK = len(indices_s)
         self.num_experts = int(num_experts)
+        self.force_scatter = _force_scatter_dispatch()
 
     def jax_fn(self, g, *rest):
         k, cap = self.topK, self.capacity
         indices = rest[:k]
         locations = rest[k:2 * k]
         gates = rest[2 * k:]
-        out = jnp.zeros((self.num_experts * cap, g.shape[-1]), g.dtype)
+        terms = []
         for i in range(k):
             idx = _flat_int(indices[i])
             loc = _flat_int(locations[i])
-            pos = jnp.where(loc < cap, idx * cap + loc, self.num_experts * cap)
-            out = out.at[pos].add(gates[i].reshape(-1, 1) * g, mode="drop")
-        return out
+            terms.append((idx * cap + loc, loc < cap,
+                          gates[i].reshape(-1)))
+        return _scatter_rows(terms, self.num_experts * cap, g, g.dtype,
+                             force_scatter=self.force_scatter)
 
     def gradient(self, output_grad):
         raise NotImplementedError

@@ -76,10 +76,16 @@ class HetuProfiler:
             return None
         fn = next(iter(sub._compiled.values()))
         try:
+            from .executor import gather_feeds
+            # the compiled step takes NAME-keyed feeds (node-keyed dicts
+            # don't even sort as a jax pytree); route synthetic feeds
+            # through the same conversion SubExecutor.run uses — with
+            # peek=True so the analysis never consumes a training batch
             compiled = fn.lower(
                 self.executor.var_values, self.executor.opt_states,
                 self.executor.step, self.executor.rng,
-                self._synth_feeds()).compile()
+                gather_feeds(sub, self._synth_feeds(),
+                             peek=True)).compile()
         except Exception:
             return None
         if not hasattr(self, "_analysis_cache"):

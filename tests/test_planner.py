@@ -347,3 +347,36 @@ class TestChipCalibration:
         plan = PlannerSearch(layers, global_batch_size=32,
                              cluster=spec).search()
         assert plan is not None
+
+    def test_search_consumes_checked_in_tpu_artifact(self):
+        """The REAL CALIBRATION_TPU.json measured on the v5e drives a
+        search end-to-end: the artifact's curve must be physical (no
+        reading above the device's spec-sheet peak) and its ClusterSpec
+        must produce a plan."""
+        import os
+        from hetu_tpu.planner.chip_calibration import (
+            CALIBRATION_FILE, load_calibration, SPEC_PEAKS)
+        from hetu_tpu.planner.search import PlannerSearch
+        from hetu_tpu.planner.cost_model import LayerSpec
+        if not os.path.exists(CALIBRATION_FILE):
+            import pytest
+            pytest.skip("no checked-in calibration artifact")
+        import json
+        with open(CALIBRATION_FILE) as f:
+            art = json.load(f)
+        if art.get("platform") == "cpu":
+            import pytest
+            pytest.skip("artifact is a CPU small-mode placeholder")
+        kind = art["device_kind"].lower()
+        spec_peak = next((p for sub, p in SPEC_PEAKS if sub in kind),
+                         None)
+        if spec_peak is not None:
+            for d, v in art["matmul_tflops_bf16"].items():
+                assert v is None or v <= spec_peak, (d, v)
+        spec = load_calibration(n_devices=8)
+        assert spec.flops_per_sec > 1e12   # a real chip, not a CPU
+        layers = [LayerSpec.transformer_encoder(768, 512)
+                  for _ in range(12)]
+        plan = PlannerSearch(layers, global_batch_size=256,
+                             cluster=spec).search()
+        assert plan is not None

@@ -52,3 +52,33 @@ class TestProfiler:
         _, _, ex = _build()
         prof = HetuProfiler(ex, feed_shapes={})
         assert prof.memory_analysis("train") is None
+
+
+def test_cost_analysis_with_dataloader_and_node_keys():
+    """cost_analysis must work when the graph feeds from Dataloader ops
+    and feed_shapes is keyed by placeholder NODES (regression: the
+    synthetic feeds went to the compiled step un-converted, which can't
+    even sort as a jax pytree, so every analysis silently returned
+    None)."""
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu.profiler import HetuProfiler
+
+    B, IN, OUT = 8, 6, 3
+    rng = np.random.RandomState(0)
+    xs = rng.randn(B * 4, IN).astype(np.float32)
+    ys = np.eye(OUT, dtype=np.float32)[rng.randint(0, OUT, B * 4)]
+    x = ht.dataloader_op([ht.Dataloader(xs, B, "train")])
+    y = ht.dataloader_op([ht.Dataloader(ys, B, "train")])
+    w = ht.init.xavier_uniform((IN, OUT), name="cap_w")
+    loss = ht.reduce_mean_op(
+        ht.softmaxcrossentropy_op(ht.matmul_op(x, w), y), axes=0)
+    train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    ex = ht.Executor({"train": [loss, train]})
+    ex.run("train")
+
+    prof = HetuProfiler(ex, feed_shapes={})
+    cost = prof.cost_analysis("train")
+    assert cost is not None and float(cost["flops"]) > 0
+    mem = prof.memory_analysis("train")
+    assert mem is not None and mem["argument_size_in_bytes"] > 0
