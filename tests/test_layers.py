@@ -116,3 +116,34 @@ def test_dataloader_pairing_and_partial_batch():
         seen += bx.shape[0]
     # partial batch of 2 was served (10 = 4+4+2)
     assert seen == 4 + 4 + 2 + 4 + 4 + 2
+
+
+def test_fused_qkv_matches_unfused():
+    """fused_qkv=True (one [H,3H] matmul over concat'd weights) must
+    match the three-matmul form through training: identical parameter
+    names/init, near-identical trajectories (same math, XLA may
+    reassociate)."""
+    import numpy as np
+    import hetu_tpu as ht
+
+    rng = np.random.RandomState(3)
+    B, S, H, NH = 2, 8, 16, 2
+    xv = rng.randn(B * S, H).astype(np.float32)
+    yv = rng.randint(0, H, (B * S,)).astype(np.int32)
+
+    def build(fused):
+        x = ht.placeholder_op("x")
+        y = ht.placeholder_op("y")
+        attn = ht.layers.MultiHeadAttention(H, NH, S, B, name="fqa",
+                                            fused_qkv=fused)
+        out = attn(x)
+        loss = ht.reduce_mean_op(
+            ht.softmaxcrossentropy_sparse_op(out, y), axes=0)
+        train = ht.optim.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]})
+        return [float(np.asarray(ex.run("train",
+                                        feed_dict={x: xv, y: yv})[0]))
+                for _ in range(4)]
+
+    np.testing.assert_allclose(build(False), build(True),
+                               rtol=1e-5, atol=1e-6)

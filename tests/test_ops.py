@@ -303,3 +303,42 @@ def test_tied_lm_head_xent_chunked_equivalence():
     np.testing.assert_allclose(l_ref, l_fus, rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(W_ref, W_fus, rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(b_ref, b_fus, rtol=2e-4, atol=2e-5)
+
+
+def test_tied_lm_head_xent_chunked_bf16_parity():
+    """Under bf16 mixed precision the fused head must track the unfused
+    composition closely (both keep bf16 [*, V] blocks; the fused path's
+    reductions run in fp32, so it may only be MORE accurate)."""
+    import hetu_tpu as ht
+
+    rng = np.random.RandomState(1)
+    N, H, V = 64, 16, 29
+    hv = rng.randn(N, H).astype(np.float32)
+    Wv = (rng.randn(V, H) * 0.2).astype(np.float32)
+    bv = (rng.randn(V) * 0.1).astype(np.float32)
+    yv = rng.randint(0, V, N).astype(np.int32)
+
+    def build(fused):
+        h = ht.placeholder_op("h")
+        y = ht.placeholder_op("y")
+        W = ht.Variable("W", value=Wv.copy())
+        b = ht.Variable("b", value=bv.copy())
+        if fused:
+            vec = ht.tied_lm_head_xent_op(h, W, b, y, n_chunks=4)
+        else:
+            vec = ht.softmaxcrossentropy_sparse_op(
+                ht.linear_op(h, W, b, trans_B=True), y)
+        loss = ht.reduce_mean_op(vec, axes=0)
+        train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]},
+                         mixed_precision="bf16")
+        ls = [float(np.asarray(ex.run("train",
+                                      feed_dict={h: hv, y: yv})[0]))
+              for _ in range(3)]
+        return ls, np.asarray(ex.var_values["W"])
+
+    l_ref, W_ref = build(False)
+    l_fus, W_fus = build(True)
+    # bf16 tolerance: one bf16 ulp on O(1) losses is ~8e-3
+    np.testing.assert_allclose(l_ref, l_fus, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(W_ref, W_fus, rtol=5e-2, atol=5e-3)
