@@ -264,27 +264,63 @@ def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
     return out
 
 
+_PROBE_LM_SRC = """
+import json
+import bench
+r = bench._bench_lm({platform!r}, False, layers_n=12, seq=512,
+                    per_chip_batch={b}, iters=3)
+print("PROBE_RESULT " + json.dumps(r["value"]))
+"""
+
+
 def bench_bert_base(platform, reduced):
     """BERT-base TRUE: 12 layers, seq 512 (BASELINE config 2 for real).
 
-    Auto-tunes the per-chip batch over {32, 48, 64} with short probes
-    (batch is the main MFU lever at this depth; OOM candidates are
-    skipped), then measures the winner properly.  Override with
-    HETU_BENCH_BERT_BATCH to pin a single batch."""
+    Auto-tunes the per-chip batch over {32, 48, 64}, each probe in a
+    SUBPROCESS with a hard timeout: a large-batch compile can hang
+    indefinitely when the axon tunnel degrades (observed: a batch-64
+    probe blocked >50 min with zero CPU), and an in-process hang would
+    cost the whole matrix.  A timed-out or failed probe is skipped.
+    The measured round-3 sweep had batch 32 fastest (258.5 vs ~252
+    samples/s at 48/64), so probes run 32 first and the winner falls
+    back to 32.  Override with HETU_BENCH_BERT_BATCH to pin a batch."""
     fixed = os.environ.get("HETU_BENCH_BERT_BATCH")
     if fixed is not None or reduced:
         return _bench_lm(platform, reduced, layers_n=12, seq=512,
                          per_chip_batch=int(fixed or 32), iters=10)
+    import subprocess
+    import sys
     probes = {}
+    deadline = time.monotonic() + 1500.0   # total probe budget
     for b in (32, 48, 64):
+        left = deadline - time.monotonic()
+        if left < 60.0:
+            probes[b] = "skipped (probe budget spent)"
+            continue
         try:
-            r = _bench_lm(platform, reduced, layers_n=12, seq=512,
-                          per_chip_batch=b, iters=3)
-            probes[b] = r["value"]
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 _PROBE_LM_SRC.format(platform=platform, b=b)],
+                capture_output=True, text=True,
+                timeout=min(900.0, left), cwd=_HERE)
+            val = next((ln.split(" ", 1)[1]
+                        for ln in r.stdout.splitlines()
+                        if ln.startswith("PROBE_RESULT ")), None)
+            probes[b] = float(json.loads(val)) if val else \
+                (r.stderr.strip().splitlines() or ["failed"])[-1][:60]
+        except subprocess.TimeoutExpired:
+            probes[b] = "probe timed out (tunnel degraded?)"
         except Exception as e:
             probes[b] = f"{type(e).__name__}"[:60]
     numeric = {b: v for b, v in probes.items()
                if isinstance(v, (int, float))}
+    if platform == "tpu" and not numeric:
+        # every probe failed — likely the tunnel is wedged (or another
+        # config initialized the TPU in-process first; main() orders
+        # bert_base first to prevent that).  Raising here lets the
+        # matrix record an error instead of hanging on an unprotected
+        # in-process measurement.
+        raise RuntimeError(f"all batch probes failed: {probes}")
     best = max(numeric, key=numeric.get) if numeric else 32
     out = _bench_lm(platform, reduced, layers_n=12, seq=512,
                     per_chip_batch=best, iters=10)
@@ -586,6 +622,11 @@ def main():
 
     sel = os.environ.get("HETU_BENCH_CONFIGS")
     names = [n.strip() for n in sel.split(",")] if sel else list(_CONFIGS)
+    # bert_base FIRST: its batch probes run in subprocesses, which only
+    # work before any in-process config initializes (and exclusively
+    # holds) the TPU backend
+    if "bert_base" in names:
+        names = ["bert_base"] + [n for n in names if n != "bert_base"]
 
     # MERGE into the existing matrix: a HETU_BENCH_CONFIGS subset run (or
     # a reduced CPU run) must not wipe other configs' recorded numbers —

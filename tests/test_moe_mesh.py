@@ -216,3 +216,27 @@ class TestShardMapA2A:
         for a, b in batches(3):
             out_v = ex.run("train", feed_dict={x: a, y: b})
             assert np.isfinite(float(np.asarray(out_v[0])))
+
+
+def test_dispatch_formulations_agree():
+    """The one-hot-matmul and row-scatter dispatch forms must produce
+    identical expert buffers and identical combine-data gradients."""
+    from hetu_tpu.graph.ops_moe import _scatter_rows
+
+    rng = np.random.RandomState(5)
+    N, D, slots = 64, 16, 24
+    src = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    pos = jnp.asarray(rng.randint(0, slots + 4, N).astype(np.int32))
+    valid = pos < slots            # some dropped
+    gates = jnp.asarray(rng.rand(N).astype(np.float32))
+
+    for terms in ([(pos, valid, None)],
+                  [(pos, valid, gates)],
+                  [(pos, valid, None), ((pos + 3) % slots,
+                                        jnp.ones_like(valid), gates)]):
+        a = _scatter_rows(terms, slots, src, jnp.float32,
+                          force_scatter=False)
+        b = _scatter_rows(terms, slots, src, jnp.float32,
+                          force_scatter=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
