@@ -15,7 +15,6 @@ PS RPCs with CUDA compute via the d2h stream + PSEvent
 from __future__ import annotations
 
 import os
-import pickle
 import socket
 import threading
 import time
@@ -23,6 +22,8 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor, Future
 
 import numpy as np
+
+from . import wire
 
 from .server import PSServer, _send_msg, _recv_msg
 
@@ -79,9 +80,8 @@ class _TCPTransport:
     def call(self, method, *args, **kwargs):
         st = self._state()
         st.seq += 1
-        payload = pickle.dumps(
-            ("__req2__", st.client_id, st.seq, method, args, kwargs),
-            protocol=pickle.HIGHEST_PROTOCOL)
+        payload = wire.dumps(
+            ("__req2__", st.client_id, st.seq, method, args, kwargs))
         last_err = None
         for attempt in range(self.retries):
             try:
@@ -91,13 +91,13 @@ class _TCPTransport:
                 raw = _recv_msg(st.sock)
                 if raw is None:
                     raise ConnectionResetError("PS closed the connection")
-                ok, result = pickle.loads(raw)
+                ok, result = wire.loads(raw)
                 if not ok:
                     raise RuntimeError(
                         f"PS server error in {method}: {result}")
                 return result
             except (OSError, ConnectionError, socket.timeout, EOFError,
-                    pickle.UnpicklingError) as e:
+                    wire.WireError) as e:
                 last_err = e
                 if st.sock is not None:
                     try:

@@ -10,17 +10,18 @@ SyncEmbedding/PushEmbedding, SSPInit/SSPSync, PReduceGetPartner).
 
 TPU-native: the server lives host-side on the TPU-VM (embeddings exceed
 HBM; SURVEY.md §2.2 'TPU equivalent').  Two transports: in-process (zero
-copy, default for single-host) and length-prefixed-pickle TCP for
-multi-process / multi-host.  Numpy is the compute engine server-side — the
-hot sparse rows path is vectorized gather/scatter, the same work the
-reference does in C++ loops.
+copy, default for single-host) and length-prefixed TCP carrying the
+TYPED wire codec (ps/wire.py — plain-data envelope only, no pickle on
+network bytes; ps-lite frames typed protobuf + raw buffers the same
+way) for multi-process / multi-host.  Numpy is the compute engine
+server-side — the hot sparse rows path is vectorized gather/scatter,
+the same work the reference does in C++ loops.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-import pickle
 import socket
 import socketserver
 import struct
@@ -28,6 +29,8 @@ import threading
 import time
 
 import numpy as np
+
+from . import wire
 
 
 # ----------------------------------------------------------------- #
@@ -626,7 +629,7 @@ def _recv_exact(sock, n):
         if not r:
             return None
         got += r
-    return buf      # pickle.loads takes the bytearray without a copy
+    return buf      # wire.loads decodes arrays zero-copy from this buffer
 
 
 def _serve_object_tcp(obj, port, block=True):
@@ -652,7 +655,7 @@ def _serve_object_tcp(obj, port, block=True):
                     raw = _recv_msg(self.request)
                     if raw is None:
                         return
-                    msg = pickle.loads(raw)
+                    msg = wire.loads(raw)
                     cid = seq = None
                     if isinstance(msg, tuple) and msg \
                             and msg[0] == "__req2__":
@@ -680,14 +683,13 @@ def _serve_object_tcp(obj, port, block=True):
                     else:
                         method, args, kwargs = msg
                     try:
+                        if method.startswith("_"):
+                            raise AttributeError(
+                                f"non-public method {method!r}")
                         result = getattr(obj, method)(*args, **kwargs)
-                        payload = pickle.dumps(
-                            (True, result),
-                            protocol=pickle.HIGHEST_PROTOCOL)
+                        payload = wire.dumps((True, result))
                     except Exception as e:  # noqa: BLE001
-                        payload = pickle.dumps(
-                            (False, repr(e)),
-                            protocol=pickle.HIGHEST_PROTOCOL)
+                        payload = wire.dumps((False, repr(e)))
                     if cid is not None:
                         with replay_cv:
                             replay[cid] = (seq, payload)

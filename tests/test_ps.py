@@ -234,3 +234,68 @@ class TestScheduler:
             server.shutdown()
             PSServer._instance = None
             PSClient._instance = None
+
+
+class TestWireCodec:
+    """ps/wire.py: the typed no-pickle envelope (VERDICT r2 weak item —
+    pickle.loads of network bytes)."""
+
+    def test_roundtrip_envelope(self):
+        import numpy as np
+        from hetu_tpu.ps import wire
+
+        cases = [
+            None, True, False, 0, -7, 1 << 40, 3.5, -0.0, "",
+            "uniçode", b"\x00raw", [1, "a", None],
+            (2.5, (b"x", [True])), {"k": 1, "n": {"m": [1.0]}},
+            np.arange(12, dtype=np.int32).reshape(3, 4),
+            np.zeros((0, 5), np.float32),
+            np.asarray(2.5, np.float64),          # 0-d array
+            ("__req2__", "cid", 3, "push", ("k", np.ones(4, np.float32)),
+             {"async_": False}),
+        ]
+        for obj in cases:
+            back = wire.loads(wire.dumps(obj))
+            if isinstance(obj, np.ndarray):
+                np.testing.assert_array_equal(back, obj)
+                assert back.dtype == obj.dtype
+            elif isinstance(obj, tuple):
+                assert isinstance(back, tuple)
+            else:
+                assert back == obj, (obj, back)
+
+    def test_rejects_code_objects(self):
+        import pytest
+        from hetu_tpu.ps import wire
+        with pytest.raises(wire.WireError):
+            wire.dumps(object())
+        with pytest.raises(wire.WireError):
+            wire.dumps(lambda: 1)
+
+    def test_rejects_bad_tags(self):
+        import pytest
+        from hetu_tpu.ps import wire
+        with pytest.raises(Exception):
+            wire.loads(b"Zjunk")
+        with pytest.raises(Exception):
+            wire.loads(wire.dumps([1, 2]) + b"extra")
+
+    def test_noncontiguous_and_fortran_arrays(self):
+        import numpy as np
+        from hetu_tpu.ps import wire
+        a = np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2]
+        np.testing.assert_array_equal(wire.loads(wire.dumps(a)), a)
+        f = np.asfortranarray(np.arange(6, dtype=np.int64).reshape(2, 3))
+        np.testing.assert_array_equal(wire.loads(wire.dumps(f)), f)
+
+    def test_error_contract_is_wireerror(self):
+        import pytest
+        from hetu_tpu.ps import wire
+        # encode: out-of-range int
+        with pytest.raises(wire.WireError):
+            wire.dumps(1 << 70)
+        # decode: truncated frames at various cut points
+        good = wire.dumps(("m", [1.5, "x"], {"a": 2}))
+        for cut in (1, 3, len(good) - 1):
+            with pytest.raises(wire.WireError):
+                wire.loads(good[:cut])
