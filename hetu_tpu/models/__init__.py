@@ -23,6 +23,7 @@ from .bert import (
     BertForSequenceClassification, BertForMaskedLM,
 )
 from .transformer import TransformerConfig, Transformer, transformer_mt
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM
 from .ctr import (
     wdl_adult, wdl_criteo, dcn_criteo, deepfm_criteo, dc_criteo,
 )

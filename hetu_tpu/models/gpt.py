@@ -1,0 +1,161 @@
+"""Decoder-only causal LM (GPT-2 topology) — pre-LN blocks, learned
+positions, tied LM head.
+
+The reference repo is BERT-centric (examples/nlp/bert/hetu_bert.py has
+no decoder-only family); this model widens the zoo along the axis the
+long-context example (examples/nlp/train_long_context.py) exercises
+inline, with the framework's measured-fast pieces composed by default:
+
+* fused QKV projection (layers.MultiHeadAttention fused_qkv),
+* flash attention from seq >= 1024 (the measured v5e crossover; XLA's
+  batched attention below it) unless the caller pins ``use_flash``,
+* fused chunked tied LM head for the training loss
+  (tied_lm_head_xent_op) with the logits node kept lazy.
+"""
+
+from __future__ import annotations
+
+from .. import initializers as init
+from .. import layers
+from ..graph import (
+    embedding_lookup_op, array_reshape_op, broadcast_shape_op,
+    linear_op, gelu_op, dropout_op, tied_lm_head_xent_op,
+)
+from .bert import _masked_mean
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50257, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 ffn_mult=4, max_position_embeddings=1024,
+                 dropout_rate=0.1, batch_size=8, seq_len=1024,
+                 use_flash=None):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.ffn_size = ffn_mult * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.dropout_rate = dropout_rate
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        # None = measured v5e crossover: flash from seq 1024 up — but
+        # only with dropout off, because the fused kernel has no probs
+        # dropout and MultiHeadAttention would silently fall back to the
+        # unfused SxS chain (exactly what flash exists to avoid at long
+        # seq).  Pinning use_flash=True with dropout on is an error, not
+        # a silent fallback.
+        if use_flash is None:
+            self.use_flash = seq_len >= 1024 and dropout_rate == 0.0
+        else:
+            if use_flash and dropout_rate > 0.0:
+                raise ValueError(
+                    "use_flash=True requires dropout_rate=0: the flash "
+                    "kernel has no attention-probs dropout and the "
+                    "layer would silently fall back to unfused "
+                    "attention")
+            self.use_flash = use_flash
+
+    @classmethod
+    def small(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def medium(cls, **kw):
+        kw.setdefault("hidden_size", 1024)
+        kw.setdefault("num_hidden_layers", 24)
+        kw.setdefault("num_attention_heads", 16)
+        return cls(**kw)
+
+
+class GPTBlock:
+    """Pre-LN: x + attn(ln1(x)); x + ffn(ln2(x))."""
+
+    def __init__(self, config: GPTConfig, name="gpt_block"):
+        c = config
+        self.ln1 = layers.LayerNorm(c.hidden_size, name=name + "_ln1")
+        self.ln2 = layers.LayerNorm(c.hidden_size, name=name + "_ln2")
+        self.attn = layers.MultiHeadAttention(
+            c.hidden_size, c.num_attention_heads, c.seq_len,
+            c.batch_size, dropout_rate=c.dropout_rate,
+            use_flash=c.use_flash, causal=True, name=name + "_attn")
+        self.wi = layers.Linear(c.hidden_size, c.ffn_size,
+                                name=name + "_ffn_wi")
+        self.wo = layers.Linear(c.ffn_size, c.hidden_size,
+                                name=name + "_ffn_wo")
+        self.keep_prob = 1.0 - c.dropout_rate
+
+    def __call__(self, h, kv_lens=None):
+        a = self.attn(self.ln1(h), kv_lens=kv_lens)
+        if self.keep_prob < 1.0:
+            a = dropout_op(a, self.keep_prob)
+        h = h + a
+        f = self.wo(gelu_op(self.wi(self.ln2(h))))
+        if self.keep_prob < 1.0:
+            f = dropout_op(f, self.keep_prob)
+        return h + f
+
+
+class GPTModel:
+    def __init__(self, config: GPTConfig, name="gpt"):
+        c = config
+        self.config = c
+        self.wte = layers.Embedding(c.vocab_size, c.hidden_size,
+                                    name=name + "_wte")
+        self.wpe = init.random_normal(
+            (c.max_position_embeddings, c.hidden_size), stddev=0.02,
+            name=name + "_wpe")
+        self.blocks = [GPTBlock(c, name=f"{name}_h{i}")
+                       for i in range(c.num_hidden_layers)]
+        self.ln_f = layers.LayerNorm(c.hidden_size, name=name + "_ln_f")
+        self.keep_prob = 1.0 - c.dropout_rate
+
+    def __call__(self, input_ids, kv_lens=None):
+        """input_ids: (B, S) int -> hidden (B*S, H)."""
+        c = self.config
+        h = embedding_lookup_op(self.wte.embedding_table, input_ids)
+        # learned positions, sliced implicitly by broadcast over seq_len
+        pos = self.wpe if c.max_position_embeddings == c.seq_len else \
+            _slice_rows(self.wpe, c.seq_len)
+        h = h + broadcast_shape_op(
+            pos, (c.batch_size, c.seq_len, c.hidden_size), add_axes=[0])
+        h = array_reshape_op(h, [c.batch_size * c.seq_len, c.hidden_size])
+        if self.keep_prob < 1.0:
+            h = dropout_op(h, self.keep_prob)
+        for blk in self.blocks:
+            h = blk(h, kv_lens=kv_lens)
+        return self.ln_f(h)
+
+
+def _slice_rows(node, n):
+    from ..graph import slice_op
+    return slice_op(node, [0, 0], [n, -1])
+
+
+class GPTForCausalLM:
+    """Next-token LM.  ``labels`` are the pre-shifted targets (callers
+    shift by one position host-side, padding the tail with -1, which is
+    ignored).  Head is TIED to wte; the training loss runs through the
+    fused chunked head, logits stay lazy."""
+
+    def __init__(self, config: GPTConfig, name="gpt"):
+        c = config
+        self.config = c
+        self.transformer = GPTModel(config, name=name)
+        self.head_bias = init.zeros((c.vocab_size,),
+                                    name=name + "_head_bias")
+
+    def __call__(self, input_ids, labels=None, kv_lens=None):
+        c = self.config
+        h = self.transformer(input_ids, kv_lens=kv_lens)
+        table = self.transformer.wte.embedding_table
+        logits = linear_op(h, table, self.head_bias, trans_B=True)
+        if labels is None:
+            return logits
+        labels_flat = array_reshape_op(labels,
+                                       [c.batch_size * c.seq_len])
+        loss_vec = tied_lm_head_xent_op(h, table, self.head_bias,
+                                        labels_flat, ignored_index=-1)
+        # mean over NON-IGNORED positions only (bert.py _masked_mean):
+        # -1-padded tails must not dilute the loss/gradient scale
+        return _masked_mean(loss_vec, labels_flat), logits
