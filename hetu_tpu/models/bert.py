@@ -184,7 +184,16 @@ class BertModel:
 def _masked_mean(per_token_loss, labels_flat, ignored_index=-1):
     """Mean over non-ignored positions only (reference averages MLM loss
     over masked tokens, hetu_bert.py), so the MLM/NSP weighting does not
-    depend on the mask rate."""
+    depend on the mask rate.
+
+    Microbatching caveat (pipeline / gradient accumulation): the
+    denominator is the VALID count of whatever slice this graph sees.
+    Under ``pipeline=`` the loss becomes the mean of per-microbatch
+    masked means, which equals the global masked mean only when ignored
+    positions are evenly distributed across microbatches — the same
+    per-chunk-weighting bias standard gradient-accumulation loops have.
+    Keep -1 densities roughly uniform per microbatch (e.g. shuffled MLM
+    masking does this naturally) when exact equivalence matters."""
     valid = bool_op(labels_flat, full_like_op(labels_flat, ignored_index),
                     cond=2)  # labels > ignored_index
     count = addbyconst_op(reduce_sum_op(valid, [0]), 1e-12)

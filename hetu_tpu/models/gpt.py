@@ -18,7 +18,7 @@ from __future__ import annotations
 from .. import initializers as init
 from .. import layers
 from ..graph import (
-    embedding_lookup_op, array_reshape_op, broadcast_shape_op,
+    embedding_lookup_op, array_reshape_op,
     linear_op, gelu_op, dropout_op, tied_lm_head_xent_op,
 )
 from .bert import _masked_mean
@@ -111,15 +111,18 @@ class GPTModel:
         self.keep_prob = 1.0 - c.dropout_rate
 
     def __call__(self, input_ids, kv_lens=None):
-        """input_ids: (B, S) int -> hidden (B*S, H)."""
+        """input_ids: (B, S) int -> hidden (B*S, H).
+
+        Batch-POLYMORPHIC: positions add by natural broadcasting and
+        reshapes use -1, so the same graph works at any local batch —
+        e.g. inside a dp-sharded pipeline body where each microbatch
+        sees batch_size/(pp*dp) rows."""
         c = self.config
         h = embedding_lookup_op(self.wte.embedding_table, input_ids)
-        # learned positions, sliced implicitly by broadcast over seq_len
         pos = self.wpe if c.max_position_embeddings == c.seq_len else \
             _slice_rows(self.wpe, c.seq_len)
-        h = h + broadcast_shape_op(
-            pos, (c.batch_size, c.seq_len, c.hidden_size), add_axes=[0])
-        h = array_reshape_op(h, [c.batch_size * c.seq_len, c.hidden_size])
+        h = h + pos                      # [B,S,H] + [S,H] broadcasts
+        h = array_reshape_op(h, [-1, c.hidden_size])
         if self.keep_prob < 1.0:
             h = dropout_op(h, self.keep_prob)
         for blk in self.blocks:
@@ -146,14 +149,12 @@ class GPTForCausalLM:
                                     name=name + "_head_bias")
 
     def __call__(self, input_ids, labels=None, kv_lens=None):
-        c = self.config
         h = self.transformer(input_ids, kv_lens=kv_lens)
         table = self.transformer.wte.embedding_table
         logits = linear_op(h, table, self.head_bias, trans_B=True)
         if labels is None:
             return logits
-        labels_flat = array_reshape_op(labels,
-                                       [c.batch_size * c.seq_len])
+        labels_flat = array_reshape_op(labels, [-1])
         loss_vec = tied_lm_head_xent_op(h, table, self.head_bias,
                                         labels_flat, ignored_index=-1)
         # mean over NON-IGNORED positions only (bert.py _masked_mean):

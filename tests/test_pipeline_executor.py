@@ -668,3 +668,37 @@ class TestMixedPrecisionPipeline:
         assert np.all(np.isfinite(tr))
         assert np.mean(tr[-3:]) < np.mean(tr[:3]), tr
         assert ex.var_values["l0_w1"].dtype == np.float32   # masters
+
+
+def test_gpt_model_pipeline_equivalence():
+    """GPTForCausalLM (batch-polymorphic: broadcast positions + -1
+    reshapes) through Executor(pipeline='gpipe') on a pp2 x dp2 mesh:
+    trajectory == 1-device.  Labels carry no -1 padding here: the
+    masked-mean denominator is per-microbatch under pipelining (see
+    models/bert.py _masked_mean's microbatching caveat)."""
+    from hetu_tpu.models import GPTConfig, GPTForCausalLM
+
+    def run(mesh=None, **exkw):
+        cfg = GPTConfig(vocab_size=61, hidden_size=32,
+                        num_hidden_layers=4, num_attention_heads=2,
+                        max_position_embeddings=16, batch_size=8,
+                        seq_len=16, dropout_rate=0.0)
+        m = GPTForCausalLM(cfg)
+        ids = ht.placeholder_op("ids")
+        labels = ht.placeholder_op("labels")
+        loss, _ = m(ids, labels=labels)
+        train = ht.optim.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]}, mesh=mesh, **exkw)
+        rng = np.random.RandomState(1)
+        ls = []
+        for _ in range(6):
+            iv = rng.randint(0, 61, (8, 16)).astype(np.int32)
+            lv = ((iv + 1) % 61).astype(np.int32)
+            ls.append(float(np.asarray(
+                ex.run("train", feed_dict={ids: iv, labels: lv})[0])))
+        return ls
+
+    base = run()
+    pp = run(mesh=make_mesh({"pp": 2, "dp": 2}), pipeline="gpipe",
+             num_microbatches=4, num_stages=2)
+    np.testing.assert_allclose(base, pp, rtol=2e-4, atol=2e-4)
