@@ -203,3 +203,75 @@ class TestPipelineLayouts:
             ex.run("train", feed_dict={x: a, y: b})[0]))
             for a, b in batches]
         np.testing.assert_allclose(tr, base, atol=1e-5)
+
+
+class TestGPTLayouts:
+    """The decoder-only family through dp/fsdp/tp layouts: trajectory ==
+    1-device (tier-2 pattern).  tp splits the fused-QKV projections
+    column-wise and the output/FFN-out projections row-wise; the
+    concat-of-sharded-weights [H,3H] matmul must propagate under
+    GSPMD."""
+
+    GPT_TP_SPECS = {
+        "g_h0_attn_q_weight": P(None, "tp"),
+        "g_h0_attn_k_weight": P(None, "tp"),
+        "g_h0_attn_v_weight": P(None, "tp"),
+        "g_h0_attn_proj_weight": P("tp", None),
+        "g_h0_ffn_wi_weight": P(None, "tp"),
+        "g_h0_ffn_wo_weight": P("tp", None),
+        "g_h1_attn_q_weight": P(None, "tp"),
+        "g_h1_attn_k_weight": P(None, "tp"),
+        "g_h1_attn_v_weight": P(None, "tp"),
+        "g_h1_attn_proj_weight": P("tp", None),
+        "g_h1_ffn_wi_weight": P(None, "tp"),
+        "g_h1_ffn_wo_weight": P("tp", None),
+    }
+
+    def _build(self):
+        from hetu_tpu.models import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=61, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=2,
+                        max_position_embeddings=16, batch_size=8,
+                        seq_len=16, dropout_rate=0.0)
+        m = GPTForCausalLM(cfg, name="g")
+        ids = ht.placeholder_op("g_ids")
+        labels = ht.placeholder_op("g_labels")
+        loss, _ = m(ids, labels=labels)
+        train = ht.optim.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+        return ids, labels, loss, train
+
+    def _batches(self, n=6):
+        rng = np.random.RandomState(2)
+        out = []
+        for _ in range(n):
+            iv = rng.randint(0, 61, (8, 16)).astype(np.int32)
+            out.append((iv, ((iv + 1) % 61).astype(np.int32)))
+        return out
+
+    @pytest.fixture(scope="class")
+    def gpt_baseline(self):
+        ids, labels, loss, train = self._build()
+        ex0 = ht.Executor({"train": [loss, train]})
+        w0 = ex0.return_tensor_values()
+        batches = self._batches()
+        base = run_traj(ex0, ids, labels, batches)
+        assert base[-1] < base[0]
+        return w0, batches, base
+
+    @pytest.mark.parametrize("layout", ["dp8", "fsdp8", "tp2", "tp2xdp4"])
+    def test_gpt_trajectory_matches(self, gpt_baseline, layout):
+        w0, batches, base = gpt_baseline
+        strategies = {
+            "dp8": lambda: ht.dist.DataParallel(num_devices=8),
+            "fsdp8": lambda: ht.dist.FSDP(dp=8, min_size=16),
+            "tp2": lambda: ht.dist.ModelParallel4LM(
+                tp=2, dp=1, specs=self.GPT_TP_SPECS),
+            "tp2xdp4": lambda: ht.dist.ModelParallel4LM(
+                tp=2, dp=4, specs=self.GPT_TP_SPECS),
+        }
+        ids2, labels2, loss2, train2 = self._build()
+        ex = ht.Executor({"train": [loss2, train2]},
+                         dist_strategy=strategies[layout]())
+        ex.load_dict(w0)
+        tr = run_traj(ex, ids2, labels2, batches)
+        np.testing.assert_allclose(tr, base, atol=2e-4)
