@@ -160,3 +160,32 @@ class GPTForCausalLM:
         # mean over NON-IGNORED positions only (bert.py _masked_mean):
         # -1-padded tails must not dilute the loss/gradient scale
         return _masked_mean(loss_vec, labels_flat), logits
+
+
+def greedy_generate(executor, name, ids_node, logits_node_index, prompt,
+                    num_tokens, seq_len, pad_id=0):
+    """Greedy decoding with the static-shape graph: the same fixed-S
+    forward is re-run per generated token and position t-1's logits are
+    read out host-side — causal masking makes the padded tail beyond t
+    irrelevant to that row.  O(S) forwards of O(S) tokens (no KV cache;
+    the graph executor compiles ONE program and reuses it, which is the
+    static-shape-friendly formulation).  ``executor`` runs subgraph
+    ``name`` whose ``logits_node_index``-th output is the [B*S, V]
+    logits of ``ids_node``."""
+    import numpy as np
+
+    prompt = list(prompt)
+    assert 0 < len(prompt) < seq_len
+    if len(prompt) + num_tokens > seq_len:
+        raise ValueError(
+            f"prompt ({len(prompt)}) + num_tokens ({num_tokens}) exceeds "
+            f"the graph's fixed seq_len ({seq_len}); generate in a "
+            f"longer-seq graph or request fewer tokens")
+    ids = np.full((1, seq_len), pad_id, np.int32)
+    ids[0, :len(prompt)] = prompt
+    end = len(prompt) + num_tokens
+    for t in range(len(prompt), end):
+        out = executor.run(name, feed_dict={ids_node: ids})
+        logits = np.asarray(out[logits_node_index])
+        ids[0, t] = int(logits.reshape(seq_len, -1)[t - 1].argmax())
+    return ids[0, :end].tolist()

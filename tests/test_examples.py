@@ -97,3 +97,32 @@ def test_gpt_example_script_runs():
     _run_main(mod, ["--vocab-size", "97", "--batch-size", "2",
                     "--seq-len", "16", "--num-layers", "1",
                     "--num-steps", "3"])
+
+
+def test_gpt_greedy_generation():
+    """Inference path: after training next=(x+1)%V, greedy decoding must
+    reproduce the arithmetic chain from a prompt (eval subgraph shares
+    the trained weights; causal masking makes the padded tail inert)."""
+    import numpy as np
+    import hetu_tpu as ht
+    from hetu_tpu.models import GPTConfig, GPTForCausalLM
+    from hetu_tpu.models.gpt import greedy_generate
+
+    cfg = GPTConfig(vocab_size=61, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, max_position_embeddings=16,
+                    batch_size=4, seq_len=16, dropout_rate=0.0)
+    m = GPTForCausalLM(cfg)
+    ids = ht.placeholder_op("gg_ids")
+    labels = ht.placeholder_op("gg_labels")
+    loss, _ = m(ids, labels=labels)
+    train = ht.optim.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+    gen_ids = ht.placeholder_op("gg_gen_ids")
+    logits_gen = m(gen_ids)
+    ex = ht.Executor({"train": [loss, train], "gen": [logits_gen]})
+    rng = np.random.RandomState(1)
+    for _ in range(200):
+        iv = rng.randint(0, 61, (4, 16)).astype(np.int32)
+        lv = ((iv + 1) % 61).astype(np.int32)
+        ex.run("train", feed_dict={ids: iv, labels: lv})
+    seq = greedy_generate(ex, "gen", gen_ids, 0, [7, 8, 9], 8, 16)
+    assert seq == list(range(7, 18)), seq
