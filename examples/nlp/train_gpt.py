@@ -75,6 +75,9 @@ def main():
                         default=None,
                         help="pin flash on/off; default: auto (flash "
                              "from seq 1024, dropout permitting)")
+    parser.add_argument("--demo-generate", type=int, default=0,
+                        help="after training, greedy-decode this many "
+                             "tokens from a short prompt")
     args = parser.parse_args()
 
     make = GPTConfig.medium if args.config == "medium" else GPTConfig.small
@@ -93,8 +96,15 @@ def main():
     opt = ht.optim.AdamWOptimizer(learning_rate=args.learning_rate,
                                   weight_decay=0.01)
     train_op = opt.minimize(loss)
-    executor = ht.Executor({"train": [loss, train_op]},
-                           comm_mode=args.comm_mode)
+    subgraphs = {"train": [loss, train_op]}
+    gen_ids = None
+    if args.demo_generate > 0:
+        gen_ids = ht.placeholder_op("gen_input_ids")
+        # eval subgraph: no optimizer -> tc.training is False -> every
+        # DropoutOp is identity (ops_conv.py DropoutOp), regardless of
+        # the config's dropout_rate
+        subgraphs["gen"] = [model(gen_ids)]
+    executor = ht.Executor(subgraphs, comm_mode=args.comm_mode)
 
     rng = np.random.RandomState(0)
     if args.data_path and os.path.exists(args.data_path):
@@ -114,6 +124,15 @@ def main():
             toks = (step + 1) * cfg.batch_size * cfg.seq_len / dt
             logger.info("step %d loss=%.4f (%.0f tokens/s)", step,
                         float(np.asarray(out[0]).reshape(-1)[0]), toks)
+
+    if args.demo_generate > 0:
+        from hetu_tpu.models.gpt import greedy_generate
+        prompt = [int(t) % cfg.vocab_size for t in (1, 2, 3)]
+        n = min(args.demo_generate, cfg.seq_len - len(prompt))
+        seq = greedy_generate(executor, "gen", gen_ids, 0, prompt, n,
+                              cfg.seq_len)
+        logger.info("greedy continuation of %s: %s", prompt,
+                    seq[len(prompt):])
 
 
 if __name__ == "__main__":

@@ -175,7 +175,11 @@ def greedy_generate(executor, name, ids_node, logits_node_index, prompt,
     import numpy as np
 
     prompt = list(prompt)
-    assert 0 < len(prompt) < seq_len
+    if not 0 < len(prompt) < seq_len:
+        raise ValueError(
+            f"prompt length {len(prompt)} must be in (0, {seq_len})")
+    if num_tokens < 1:
+        raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
     if len(prompt) + num_tokens > seq_len:
         raise ValueError(
             f"prompt ({len(prompt)}) + num_tokens ({num_tokens}) exceeds "
