@@ -46,6 +46,14 @@ class Optimizer:
     def __init__(self, learning_rate, l2reg=0.0):
         self.learning_rate = learning_rate
         self.l2reg = l2reg
+        # max global gradient norm (None = off).  An attribute rather
+        # than a per-subclass kwarg: set it on any optimizer instance
+        # (`opt.clip_grad_norm = 1.0`) before minimize(); the clip
+        # factor folds into the step's grad scaling inside the jitted
+        # program (OptimizerOp.apply), so it reaches dense, row-sparse
+        # AND PS-routed gradients uniformly.  The reference has no
+        # clipping; standard for LM training.
+        self.clip_grad_norm = None
         self.name = type(self).__name__
 
     # ------------------------------------------------------------------ #
@@ -374,6 +382,32 @@ class OptimizerOp(Op):
         backward_hook routing, ParameterServerCommunicate.py:38-57)."""
         opt = self.optimizer
         lr = opt.lr_value(tc.step)
+        clip_cfg = getattr(opt, "clip_grad_norm", None)
+        if clip_cfg is not None and clip_cfg <= 0:
+            raise ValueError(
+                f"clip_grad_norm must be positive, got {clip_cfg}")
+        if clip_cfg is not None:
+            # global-norm clip folded into grad_scale so every grad kind
+            # (dense / sparse rows / PS-routed) scales identically.  For
+            # sparse adjoints the norm uses per-position rows BEFORE
+            # duplicate-id merging — an upper bound on the merged-grad
+            # norm when ids repeat, i.e. clipping is (slightly)
+            # conservative there.
+            sq = jnp.asarray(0.0, jnp.float32)
+            for i in range(len(grad_vals)):
+                if i in self.sparse_inputs:
+                    _ids, rows = grad_vals[i]
+                    sq = sq + jnp.sum(rows.astype(jnp.float32) ** 2)
+                else:
+                    sq = sq + jnp.sum(
+                        grad_vals[i].astype(jnp.float32) ** 2)
+            if grad_scale is not None:
+                sq = sq * jnp.asarray(grad_scale, jnp.float32) ** 2
+            gnorm = jnp.sqrt(sq)
+            factor = jnp.minimum(
+                1.0, opt.clip_grad_norm / (gnorm + 1e-6))
+            grad_scale = factor if grad_scale is None \
+                else grad_scale * factor
         new_state = dict(opt_state)
         for i, var in enumerate(self.var_list):
             if var.name in ps_vars:

@@ -529,6 +529,22 @@ class PipelineTrainer:
 
     def _apply_update(self, live, grads):
         opt = self.optimizer
+        clip = getattr(opt, "clip_grad_norm", None) \
+            if opt is not None else None
+        if clip is not None:
+            # same global-norm clip the Executor path applies in
+            # OptimizerOp.apply — across ALL stages' gradient leaves
+            if clip <= 0:
+                raise ValueError(
+                    f"clip_grad_norm must be positive, got {clip}")
+            sq = jnp.asarray(0.0, jnp.float32)
+            for gr in grads:
+                for g in jax.tree_util.tree_leaves(gr):
+                    sq = sq + jnp.sum(g.astype(jnp.float32) ** 2)
+            factor = jnp.minimum(1.0, clip / (jnp.sqrt(sq) + 1e-6))
+            grads = [jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype),
+                gr) for gr in grads]
         if opt is None or not hasattr(opt, "update_one"):
             lr = getattr(opt, "learning_rate", 0.1) if opt is not None else 0.1
             return [jax.tree_util.tree_map(lambda p, g: p - lr * g, pl, gr)

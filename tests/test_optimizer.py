@@ -131,3 +131,47 @@ def test_checkpoint_roundtrip(tmp_path):
     ex2.run("train")
     np.testing.assert_allclose(np.asarray(ex2.var_values["w_ckpt"]),
                                np.asarray(ex.var_values["w_ckpt"]), rtol=1e-6)
+
+
+def test_clip_grad_norm_matches_manual():
+    """opt.clip_grad_norm clips by GLOBAL norm across all params; the
+    clipped step equals a hand-computed clipped SGD step, and a
+    large-enough bound is a no-op."""
+    import numpy as np
+    import hetu_tpu as ht
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 6).astype(np.float32) * 3.0
+    yv = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+
+    def run(clip):
+        x = ht.placeholder_op("cg_x")
+        y = ht.placeholder_op("cg_y")
+        w = ht.Variable("cg_w", value=np.ones((6, 3), np.float32) * 0.5)
+        b = ht.Variable("cg_b", value=np.zeros(3, np.float32))
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.linear_op(x, w, b), y), axes=0)
+        opt = ht.optim.SGDOptimizer(learning_rate=1.0)
+        opt.clip_grad_norm = clip
+        train = opt.minimize(loss)
+        ex = ht.Executor({"train": [loss, train]})
+        ex.run("train", feed_dict={x: xv, y: yv})
+        return (np.asarray(ex.var_values["cg_w"]),
+                np.asarray(ex.var_values["cg_b"]))
+
+    w_unc, b_unc = run(None)
+    w_big, b_big = run(1e6)        # bound never binds -> identical
+    np.testing.assert_allclose(w_big, w_unc, rtol=1e-6)
+    np.testing.assert_allclose(b_big, b_unc, rtol=1e-6)
+
+    # manual reference: raw grad = (w0 - w_unclipped) / lr
+    w0, b0 = np.ones((6, 3), np.float32) * 0.5, np.zeros(3, np.float32)
+    gw, gb = (w0 - w_unc), (b0 - b_unc)
+    gnorm = np.sqrt((gw ** 2).sum() + (gb ** 2).sum())
+    clip = float(gnorm) / 2.0       # binds: factor = 0.5
+    w_clip, b_clip = run(clip)
+    factor = clip / (gnorm + 1e-6)
+    np.testing.assert_allclose(w_clip, w0 - factor * gw,
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(b_clip, b0 - factor * gb,
+                               rtol=1e-4, atol=1e-6)
