@@ -66,6 +66,8 @@ def main():
     parser.add_argument("--num-layers", type=int, default=None)
     parser.add_argument("--vocab-size", type=int, default=50257)
     parser.add_argument("--learning-rate", type=float, default=3e-4)
+    parser.add_argument("--clip-grad-norm", type=float, default=1.0,
+                        help="global gradient-norm bound (<=0 disables)")
     parser.add_argument("--num-steps", type=int, default=30)
     parser.add_argument("--comm-mode", default=None)
     parser.add_argument("--data-path", default=None,
@@ -95,6 +97,8 @@ def main():
     loss, _logits = model(ids, labels=labels)
     opt = ht.optim.AdamWOptimizer(learning_rate=args.learning_rate,
                                   weight_decay=0.01)
+    if args.clip_grad_norm > 0:
+        opt.clip_grad_norm = args.clip_grad_norm
     train_op = opt.minimize(loss)
     subgraphs = {"train": [loss, train_op]}
     gen_ids = None
