@@ -175,3 +175,38 @@ def test_clip_grad_norm_matches_manual():
                                rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(b_clip, b0 - factor * gb,
                                rtol=1e-4, atol=1e-6)
+
+
+def test_clip_grad_norm_dp_equivalence():
+    """Clipping composes with data parallelism: the norm is taken over
+    the GLOBAL (psum'd) gradients inside the sharded step, so a dp8 run
+    must track the 1-device trajectory."""
+    import numpy as np
+    import hetu_tpu as ht
+
+    rng = np.random.RandomState(0)
+    feeds = []
+    for _ in range(6):
+        xv = rng.randn(16, 6).astype(np.float32) * 3.0
+        yv = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        feeds.append((xv, yv))
+
+    def run(strategy):
+        x = ht.placeholder_op("cd_x")
+        y = ht.placeholder_op("cd_y")
+        w = ht.Variable("cd_w", value=np.ones((6, 3), np.float32) * 0.5)
+        b = ht.Variable("cd_b", value=np.zeros(3, np.float32))
+        loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+            ht.linear_op(x, w, b), y), axes=0)
+        opt = ht.optim.AdamOptimizer(learning_rate=0.05)
+        opt.clip_grad_norm = 0.1          # binds on these feeds
+        train = opt.minimize(loss)
+        ex = ht.Executor({"train": [loss, train]},
+                         dist_strategy=strategy)
+        return [float(np.asarray(ex.run("train",
+                                        feed_dict={x: a, y: b_})[0]))
+                for a, b_ in feeds]
+
+    base = run(None)
+    dp = run(ht.dist.DataParallel(num_devices=8))
+    np.testing.assert_allclose(dp, base, atol=1e-5)
