@@ -38,6 +38,13 @@ class GPTConfig:
         self.max_position_embeddings = max_position_embeddings
         self.dropout_rate = dropout_rate
         self.batch_size = batch_size
+        if seq_len > max_position_embeddings:
+            raise ValueError(
+                f"seq_len={seq_len} exceeds max_position_embeddings="
+                f"{max_position_embeddings}: the learned position table "
+                f"has no rows past that, and the slice would otherwise "
+                f"surface as an opaque broadcast error when adding "
+                f"positions")
         self.seq_len = seq_len
         # None = measured v5e crossover: flash from seq 1024 up — but
         # only with dropout off, because the fused kernel has no probs

@@ -12,6 +12,13 @@ produce plain data, never code or constructor calls.
 Layout: one tag byte per value, then a fixed or length-prefixed
 payload; arrays carry (dtype-str, shape) and their raw C-contiguous
 buffer, decoded zero-copy via np.frombuffer over the receive buffer.
+
+Scalar-widening contract: numpy *scalars* are normalized on the wire —
+np.bool_ → bool, integer scalars → int64, floating scalars → float64
+(the decoder returns Python bool/int/float).  Integer scalars outside
+int64 range (e.g. np.uint64 above 2**63-1) are rejected with WireError
+at encode time.  Arrays keep their exact dtype; put values in a 0-d
+ndarray if dtype or full uint64 range must survive the trip.
 """
 
 from __future__ import annotations
@@ -36,6 +43,10 @@ def _enc(obj, out):
         out.append(b"T")
     elif obj is False:
         out.append(b"F")
+    elif isinstance(obj, np.bool_):
+        # np.bool_ is not a subclass of int/np.integer; without this
+        # branch a numpy bool scalar would fall through to WireError.
+        out.append(b"T" if obj else b"F")
     elif isinstance(obj, (int, np.integer)):
         out.append(b"I")
         out.append(_I64.pack(int(obj)))

@@ -317,10 +317,16 @@ class TestChipCalibration:
         from hetu_tpu.planner.chip_calibration import (
             calibrate_chip, load_calibration)
         art = calibrate_chip(small=True)
-        for key in ("matmul_tflops_bf16", "host_link", "overlap",
+        for key in ("matmul_tflops_bf16", "matmul_tflops_bf16_raw",
+                    "matmul_clamped_to_spec", "host_link", "overlap",
                     "flash_blocks", "plan_vs_naive", "cluster_spec",
                     "unmeasurable_on_one_chip"):
             assert key in art, key
+        # clamp bookkeeping: a clamped dim must have raw > recorded
+        for d, clamped in art["matmul_clamped_to_spec"].items():
+            if clamped:
+                assert art["matmul_tflops_bf16_raw"][d] > \
+                    art["matmul_tflops_bf16"][d]
         assert 0.0 <= art["overlap"]["overlap_h2d"] <= 1.0
         assert art["flash_blocks"]["chosen"] in \
             art["flash_blocks"]["step_ms"]

@@ -264,6 +264,23 @@ class TestWireCodec:
             else:
                 assert back == obj, (obj, back)
 
+    def test_numpy_scalar_widening_contract(self):
+        """np.bool_ -> bool; numpy int/float scalars widen to
+        int64/float64 and come back as Python scalars (documented
+        contract; arrays keep their exact dtype)."""
+        import numpy as np
+        from hetu_tpu.ps import wire
+        assert wire.loads(wire.dumps(np.bool_(True))) is True
+        assert wire.loads(wire.dumps(np.bool_(False))) is False
+        back = wire.loads(wire.dumps(np.int16(-3)))
+        assert back == -3 and type(back) is int
+        back = wire.loads(wire.dumps(np.float32(0.5)))
+        assert back == 0.5 and type(back) is float
+        # composed, as a server reply envelope would carry it
+        back = wire.loads(wire.dumps({"ok": np.bool_(True),
+                                      "n": np.uint8(7)}))
+        assert back == {"ok": True, "n": 7}
+
     def test_rejects_code_objects(self):
         import pytest
         from hetu_tpu.ps import wire
