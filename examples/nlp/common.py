@@ -1,0 +1,20 @@
+"""Shared helpers for the NLP example scripts."""
+
+import numpy as np
+
+
+def synthetic_mlm_batch(rng, cfg, mask_prob=0.15):
+    """Synthetic BERT pretraining batch: (ids, token_type, attention_mask,
+    mlm_labels, nsp_labels).  [MASK] is 103 in the standard vocab; the
+    clamp keeps tiny test vocabs in range."""
+    ids = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len))
+    token_type = np.zeros((cfg.batch_size, cfg.seq_len), np.int32)
+    token_type[:, cfg.seq_len // 2:] = 1
+    mask = np.ones((cfg.batch_size, cfg.seq_len), np.float32)
+    mlm_labels = np.full((cfg.batch_size, cfg.seq_len), -1, np.int32)
+    masked = rng.rand(cfg.batch_size, cfg.seq_len) < mask_prob
+    mlm_labels[masked] = ids[masked]
+    ids[masked] = min(103, cfg.vocab_size - 1)  # [MASK]
+    nsp = rng.randint(0, 2, (cfg.batch_size,))
+    return (ids.astype(np.int32), token_type, mask,
+            mlm_labels, nsp.astype(np.int32))

@@ -8,8 +8,9 @@ streams when no corpus is present.  DP over all visible devices via
 import os
 import sys
 
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, '..', '..'))
+sys.path.insert(0, _HERE)   # for the shared `common` helpers
 
 import argparse
 import logging
@@ -20,23 +21,10 @@ import numpy as np
 import hetu_tpu as ht
 from hetu_tpu.models import BertConfig, BertForPreTraining
 
+from common import synthetic_mlm_batch
+
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
 logger = logging.getLogger("bert")
-
-
-def synthetic_batch(rng, cfg, mask_prob=0.15):
-    ids = rng.randint(0, cfg.vocab_size, (cfg.batch_size, cfg.seq_len))
-    token_type = np.zeros((cfg.batch_size, cfg.seq_len), np.int32)
-    half = cfg.seq_len // 2
-    token_type[:, half:] = 1
-    mask = np.ones((cfg.batch_size, cfg.seq_len), np.float32)
-    mlm_labels = np.full((cfg.batch_size, cfg.seq_len), -1, np.int32)
-    masked = rng.rand(cfg.batch_size, cfg.seq_len) < mask_prob
-    mlm_labels[masked] = ids[masked]
-    ids[masked] = 103  # [MASK]
-    nsp = rng.randint(0, 2, (cfg.batch_size,))
-    return (ids.astype(np.int32), token_type, mask,
-            mlm_labels, nsp.astype(np.int32))
 
 
 def main():
@@ -74,7 +62,7 @@ def main():
     rng = np.random.RandomState(0)
     t0 = time.time()
     for step in range(args.num_steps):
-        b_ids, b_tok, b_mask, b_mlm, b_nsp = synthetic_batch(rng, cfg)
+        b_ids, b_tok, b_mask, b_mlm, b_nsp = synthetic_mlm_batch(rng, cfg)
         out = executor.run("train", feed_dict={
             ids: b_ids, tok: b_tok, mask: b_mask, mlm: b_mlm, nsp: b_nsp})
         if step % 10 == 0 or step == args.num_steps - 1:

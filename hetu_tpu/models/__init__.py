@@ -22,6 +22,9 @@ from .bert import (
     BertConfig, BertModel, BertForPreTraining,
     BertForSequenceClassification, BertForMaskedLM,
 )
+from .bert_moe import (
+    BertMoEConfig, BertMoEModel, BertMoEForPreTraining,
+)
 from .transformer import TransformerConfig, Transformer, transformer_mt
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM
 from .ctr import (

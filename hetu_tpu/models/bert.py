@@ -98,6 +98,30 @@ class BertEmbeddings:
         return emb
 
 
+class BertAttentionBlock:
+    """Self-attention half of an encoder block: attention -> dropout ->
+    add&norm.  Shared by the dense BertLayer and the MoE block
+    (bert_moe.BertMoELayer), so attention wiring changes propagate to
+    both."""
+
+    def __init__(self, config: BertConfig, name="bert_layer"):
+        c = config
+        self.config = c
+        self.attention = layers.MultiHeadAttention(
+            c.hidden_size, c.num_attention_heads, c.seq_len, c.batch_size,
+            dropout_rate=c.attention_probs_dropout_prob,
+            use_flash=c.use_flash_attention, name=name + "_attn")
+        self.attn_ln = layers.LayerNorm(c.hidden_size, name=name + "_attn_ln")
+
+    def __call__(self, hidden, attention_mask=None, kv_lens=None):
+        c = self.config
+        attn = self.attention(hidden, attention_mask=attention_mask,
+                              kv_lens=kv_lens)
+        if c.hidden_dropout_prob > 0:
+            attn = dropout_op(attn, 1.0 - c.hidden_dropout_prob)
+        return self.attn_ln(hidden + attn)
+
+
 class BertLayer:
     """One encoder block: self-attention -> add&norm -> FFN -> add&norm."""
 
@@ -106,11 +130,7 @@ class BertLayer:
         act = gelu_op if c.hidden_act == "gelu" else relu_op
         self.config = c
         self.act = act
-        self.attention = layers.MultiHeadAttention(
-            c.hidden_size, c.num_attention_heads, c.seq_len, c.batch_size,
-            dropout_rate=c.attention_probs_dropout_prob,
-            use_flash=c.use_flash_attention, name=name + "_attn")
-        self.attn_ln = layers.LayerNorm(c.hidden_size, name=name + "_attn_ln")
+        self.attn_block = BertAttentionBlock(config, name=name)
         self.intermediate = layers.Linear(c.hidden_size, c.intermediate_size,
                                           name=name + "_intermediate")
         self.output = layers.Linear(c.intermediate_size, c.hidden_size,
@@ -119,11 +139,8 @@ class BertLayer:
 
     def __call__(self, hidden, attention_mask=None, kv_lens=None):
         c = self.config
-        attn = self.attention(hidden, attention_mask=attention_mask,
-                              kv_lens=kv_lens)
-        if c.hidden_dropout_prob > 0:
-            attn = dropout_op(attn, 1.0 - c.hidden_dropout_prob)
-        hidden = self.attn_ln(hidden + attn)
+        hidden = self.attn_block(hidden, attention_mask=attention_mask,
+                                 kv_lens=kv_lens)
         ffn = self.output(self.act(self.intermediate(hidden)))
         if c.hidden_dropout_prob > 0:
             ffn = dropout_op(ffn, 1.0 - c.hidden_dropout_prob)
@@ -147,6 +164,13 @@ class BertPooler:
         return tanh_op(self.dense(cls))
 
 
+def additive_attention_mask(config, attention_mask):
+    """(B, S) {0,1} mask -> additive (B,1,1,S): (1-m) * -10000."""
+    c = config
+    m = array_reshape_op(attention_mask, [c.batch_size, 1, 1, c.seq_len])
+    return mul_byconst_op(addbyconst_op(opposite_op(m), 1.0), -10000.0)
+
+
 class BertModel:
     """Backbone; returns (sequence_output (B*S,H), pooled_output (B,H))."""
 
@@ -158,10 +182,7 @@ class BertModel:
         self.pooler = BertPooler(config, name=name + "_pooler")
 
     def attention_mask_from_input(self, attention_mask):
-        """(B, S) {0,1} mask -> additive (B,1,1,S): (1-m) * -10000."""
-        c = self.config
-        m = array_reshape_op(attention_mask, [c.batch_size, 1, 1, c.seq_len])
-        return mul_byconst_op(addbyconst_op(opposite_op(m), 1.0), -10000.0)
+        return additive_attention_mask(self.config, attention_mask)
 
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  kv_lens=None):
@@ -200,13 +221,14 @@ def _masked_mean(per_token_loss, labels_flat, ignored_index=-1):
     return div_op(reduce_sum_op(per_token_loss, [0]), count)
 
 
-class BertForPreTraining:
-    """MLM + NSP heads (reference hetu_bert.py BertForPreTraining)."""
+class BertPreTrainingHeads:
+    """Tied MLM decoder + NSP head, shared by the dense and MoE
+    pretraining models (the reference's cls heads, hetu_bert.py)."""
 
-    def __init__(self, config: BertConfig, name="bert"):
+    def __init__(self, config: BertConfig, word_embeddings, name="bert"):
         c = config
         self.config = c
-        self.bert = BertModel(config, name=name)
+        self.word_embeddings = word_embeddings
         self.transform = layers.Linear(c.hidden_size, c.hidden_size,
                                        name=name + "_mlm_transform")
         self.transform_ln = layers.LayerNorm(c.hidden_size,
@@ -215,36 +237,68 @@ class BertForPreTraining:
                                        name=name + "_mlm_bias")
         self.nsp = layers.Linear(c.hidden_size, 2, name=name + "_nsp")
 
-    def _mlm_head(self, seq_out):
+    def mlm(self, seq_out):
         """(h, logits) for the tied MLM decoder.  The logits node is
         LAZY — training losses go through the fused chunked head on
         ``h`` instead, so the [B*S, vocab] logits chain is only ever
         computed if a caller evaluates it."""
         h = self.transform_ln(gelu_op(self.transform(seq_out)))
-        logits = matmul_op(h, self.bert.embeddings.word_embeddings,
-                           trans_B=True)
+        logits = matmul_op(h, self.word_embeddings, trans_B=True)
         logits = logits + broadcastto_op(self.decoder_bias, logits)
         return h, logits
+
+    def pretraining_loss(self, h, nsp_logits, masked_lm_labels,
+                         next_sentence_label):
+        """masked-mean MLM loss (fused chunked tied head) + NSP loss."""
+        c = self.config
+        labels_flat = array_reshape_op(masked_lm_labels,
+                                       [c.batch_size * c.seq_len])
+        mlm_loss = tied_lm_head_xent_op(
+            h, self.word_embeddings, self.decoder_bias,
+            labels_flat, ignored_index=-1)
+        nsp_loss = softmaxcrossentropy_sparse_op(nsp_logits,
+                                                 next_sentence_label)
+        return (_masked_mean(mlm_loss, labels_flat)
+                + reduce_mean_op(nsp_loss, [0]))
+
+
+class BertForPreTraining:
+    """MLM + NSP heads (reference hetu_bert.py BertForPreTraining)."""
+
+    def __init__(self, config: BertConfig, name="bert"):
+        self.config = config
+        self.bert = BertModel(config, name=name)
+        self.heads = BertPreTrainingHeads(
+            config, self.bert.embeddings.word_embeddings, name=name)
+
+    def _mlm_head(self, seq_out):
+        return self.heads.mlm(seq_out)
+
+    # checkpoint-name-stable attribute passthroughs (pre-round-4 callers
+    # reached the head params through the model object)
+    @property
+    def decoder_bias(self):
+        return self.heads.decoder_bias
+
+    @property
+    def transform(self):
+        return self.heads.transform
+
+    @property
+    def nsp(self):
+        return self.heads.nsp
 
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  masked_lm_labels=None, next_sentence_label=None,
                  kv_lens=None):
-        c = self.config
         seq_out, pooled = self.bert(input_ids, token_type_ids,
                                     attention_mask, kv_lens=kv_lens)
-        h, logits = self._mlm_head(seq_out)
-        nsp_logits = self.nsp(pooled)
+        h, logits = self.heads.mlm(seq_out)
+        nsp_logits = self.heads.nsp(pooled)
         if masked_lm_labels is None:
             return logits, nsp_logits
-        mlm_labels_flat = array_reshape_op(masked_lm_labels,
-                                           [c.batch_size * c.seq_len])
-        mlm_loss = tied_lm_head_xent_op(
-            h, self.bert.embeddings.word_embeddings, self.decoder_bias,
-            mlm_labels_flat, ignored_index=-1)
-        nsp_loss = softmaxcrossentropy_sparse_op(nsp_logits,
-                                                 next_sentence_label)
-        loss = (_masked_mean(mlm_loss, mlm_labels_flat)
-                + reduce_mean_op(nsp_loss, [0]))
+        loss = self.heads.pretraining_loss(h, nsp_logits, masked_lm_labels,
+                                           next_sentence_label)
         return loss, logits, nsp_logits
 
 

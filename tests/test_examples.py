@@ -92,6 +92,17 @@ def test_gpt_example_learns():
     assert last < first * 0.5, (first, last)
 
 
+def test_bert_moe_example_script_runs_on_ep_mesh():
+    mod = _load("nlp/train_bert_moe.py", "ex_bert_moe")
+    last = _run_main(mod, ["--vocab-size", "97", "--batch-size", "4",
+                           "--seq-len", "8", "--num-layers", "2",
+                           "--hidden", "32", "--heads", "2",
+                           "--num-experts", "4", "--ep", "4", "--dp", "2",
+                           "--num-steps", "3"])
+    import numpy as np
+    assert np.isfinite(last)
+
+
 def test_gpt_example_script_runs():
     mod = _load("nlp/train_gpt.py", "ex_gpt")
     _run_main(mod, ["--vocab-size", "97", "--batch-size", "2",
