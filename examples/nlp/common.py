@@ -1,5 +1,7 @@
 """Shared helpers for the NLP example scripts."""
 
+import itertools
+
 import numpy as np
 
 
@@ -18,3 +20,27 @@ def synthetic_mlm_batch(rng, cfg, mask_prob=0.15):
     nsp = rng.randint(0, 2, (cfg.batch_size,))
     return (ids.astype(np.int32), token_type, mask,
             mlm_labels, nsp.astype(np.int32))
+
+
+def corpus_mlm_stream(data_path, vocab_path, batch_size, seq_len,
+                      dupe_factor=5, seed=0):
+    """Raw-text corpus -> endless (ids, token_type, attention_mask,
+    mlm_labels, nsp) batch stream through the real pretraining pipeline
+    (hetu_tpu.pretraining_data).  Returns (stream, vocab_size).  Builds
+    a wordpiece vocab from the corpus when no vocab file is given."""
+    from hetu_tpu.pretraining_data import (
+        PretrainingBatches, create_bert_pretraining_data,
+        load_or_build_tokenizer)
+    tok = load_or_build_tokenizer(data_path, vocab_path)
+    data = create_bert_pretraining_data(
+        data_path, tok, max_seq_length=seq_len, dupe_factor=dupe_factor,
+        seed=seed)
+    batches = PretrainingBatches(data, batch_size, seed=seed)
+
+    def stream():
+        for b in itertools.chain.from_iterable(itertools.repeat(batches)):
+            yield (b["input_ids"], b["token_type_ids"],
+                   b["attention_mask"], b["masked_lm_labels"],
+                   b["next_sentence_label"])
+
+    return stream(), len(tok.vocab)

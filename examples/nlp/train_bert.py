@@ -21,7 +21,7 @@ import numpy as np
 import hetu_tpu as ht
 from hetu_tpu.models import BertConfig, BertForPreTraining
 
-from common import synthetic_mlm_batch
+from common import corpus_mlm_stream, synthetic_mlm_batch
 
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
 logger = logging.getLogger("bert")
@@ -37,6 +37,14 @@ def main():
     parser.add_argument("--num-steps", type=int, default=30)
     parser.add_argument("--comm-mode", default=None)
     parser.add_argument("--use-flash", action="store_true")
+    parser.add_argument("--data-path", default=None,
+                        help="raw text corpus (one sentence per line, "
+                             "blank line between documents); synthetic "
+                             "batches when absent")
+    parser.add_argument("--vocab-path", default=None,
+                        help="wordpiece vocab.txt; built from the "
+                             "corpus when absent")
+    parser.add_argument("--dupe-factor", type=int, default=5)
     args = parser.parse_args()
 
     make = BertConfig.large if args.config == "large" else BertConfig.base
@@ -44,6 +52,15 @@ def main():
               use_flash_attention=args.use_flash)
     if args.num_layers:
         kw["num_hidden_layers"] = args.num_layers
+
+    stream = None
+    if args.data_path:
+        stream, vocab_size = corpus_mlm_stream(
+            args.data_path, args.vocab_path, args.batch_size,
+            args.seq_len, dupe_factor=args.dupe_factor)
+        kw["vocab_size"] = max(vocab_size, 128)
+        logger.info("pretraining on %s (vocab %d)", args.data_path,
+                    vocab_size)
     cfg = make(**kw)
 
     model = BertForPreTraining(cfg)
@@ -61,15 +78,22 @@ def main():
 
     rng = np.random.RandomState(0)
     t0 = time.time()
+    last = None
     for step in range(args.num_steps):
-        b_ids, b_tok, b_mask, b_mlm, b_nsp = synthetic_mlm_batch(rng, cfg)
+        if stream is not None:
+            b_ids, b_tok, b_mask, b_mlm, b_nsp = next(stream)
+        else:
+            b_ids, b_tok, b_mask, b_mlm, b_nsp = synthetic_mlm_batch(
+                rng, cfg)
         out = executor.run("train", feed_dict={
             ids: b_ids, tok: b_tok, mask: b_mask, mlm: b_mlm, nsp: b_nsp})
+        last = float(np.asarray(out[0]).reshape(-1)[0])
         if step % 10 == 0 or step == args.num_steps - 1:
             dt = time.time() - t0
             sps = (step + 1) * cfg.batch_size / dt
             logger.info("step %d loss=%.4f (%.1f samples/s)", step,
-                        float(np.asarray(out[0]).reshape(-1)[0]), sps)
+                        last, sps)
+    return last
 
 
 if __name__ == "__main__":

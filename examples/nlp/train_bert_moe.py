@@ -24,7 +24,7 @@ import numpy as np
 import hetu_tpu as ht
 from hetu_tpu.models import BertMoEConfig, BertMoEForPreTraining
 
-from common import synthetic_mlm_batch
+from common import corpus_mlm_stream, synthetic_mlm_batch
 
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
 logger = logging.getLogger("bert_moe")
@@ -49,7 +49,21 @@ def main():
                         help="data-parallel mesh extent")
     parser.add_argument("--learning-rate", type=float, default=1e-4)
     parser.add_argument("--num-steps", type=int, default=30)
+    parser.add_argument("--data-path", default=None,
+                        help="raw text corpus (one sentence per line, "
+                             "blank line between documents); synthetic "
+                             "batches when absent")
+    parser.add_argument("--vocab-path", default=None)
     args = parser.parse_args()
+
+    stream = None
+    if args.data_path:
+        stream, vocab_size = corpus_mlm_stream(
+            args.data_path, args.vocab_path, args.batch_size,
+            args.seq_len)
+        args.vocab_size = max(vocab_size, 128)
+        logger.info("pretraining on %s (vocab %d)", args.data_path,
+                    vocab_size)
 
     cfg = BertMoEConfig(
         vocab_size=args.vocab_size, hidden_size=args.hidden,
@@ -63,10 +77,11 @@ def main():
     model = BertMoEForPreTraining(cfg)
     ids = ht.placeholder_op("input_ids")
     tok = ht.placeholder_op("token_type_ids")
+    mask = ht.placeholder_op("attention_mask")
     mlm = ht.placeholder_op("masked_lm_labels")
     nsp = ht.placeholder_op("next_sentence_label")
-    loss, _, _ = model(ids, tok, masked_lm_labels=mlm,
-                       next_sentence_label=nsp)
+    loss, _, _ = model(ids, tok, attention_mask=mask,
+                       masked_lm_labels=mlm, next_sentence_label=nsp)
     opt = ht.optim.AdamWOptimizer(learning_rate=args.learning_rate,
                                   weight_decay=0.01)
     train_op = opt.minimize(loss)
@@ -80,9 +95,14 @@ def main():
     t0 = time.time()
     last = None
     for step in range(args.num_steps):
-        b_ids, b_tok, _m, b_mlm, b_nsp = synthetic_mlm_batch(rng, cfg)
+        if stream is not None:
+            b_ids, b_tok, b_mask, b_mlm, b_nsp = next(stream)
+        else:
+            b_ids, b_tok, b_mask, b_mlm, b_nsp = synthetic_mlm_batch(
+                rng, cfg)
         out = executor.run("train", feed_dict={
-            ids: b_ids, tok: b_tok, mlm: b_mlm, nsp: b_nsp})
+            ids: b_ids, tok: b_tok, mask: b_mask, mlm: b_mlm,
+            nsp: b_nsp})
         last = float(np.asarray(out[0]).reshape(-1)[0])
         if step % 10 == 0 or step == args.num_steps - 1:
             dt = time.time() - t0

@@ -33,6 +33,18 @@ def load_tokens(path, vocab_size):
     return np.fromfile(path, dtype=dtype).astype(np.int32)
 
 
+def load_text_corpus(path, vocab_path):
+    """Raw text corpus -> flat token stream through the pretraining
+    pipeline (hetu_tpu.pretraining_data); builds a wordpiece vocab from
+    the corpus when none is given.  Returns (tokens, vocab_size).  The
+    FULL stream feeds batches()'s random windows — no fixed-block
+    packing, so no tail tokens are lost."""
+    from hetu_tpu.pretraining_data import (
+        corpus_token_stream, load_or_build_tokenizer)
+    tok = load_or_build_tokenizer(path, vocab_path)
+    return corpus_token_stream(path, tok), len(tok.vocab)
+
+
 def batches(tokens, cfg, rng):
     # valid starts: 0 .. len - seq_len - 1 inclusive (targets need one
     # extra token); randint's high bound is exclusive
@@ -72,7 +84,11 @@ def main():
     parser.add_argument("--comm-mode", default=None)
     parser.add_argument("--data-path", default=None,
                         help="flat uint16/uint32 token file (nanoGPT "
-                             "format); synthetic task when absent")
+                             "format) or a raw .txt corpus; synthetic "
+                             "task when absent")
+    parser.add_argument("--vocab-path", default=None,
+                        help="wordpiece vocab.txt for .txt corpora; "
+                             "built from the corpus when absent")
     parser.add_argument("--use-flash", action=argparse.BooleanOptionalAction,
                         default=None,
                         help="pin flash on/off; default: auto (flash "
@@ -89,6 +105,14 @@ def main():
               use_flash=args.use_flash)
     if args.num_layers:
         kw["num_hidden_layers"] = args.num_layers
+
+    corpus_tokens = None
+    if args.data_path and args.data_path.endswith(".txt"):
+        corpus_tokens, vocab_size = load_text_corpus(
+            args.data_path, args.vocab_path)
+        kw["vocab_size"] = max(vocab_size, 128)
+        logger.info("tokenized %s: %d tokens, vocab %d", args.data_path,
+                    len(corpus_tokens), vocab_size)
     cfg = make(**kw)
 
     model = GPTForCausalLM(cfg)
@@ -111,7 +135,10 @@ def main():
     executor = ht.Executor(subgraphs, comm_mode=args.comm_mode)
 
     rng = np.random.RandomState(0)
-    if args.data_path and os.path.exists(args.data_path):
+    if corpus_tokens is not None:
+        stream = batches(corpus_tokens, cfg, rng)
+        logger.info("training on text corpus %s", args.data_path)
+    elif args.data_path and os.path.exists(args.data_path):
         stream = batches(load_tokens(args.data_path, cfg.vocab_size),
                          cfg, rng)
         logger.info("training on %s", args.data_path)

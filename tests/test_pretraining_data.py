@@ -1,0 +1,210 @@
+"""Raw-corpus pretraining pipeline (reference
+examples/nlp/bert/create_pretraining_data.py + load_data.py): corpus ->
+masked-LM/NSP instance arrays -> the models, hermetically from a
+checked-in text fixture."""
+
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.pretraining_data import (
+    IGNORE_INDEX, PretrainingBatches, build_wordpiece_vocab,
+    create_bert_pretraining_data, create_gpt_pretraining_data,
+    read_documents,
+)
+from hetu_tpu.tokenizers import BertTokenizer
+
+CORPUS = os.path.join(os.path.dirname(__file__), "fixtures",
+                      "tiny_corpus.txt")
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    vocab = str(tmp_path_factory.mktemp("vocab") / "vocab.txt")
+    build_wordpiece_vocab(CORPUS, out_path=vocab)
+    return BertTokenizer.from_pretrained(vocab)
+
+
+@pytest.fixture(scope="module")
+def bert_data(tokenizer):
+    return create_bert_pretraining_data(CORPUS, tokenizer,
+                                        max_seq_length=48, dupe_factor=3)
+
+
+class TestCorpusParsing:
+    def test_blank_lines_split_documents(self, tokenizer):
+        docs = read_documents(CORPUS, tokenizer)
+        assert len(docs) == 6          # fixture has 6 paragraphs
+        assert all(len(d) >= 4 for d in docs)   # sentences per doc
+
+    def test_vocab_builder_roundtrip(self, tokenizer):
+        # specials present and corpus words tokenize without [UNK]
+        for sp in ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"):
+            assert sp in tokenizer.vocab
+        toks = tokenizer.tokenize("the river carried cold water")
+        ids = tokenizer.convert_tokens_to_ids(toks)
+        assert tokenizer.vocab["[UNK]"] not in ids
+
+
+class TestBertInstances:
+    def test_shapes_and_ranges(self, bert_data, tokenizer):
+        ids = bert_data["input_ids"]
+        n, s = ids.shape
+        assert s == 48 and n >= 20
+        assert ids.min() >= 0 and ids.max() < len(tokenizer.vocab)
+        for key in ("token_type_ids", "attention_mask",
+                    "masked_lm_labels"):
+            assert bert_data[key].shape == (n, s)
+        assert bert_data["next_sentence_label"].shape == (n,)
+
+    def test_instance_structure(self, bert_data, tokenizer):
+        """[CLS] a [SEP] b [SEP] with segment ids 0/1 and padding."""
+        v = tokenizer.vocab
+        ids = bert_data["input_ids"]
+        seg = bert_data["token_type_ids"]
+        mask = bert_data["attention_mask"]
+        assert (ids[:, 0] == v["[CLS]"]).all()
+        for j in range(ids.shape[0]):
+            valid = int(mask[j].sum())
+            # exactly two [SEP]s among valid positions, last valid is one
+            seps = np.where(ids[j, :valid] == v["[SEP]"])[0]
+            assert len(seps) == 2 and seps[-1] == valid - 1
+            # segment 1 exactly between the two seps
+            assert (seg[j, :seps[0] + 1] == 0).all()
+            assert (seg[j, seps[0] + 1:valid] == 1).all()
+            # padding after valid
+            assert (ids[j, valid:] == v["[PAD]"]).all()
+            assert (mask[j, valid:] == 0).all()
+
+    def test_masking_statistics(self, bert_data, tokenizer):
+        """~15% of tokens masked (<= max_predictions), labels only at
+        corrupted-or-kept positions, and most corrupted positions are
+        the [MASK] token (80/10/10)."""
+        v = tokenizer.vocab
+        ids = bert_data["input_ids"]
+        mlm = bert_data["masked_lm_labels"]
+        labeled = mlm != IGNORE_INDEX
+        per_row = labeled.sum(axis=1)
+        assert (per_row >= 1).all() and (per_row <= 20).all()
+        frac_mask_tok = (ids[labeled] == v["[MASK]"]).mean()
+        assert 0.6 < frac_mask_tok < 0.95      # 80% +/- sampling noise
+        # labels are real vocab ids, never specials like [PAD]
+        assert mlm[labeled].min() >= 0
+        assert (mlm[labeled] < len(v)).all()
+
+    def test_nsp_labels_are_mixed(self, bert_data):
+        m = bert_data["next_sentence_label"].mean()
+        assert 0.1 < m < 0.9
+
+    def test_deterministic_given_seed(self, tokenizer):
+        a = create_bert_pretraining_data(CORPUS, tokenizer,
+                                         max_seq_length=32, dupe_factor=1,
+                                         seed=7)
+        b = create_bert_pretraining_data(CORPUS, tokenizer,
+                                         max_seq_length=32, dupe_factor=1,
+                                         seed=7)
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+        np.testing.assert_array_equal(a["masked_lm_labels"],
+                                      b["masked_lm_labels"])
+
+
+class TestGptPacking:
+    def test_blocks_and_shifted_labels(self, tokenizer):
+        g = create_gpt_pretraining_data(CORPUS, tokenizer, seq_len=32)
+        ids, labels = g["input_ids"], g["labels"]
+        assert ids.shape == labels.shape and ids.shape[0] >= 5
+        np.testing.assert_array_equal(labels[:, :-1], ids[:, 1:])
+        assert (labels[:, -1] == IGNORE_INDEX).all()
+
+    def test_too_small_corpus_raises(self, tokenizer):
+        with pytest.raises(ValueError):
+            create_gpt_pretraining_data(CORPUS, tokenizer, seq_len=10 ** 6)
+
+
+class TestBatches:
+    def test_epoch_covers_all_and_reshuffles(self, bert_data):
+        bs = 4
+        it = PretrainingBatches(bert_data, bs, seed=3)
+        e1 = [b["input_ids"] for b in it]
+        e2 = [b["input_ids"] for b in it]
+        n = bert_data["input_ids"].shape[0]
+        assert len(e1) == n // bs          # drop-last epoch length
+        # reshuffled between epochs (drop-last may also rotate which
+        # rows are kept, so only the ordering difference is asserted)
+        assert not np.array_equal(np.concatenate(e1), np.concatenate(e2))
+
+    def test_batch_too_large_raises(self, bert_data):
+        with pytest.raises(ValueError):
+            PretrainingBatches(bert_data, 10 ** 6)
+
+
+class TestEndToEnd:
+    def test_bert_pretrains_on_fixture_corpus(self, tokenizer, bert_data):
+        """The reference's integration bar (train_hetu_bert.py on real
+        data): loss on real masked-LM batches from the corpus drops."""
+        from hetu_tpu.models import BertConfig, BertForPreTraining
+        cfg = BertConfig(vocab_size=len(tokenizer.vocab), hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=2,
+                         intermediate_size=64, batch_size=8, seq_len=48,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        m = BertForPreTraining(cfg, name="corpus_bert")
+        ids = ht.placeholder_op("c_ids")
+        tt = ht.placeholder_op("c_tt")
+        am = ht.placeholder_op("c_am")
+        mlm = ht.placeholder_op("c_mlm")
+        nsp = ht.placeholder_op("c_nsp")
+        loss, _, _ = m(ids, tt, am, mlm, nsp)
+        train = ht.optim.AdamOptimizer(learning_rate=3e-3).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]})
+        first = last = None
+        for epoch in range(30):
+            for b in PretrainingBatches(bert_data, 8, seed=epoch):
+                out = ex.run("train", feed_dict={
+                    ids: b["input_ids"], tt: b["token_type_ids"],
+                    am: b["attention_mask"],
+                    mlm: b["masked_lm_labels"],
+                    nsp: b["next_sentence_label"]})
+                last = float(np.asarray(out[0]))
+                if first is None:
+                    first = last
+        assert last < first * 0.6, (first, last)
+
+    def test_train_bert_example_with_data_path(self):
+        import importlib.util
+        import sys
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "nlp", "train_bert.py")
+        spec = importlib.util.spec_from_file_location("ex_bert_corpus",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        old = sys.argv
+        sys.argv = ["prog", "--data-path", CORPUS, "--batch-size", "4",
+                    "--seq-len", "32", "--num-layers", "1",
+                    "--num-steps", "3"]
+        try:
+            last = mod.main()
+        finally:
+            sys.argv = old
+        assert np.isfinite(last)
+
+    def test_train_gpt_example_with_text_corpus(self):
+        import importlib.util
+        import sys
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "nlp", "train_gpt.py")
+        spec = importlib.util.spec_from_file_location("ex_gpt_corpus",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        old = sys.argv
+        sys.argv = ["prog", "--data-path", CORPUS, "--batch-size", "2",
+                    "--seq-len", "32", "--num-layers", "1",
+                    "--num-steps", "3"]
+        try:
+            mod.main()
+        finally:
+            sys.argv = old
