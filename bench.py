@@ -197,14 +197,20 @@ def _build_lm(batch, seq, hidden, heads, layers_n, vocab, use_flash, mesh,
 
 
 def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
-              hidden=768, heads=12, vocab=30522, iters=20):
+              hidden=768, heads=12, vocab=30522, iters=20,
+              keep_batch=False):
     import jax
     from hetu_tpu.parallel.mesh import make_mesh
 
     n_chips = max(1, jax.device_count())
     if reduced:
-        per_chip_batch, seq, hidden, heads, layers_n, vocab = \
-            4, 64, 128, 4, 2, 1000
+        # keep_batch: the sweep varies per_chip_batch as a REAL axis even
+        # at reduced scale — overriding it here would make every sweep
+        # cell measure the identical workload and the batch ranking
+        # fictitious
+        if not keep_batch:
+            per_chip_batch = 4
+        seq, hidden, heads, layers_n, vocab = 64, 128, 4, 2, 1000
         iters = 3
     batch = per_chip_batch * n_chips
     mesh = make_mesh({"dp": n_chips}) if n_chips > 1 else None
@@ -215,6 +221,11 @@ def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
     # crossover is taken at 1024.  Reduced (CPU) scale keeps flash on so
     # the kernel path stays exercised in verification runs.
     use_flash = (platform == "tpu" and seq >= 1024) or reduced
+    # sweep/ablation override: pin the attention impl regardless of the
+    # crossover default (HETU_BENCH_SWEEP drives both impls per batch)
+    forced = os.environ.get("HETU_BENCH_FORCE_FLASH")
+    if forced is not None:
+        use_flash = forced == "1"
     flash_err = None
     try:
         ex = _build_lm(batch, seq, hidden, heads, layers_n, vocab,
@@ -273,6 +284,31 @@ print("PROBE_RESULT " + json.dumps(r["value"]))
 """
 
 
+def _run_probe(src, deadline, timeout_cap=900.0, min_left=60.0):
+    """One subprocess probe under the shared budget policy: returns the
+    json-decoded PROBE_RESULT payload, or an error string.  Shared by
+    the bert_base batch probes and the ablation sweep so timeout/parse
+    fixes land once."""
+    import subprocess
+    import sys
+    left = deadline - time.monotonic()
+    if left < min_left:
+        return "skipped (probe budget spent)"
+    try:
+        r = subprocess.run([sys.executable, "-c", src],
+                           capture_output=True, text=True,
+                           timeout=min(timeout_cap, left), cwd=_HERE)
+        val = next((ln.split(" ", 1)[1] for ln in r.stdout.splitlines()
+                    if ln.startswith("PROBE_RESULT ")), None)
+        if val is not None:
+            return json.loads(val)
+        return (r.stderr.strip().splitlines() or ["failed"])[-1][:200]
+    except subprocess.TimeoutExpired:
+        return "probe timed out (tunnel degraded?)"
+    except Exception as e:
+        return f"{type(e).__name__}"[:60]
+
+
 def bench_bert_base(platform, reduced):
     """BERT-base TRUE: 12 layers, seq 512 (BASELINE config 2 for real).
 
@@ -288,30 +324,12 @@ def bench_bert_base(platform, reduced):
     if fixed is not None or reduced:
         return _bench_lm(platform, reduced, layers_n=12, seq=512,
                          per_chip_batch=int(fixed or 32), iters=10)
-    import subprocess
-    import sys
     probes = {}
     deadline = time.monotonic() + 1500.0   # total probe budget
     for b in (32, 48, 64):
-        left = deadline - time.monotonic()
-        if left < 60.0:
-            probes[b] = "skipped (probe budget spent)"
-            continue
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 _PROBE_LM_SRC.format(platform=platform, b=b)],
-                capture_output=True, text=True,
-                timeout=min(900.0, left), cwd=_HERE)
-            val = next((ln.split(" ", 1)[1]
-                        for ln in r.stdout.splitlines()
-                        if ln.startswith("PROBE_RESULT ")), None)
-            probes[b] = float(json.loads(val)) if val else \
-                (r.stderr.strip().splitlines() or ["failed"])[-1][:60]
-        except subprocess.TimeoutExpired:
-            probes[b] = "probe timed out (tunnel degraded?)"
-        except Exception as e:
-            probes[b] = f"{type(e).__name__}"[:60]
+        got = _run_probe(_PROBE_LM_SRC.format(platform=platform, b=b),
+                         deadline)
+        probes[b] = float(got) if isinstance(got, (int, float)) else got
     numeric = {b: v for b, v in probes.items()
                if isinstance(v, (int, float))}
     if platform == "tpu" and not numeric:
@@ -707,10 +725,148 @@ _CONFIGS = {
 }
 
 
+_SWEEP_FILE = os.path.join(_HERE, "SWEEP_BERT_BASE.json")
+
+_PROBE_SWEEP_SRC = """
+import json, os
+os.environ["HETU_BENCH_FORCE_FLASH"] = {flash!r}
+if {fused!r} == "1":
+    os.environ["HETU_BENCH_FUSED_HEAD"] = "1"
+else:
+    os.environ.pop("HETU_BENCH_FUSED_HEAD", None)   # parent env leak
+import bench
+r = bench._bench_lm({platform!r}, {reduced!r}, layers_n=12, seq=512,
+                    per_chip_batch={b}, iters={iters})
+print("PROBE_RESULT " + json.dumps(
+    {{"step_time_ms": r["step_time_ms"],
+      "flash_attention": r["flash_attention"],
+      "flash_fallback": r.get("flash_fallback")}}))
+"""
+
+
+def _sweep_cell_from_result(cell, r, want_flash):
+    """Record a measured cell, refusing to mislabel a flash fallback as
+    a flash measurement (the fitted attention delta would be ~0 and the
+    artifact's impl ranking meaningless)."""
+    if want_flash and not r.get("flash_attention", want_flash):
+        cell["error"] = ("flash fell back to xla: "
+                         + str(r.get("flash_fallback"))[:160])
+    else:
+        cell["step_time_ms"] = r["step_time_ms"]
+        if r.get("flash_fallback"):
+            cell["flash_fallback"] = r["flash_fallback"]
+
+
+def sweep_bert(platform, reduced, batches=(16, 32, 48, 64)):
+    """On-chip ablation sweep over (per-chip batch x attention impl x
+    LM-head variant) -> SWEEP_BERT_BASE.json, the measured strategy
+    space the exec-config planner is validated against
+    (planner/exec_plan.py; VERDICT r3 item 6).
+
+    Each cell runs in a subprocess with a hard timeout (same rationale
+    as bench_bert_base: a wedged tunnel must cost one cell, not the
+    sweep).  Reduced mode measures the tiny-graph grid in-process with
+    the batch axis kept REAL (keep_batch) — the artifact then records a
+    CPU-measured space, still a genuine measured ordering for the
+    validation loop to close over."""
+    import itertools as _it
+    if reduced:
+        batches = (2, 4, 8)
+    grid = list(_it.product(batches, ("xla", "flash"),
+                            ("materialized", "fused")))
+    rows = []
+    deadline = time.monotonic() + 3600.0
+    for b, attn, head in grid:
+        cell = {"batch": b, "attention": attn, "head": head}
+        if reduced:
+            old_flash = os.environ.get("HETU_BENCH_FORCE_FLASH")
+            old_fused = os.environ.get("HETU_BENCH_FUSED_HEAD")
+            os.environ["HETU_BENCH_FORCE_FLASH"] = \
+                "1" if attn == "flash" else "0"
+            if head == "fused":
+                os.environ["HETU_BENCH_FUSED_HEAD"] = "1"
+            else:
+                os.environ.pop("HETU_BENCH_FUSED_HEAD", None)
+            try:
+                r = _bench_lm(platform, True, layers_n=12, seq=512,
+                              per_chip_batch=b, iters=3, keep_batch=True)
+                _sweep_cell_from_result(cell, r, attn == "flash")
+            except Exception as e:
+                cell["error"] = f"{type(e).__name__}: {e}"[:200]
+            finally:
+                if old_flash is None:
+                    os.environ.pop("HETU_BENCH_FORCE_FLASH", None)
+                else:
+                    os.environ["HETU_BENCH_FORCE_FLASH"] = old_flash
+                if old_fused is None:
+                    os.environ.pop("HETU_BENCH_FUSED_HEAD", None)
+                else:
+                    os.environ["HETU_BENCH_FUSED_HEAD"] = old_fused
+        else:
+            src = _PROBE_SWEEP_SRC.format(
+                flash="1" if attn == "flash" else "0",
+                fused="1" if head == "fused" else "0",
+                platform=platform, reduced=False, b=b, iters=8)
+            got = _run_probe(src, deadline, min_left=120.0)
+            if isinstance(got, dict):
+                _sweep_cell_from_result(cell, got, attn == "flash")
+            else:
+                cell["error"] = str(got)
+        rows.append(cell)
+
+    art = {
+        "platform": platform,
+        "reduced_scale": reduced,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "model": ("bert_base 12L seq 512" if not reduced
+                  else "reduced LM 2L seq 64 (batch axis real)"),
+        "objective": "samples/sec/chip (throughput = batch / step_time)",
+        "configs": rows,
+    }
+    try:
+        from hetu_tpu.planner.exec_plan import validate_against_sweep
+        art["planner_validation"] = validate_against_sweep(art)
+    except Exception as e:
+        art["planner_validation"] = {
+            "error": f"{type(e).__name__}: {e}"[:300]}
+    # same overwrite discipline as the matrix: a reduced/CPU sweep must
+    # never clobber a full-scale on-chip artifact
+    existing = None
+    try:
+        with open(_SWEEP_FILE) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if (existing is not None and not existing.get("reduced_scale")
+            and existing.get("platform") == "tpu" and reduced):
+        art["not_written"] = ("full-scale TPU sweep already recorded; "
+                              "reduced run not persisted")
+        return art
+    with open(_SWEEP_FILE, "w") as f:
+        json.dump(art, f, indent=1)
+    return art
+
+
 def main():
     platform, bringup_err = _bring_up_backend()
     reduced = bool(os.environ.get("HETU_BENCH_SMALL")) or \
         platform in ("cpu", "cpu-fallback")
+
+    if os.environ.get("HETU_BENCH_SWEEP"):
+        art = sweep_bert(platform, reduced)
+        pv = art.get("planner_validation", {})
+        print(json.dumps({
+            "metric": "bert_sweep_planner_choice_ok",
+            "value": (1.0 if pv.get("ok") else 0.0),
+            "unit": "bool", "vs_baseline": None,
+            "platform": platform,
+            "argmax_match": pv.get("argmax_match"),
+            "regret": pv.get("regret"),
+            "spearman_rho": pv.get("spearman_rho"),
+            "measured_best": pv.get("measured_best"),
+            "predicted_best": pv.get("predicted_best"),
+            "sweep_file": os.path.basename(_SWEEP_FILE)}))
+        return
 
     sel = os.environ.get("HETU_BENCH_CONFIGS")
     names = [n.strip() for n in sel.split(",")] if sel else list(_CONFIGS)

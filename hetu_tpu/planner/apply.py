@@ -88,8 +88,14 @@ class AutoParallel(BaseSearchingStrategy):
 
 
 def plan_to_json(plan):
-    return {"cost_s": plan.cost,
-            "mesh": plan.mesh_axes(),
-            "stages": plan.stage_assignment(),
-            "layers": [{"name": l.name, "strategy": str(s)}
-                       for l, s in zip(plan.layers, plan.strategies)]}
+    out = {"cost_s": plan.cost,
+           "mesh": plan.mesh_axes(),
+           "stages": plan.stage_assignment(),
+           "layers": [{"name": l.name, "strategy": str(s)}
+                      for l, s in zip(plan.layers, plan.strategies)]}
+    if plan.cluster is not None and \
+            hasattr(plan.cluster, "assumed_constants"):
+        # which cost-model constants ranked this plan WITHOUT a
+        # measurement (ICI/DCN bandwidth can't be measured on one chip)
+        out["assumed_constants"] = plan.cluster.assumed_constants()
+    return out

@@ -399,12 +399,30 @@ def calibrate_chip(small=False):
 
 def load_calibration(path=CALIBRATION_FILE, n_devices=None):
     """ClusterSpec from a checked-in calibration artifact; measured
-    fields override the analytic defaults."""
+    fields override the analytic defaults.  Provenance is recorded per
+    constant: what the artifact measured is 'measured'; ICI/DCN
+    bandwidth stay 'spec-assumed' (unmeasurable on one chip — the
+    artifact's unmeasurable_on_one_chip list says so) so plan output
+    can flag rankings that rest on them."""
     with open(path) as f:
         art = json.load(f)
     spec = ClusterSpec()
     for k, v in art.get("cluster_spec", {}).items():
         setattr(spec, k, v)
+        spec.provenance[k] = "measured"
+    # flops_per_sec is max() over the matmul curve: if the peak dim's
+    # reading was clamped TO the spec-sheet value, the constant is a
+    # spec number, not a measurement — say so (matmul_clamped_to_spec
+    # exists in post-r4 artifacts; older ones default to 'measured')
+    curve = art.get("matmul_tflops_bf16", {})
+    clamped = art.get("matmul_clamped_to_spec", {})
+    peaks = [d for d, v in curve.items() if v is not None]
+    if peaks and "flops_per_sec" in spec.provenance:
+        peak_dim = max(peaks, key=lambda d: curve[d])
+        if clamped.get(peak_dim):
+            spec.provenance["flops_per_sec"] = "spec-clamped"
+    for k in ("ici_bandwidth", "dcn_bandwidth"):
+        spec.provenance.setdefault(k, "spec-assumed")
     if n_devices is not None:
         spec.n_devices = n_devices
     return spec

@@ -67,6 +67,24 @@ class ClusterSpec:
     overlap: float = 0.7               # fraction of comm hidden by compute
     bytes_per_param: int = 4           # fp32 master params
     bytes_per_act: int = 2             # bf16 activations
+    # constant -> how it was obtained: 'analytic-default' (this class's
+    # literals), 'measured' (a live probe wrote it), or 'spec-assumed'
+    # (spec-sheet value that CANNOT be measured on the available
+    # hardware, e.g. ICI/DCN bandwidth on one chip).  load_calibration
+    # fills this; plan_to_json surfaces the not-measured ones so a plan
+    # consumer can see which cost terms ranked layouts on assumptions.
+    provenance: dict = field(default_factory=dict)
+
+    def assumed_constants(self):
+        """The constants the cost model used WITHOUT a measurement."""
+        keys = ("flops_per_sec", "mfu", "ici_bandwidth", "dcn_bandwidth",
+                "overlap", "hbm_bytes")
+        return {k: {"value": getattr(self, k),
+                    "provenance": self.provenance.get(
+                        k, "analytic-default")}
+                for k in keys
+                if self.provenance.get(k, "analytic-default")
+                != "measured"}
 
     def collective_bw(self, axis_size, over_dcn=False):
         bw = self.dcn_bandwidth if over_dcn else self.ici_bandwidth

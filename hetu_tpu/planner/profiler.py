@@ -142,21 +142,26 @@ def measure_cluster(mesh=None, n_devices=None, hbm_bytes=None,
         else jax.device_count())
     achieved = profile_matmul_throughput(dim=probe_dim)
     spec.flops_per_sec = achieved
+    spec.provenance["flops_per_sec"] = "measured"
     spec.mfu = 1.0  # 'achieved' already folds utilization in
+    spec.provenance["mfu"] = "measured"
     if hbm_bytes:
         spec.hbm_bytes = hbm_bytes
+        spec.provenance["hbm_bytes"] = "measured"   # caller-supplied cap
     else:
         try:
             stats = jax.devices()[0].memory_stats()
             if stats and "bytes_limit" in stats:
                 spec.hbm_bytes = float(stats["bytes_limit"])
+                spec.provenance["hbm_bytes"] = "measured"
         except Exception:
             pass
     if mesh is not None:
         for axis in mesh.shape:
             if mesh.shape[axis] > 1:
                 bw = profile_collective_bandwidth(mesh, axis, size_mb=4)
-                spec.ici_bandwidth = min(spec.ici_bandwidth, bw) \
-                    if np.isfinite(bw) else spec.ici_bandwidth
+                if np.isfinite(bw):
+                    spec.ici_bandwidth = min(spec.ici_bandwidth, bw)
+                    spec.provenance["ici_bandwidth"] = "measured"
                 break
     return spec

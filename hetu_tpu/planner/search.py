@@ -128,6 +128,14 @@ class ParallelPlan:
                  f"mesh {self.mesh_axes()}"]
         for l, s in zip(self.layers, self.strategies):
             lines.append(f"  {l.name}: {s}")
+        if self.cluster is not None and \
+                hasattr(self.cluster, "assumed_constants"):
+            assumed = self.cluster.assumed_constants()
+            if assumed:
+                lines.append(
+                    "  [cost-model constants NOT from measurement: "
+                    + ", ".join(f"{k} ({v['provenance']})"
+                                for k, v in assumed.items()) + "]")
         return "\n".join(lines)
 
 

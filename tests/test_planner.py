@@ -386,3 +386,114 @@ class TestChipCalibration:
         plan = PlannerSearch(layers, global_batch_size=256,
                              cluster=spec).search()
         assert plan is not None
+
+
+class TestExecConfigPlanner:
+    """Single-chip execution-config ranking closed over the measured
+    ablation sweep (VERDICT r3 item 6; reference Galvatron profiles
+    components then ranks full configs, utils/cost_model.py:38-60)."""
+
+    @staticmethod
+    def _synthetic_sweep(noise=0.0, seed=0):
+        """Rows from a known generative model: per-sample base 2ms,
+        flash +0.5ms/sample, fused head +0.3ms/sample, fixed 5ms."""
+        import numpy as np
+        rng = np.random.RandomState(seed)
+        rows = []
+        for b in (8, 16, 32, 64):
+            for a in ("xla", "flash"):
+                for h in ("materialized", "fused"):
+                    t = b * (2.0 + 0.5 * (a == "flash")
+                             + 0.3 * (h == "fused")) + 5.0
+                    rows.append({"batch": b, "attention": a, "head": h,
+                                 "step_time_ms":
+                                     t * (1 + noise * rng.randn())})
+        return rows
+
+    def test_model_recovers_generative_components(self):
+        from hetu_tpu.planner.exec_plan import ExecConfigModel
+        import numpy as np
+        m = ExecConfigModel().fit(self._synthetic_sweep())
+        # generative model has no quadratic term: c2 must fit ~0
+        np.testing.assert_allclose(
+            m.coef, [2.0, 0.0, 0.5, 0.3, 5.0], atol=1e-7)
+
+    def test_argmax_match_with_heldout_winner(self):
+        """The strict split: the measured-best config is EXCLUDED from
+        the fit and the model must still predict it on top."""
+        from hetu_tpu.planner.exec_plan import validate_against_sweep
+        rep = validate_against_sweep(self._synthetic_sweep(noise=0.02))
+        assert rep["ok"], rep
+        assert rep["regret"] <= rep["regret_tol"]
+        assert rep["spearman_rho"] > 0.9
+        assert rep["n_fit"] == rep["n_configs"] - 1
+
+    def test_checked_in_sweep_artifact_validates(self):
+        """SWEEP_BERT_BASE.json (written by HETU_BENCH_SWEEP=1
+        bench.py) must carry a planner_validation whose argmax matches —
+        the closed loop the VERDICT asked for, on whatever platform
+        measured the artifact."""
+        import json
+        import os
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "SWEEP_BERT_BASE.json")
+        if not os.path.exists(path):
+            import pytest
+            pytest.skip("no sweep artifact checked in")
+        with open(path) as f:
+            art = json.load(f)
+        pv = art.get("planner_validation", {})
+        assert pv.get("ok") is True, pv
+        # re-validate from the raw rows (don't trust the embedded field)
+        from hetu_tpu.planner.exec_plan import validate_against_sweep
+        rep = validate_against_sweep(art)
+        assert rep["ok"], rep
+        assert rep["regret"] <= rep["regret_tol"], rep
+
+    def test_negative_extrapolation_ranks_last(self):
+        from hetu_tpu.planner.exec_plan import ExecConfigModel
+        m = ExecConfigModel()
+        m.coef = [0.1, 0.0, 0.0, 0.0, -100.0]  # negative times, small b
+        import numpy as np
+        m.coef = np.asarray(m.coef)
+        cfg = {"batch": 4, "attention": "xla", "head": "materialized"}
+        assert m.predict_throughput(cfg) == 0.0
+
+
+class TestPlanAssumedConstants:
+    """ICI/DCN constants the one-chip calibration cannot measure are
+    flagged in plan output (VERDICT r3 item 6 tail)."""
+
+    def test_load_calibration_marks_provenance(self, tmp_path):
+        import json
+        from hetu_tpu.planner.chip_calibration import (calibrate_chip,
+                                                       load_calibration)
+        art = calibrate_chip(small=True)
+        p = tmp_path / "cal.json"
+        p.write_text(json.dumps(art))
+        spec = load_calibration(str(p), n_devices=8)
+        assert spec.provenance["flops_per_sec"] == "measured"
+        assert spec.provenance["ici_bandwidth"] == "spec-assumed"
+        assert spec.provenance["dcn_bandwidth"] == "spec-assumed"
+        assumed = spec.assumed_constants()
+        assert "ici_bandwidth" in assumed
+        assert "flops_per_sec" not in assumed
+
+    def test_plan_json_and_describe_surface_assumptions(self, tmp_path):
+        import json
+        from hetu_tpu.planner import (LayerSpec, PlannerSearch,
+                                      plan_to_json)
+        from hetu_tpu.planner.chip_calibration import (calibrate_chip,
+                                                       load_calibration)
+        art = calibrate_chip(small=True)
+        p = tmp_path / "cal.json"
+        p.write_text(json.dumps(art))
+        spec = load_calibration(str(p), n_devices=8)
+        layers = [LayerSpec.transformer_encoder(64, 32) for _ in range(4)]
+        plan = PlannerSearch(layers, global_batch_size=32,
+                             cluster=spec).search()
+        j = plan_to_json(plan)
+        assert "ici_bandwidth" in j["assumed_constants"]
+        assert j["assumed_constants"]["ici_bandwidth"]["provenance"] == \
+            "spec-assumed"
+        assert "NOT from measurement" in plan.describe()
