@@ -524,14 +524,15 @@ def bench_resnet18(platform, reduced):
 # config: Wide&Deep CTR through the PS + HET-cache hybrid path
 # --------------------------------------------------------------------- #
 
-def bench_ctr_hybrid(platform, reduced):
+def _ctr_hybrid_once(platform, reduced, *, batch=1024, iters=20,
+                     feature_dim=1_000_000, subgraph="train"):
+    """One measured hybrid CTR config; shared by the matrix entry and
+    the rows-per-chip ladder."""
     import hetu_tpu as ht
     from hetu_tpu.models import ctr as ctr_models
 
-    batch, iters = 1024, 20
-    feature_dim = 1_000_000
     if reduced:
-        batch, iters, feature_dim = 128, 3, 10_000
+        batch, iters, feature_dim = 128, 3, min(feature_dim, 10_000)
     cache_bound = max(feature_dim // 10, 1024)
     rng = np.random.RandomState(0)
     n_pool = iters + 2
@@ -541,15 +542,23 @@ def bench_ctr_hybrid(platform, reduced):
     dense = rng.randn(n_pool * batch, 13).astype(np.float32)
     label = np.eye(2, dtype=np.float32)[
         rng.randint(0, 2, n_pool * batch)]
-    d = ht.dataloader_op([ht.Dataloader(dense, batch, "train")])
-    s = ht.dataloader_op([ht.Dataloader(sparse, batch, "train")])
-    y_ = ht.dataloader_op([ht.Dataloader(label, batch, "train")])
+    d = ht.dataloader_op([ht.Dataloader(dense, batch, subgraph)])
+    s = ht.dataloader_op([ht.Dataloader(sparse, batch, subgraph)])
+    y_ = ht.dataloader_op([ht.Dataloader(label, batch, subgraph)])
     loss, pred, _lab, train = ctr_models.wdl_criteo(
         d, s, y_, feature_dimension=feature_dim, embedding_size=16)
-    ex = ht.Executor({"train": [loss, train]}, comm_mode="Hybrid",
-                     cstable_policy="lfu", cache_bound=cache_bound)
+    # bf16 wire: phase A casts the gathered rows host-side and the step
+    # emits bf16 grads, halving BOTH directions of the host link — the
+    # link IS the hybrid path's bottleneck (the PS accumulates fp32
+    # regardless).  HETU_BENCH_CTR_FP32=1 pins the old full-width wire.
+    mp = None if os.environ.get("HETU_BENCH_CTR_FP32") else "bf16"
+    t_init = time.monotonic()
+    ex = ht.Executor({subgraph: [loss, train]}, comm_mode="Hybrid",
+                     cstable_policy="lfu", cache_bound=cache_bound,
+                     mixed_precision=mp)
+    init_s = time.monotonic() - t_init
     dt, host_frac = _time_steps(
-        lambda: ex.run("train"), iters,
+        lambda: ex.run(subgraph), iters,
         lambda out: float(np.asarray(out[0]).reshape(-1)[0]))
     hit_rate = None
     if ex.cstables:
@@ -563,11 +572,102 @@ def bench_ctr_hybrid(platform, reduced):
         "step_time_ms": round(dt * 1e3, 3),
         "host_fraction": round(host_frac, 4),
         "cache_hit_rate": hit_rate,
+        "table_init_s": round(init_s, 2),
         "reduced_scale": reduced,
         "config": {"batch": batch, "feature_dim": feature_dim,
                    "fields": 26, "embedding_size": 16,
-                   "cache_bound": cache_bound, "policy": "lfu"},
+                   "cache_bound": cache_bound, "policy": "lfu",
+                   "wire_dtype": mp or "fp32"},
     }
+
+
+def bench_ctr_hybrid(platform, reduced):
+    return _ctr_hybrid_once(platform, reduced)
+
+
+_CTR_ROWS_FILE = os.path.join(_HERE, "BENCH_CTR_ROWS.json")
+
+_PROBE_CTR_ROWS_SRC = """
+import json
+import bench
+r = bench._ctr_hybrid_once({platform!r}, False, feature_dim={rows},
+                           iters=8)
+print("PROBE_RESULT " + json.dumps(r))
+"""
+
+
+def _persist_artifact(path, art, reduced, has_data):
+    """Shared artifact-persistence policy for the sweep modes: a
+    reduced/CPU run never overwrites a full-scale TPU record, and an
+    all-error run never overwrites a record that has data.  Sets
+    art['not_written'] when skipped; returns whether it wrote."""
+    existing = None
+    try:
+        with open(path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if existing is not None:
+        if (not existing.get("reduced_scale")
+                and existing.get("platform") == "tpu" and reduced):
+            art["not_written"] = ("full-scale TPU record already "
+                                  "present; reduced run not persisted")
+            return False
+        if not has_data:
+            art["not_written"] = ("run produced no measured data; "
+                                  "keeping the existing record")
+            return False
+    with open(path, "w") as f:
+        json.dump(art, f, indent=1)
+    return True
+
+
+def sweep_ctr_rows(platform, reduced):
+    """BASELINE's third headline metric: max embedding rows trainable
+    per chip.  Climb a table-size ladder (each rung a subprocess with a
+    hard timeout, so an OOM or wedge costs one rung); max_rows = the
+    largest table that completes training steps.  Writes
+    BENCH_CTR_ROWS.json with the full rows/s curve."""
+    ladder = (1_000_000, 4_000_000, 16_000_000, 64_000_000, 256_000_000)
+    if reduced:
+        ladder = (10_000, 40_000)
+    rungs = []
+    deadline = time.monotonic() + 3600.0
+    for rows in ladder:
+        if reduced:
+            try:
+                # reduced=False bypasses _ctr_hybrid_once's shape clamp
+                # (the ladder IS the variable); tag the rung honestly
+                r = _ctr_hybrid_once(platform, False, feature_dim=rows,
+                                     iters=3, batch=128,
+                                     subgraph=f"rows{rows}")
+                r["reduced_scale"] = True
+                rungs.append({"rows": rows, **r})
+            except Exception as e:
+                rungs.append({"rows": rows,
+                              "error": f"{type(e).__name__}: {e}"[:200]})
+                break
+        else:
+            got = _run_probe(
+                _PROBE_CTR_ROWS_SRC.format(platform=platform, rows=rows),
+                deadline, timeout_cap=1800.0, min_left=300.0)
+            if isinstance(got, dict):
+                rungs.append({"rows": rows, **got})
+            else:
+                rungs.append({"rows": rows, "error": str(got)})
+                break
+    ok = [r for r in rungs if "error" not in r]
+    art = {
+        "platform": platform,
+        "reduced_scale": reduced,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "metric": "max embedding rows trainable per chip "
+                  "(host PS + HET cache, dim 16, fp32 server rows)",
+        "max_rows": max((r["rows"] for r in ok), default=0),
+        "rungs": rungs,
+    }
+    _persist_artifact(_CTR_ROWS_FILE, art, reduced, has_data=bool(ok))
+    return art
 
 
 # --------------------------------------------------------------------- #
@@ -829,21 +929,8 @@ def sweep_bert(platform, reduced, batches=(16, 32, 48, 64)):
     except Exception as e:
         art["planner_validation"] = {
             "error": f"{type(e).__name__}: {e}"[:300]}
-    # same overwrite discipline as the matrix: a reduced/CPU sweep must
-    # never clobber a full-scale on-chip artifact
-    existing = None
-    try:
-        with open(_SWEEP_FILE) as f:
-            existing = json.load(f)
-    except (OSError, ValueError):
-        pass
-    if (existing is not None and not existing.get("reduced_scale")
-            and existing.get("platform") == "tpu" and reduced):
-        art["not_written"] = ("full-scale TPU sweep already recorded; "
-                              "reduced run not persisted")
-        return art
-    with open(_SWEEP_FILE, "w") as f:
-        json.dump(art, f, indent=1)
+    _persist_artifact(_SWEEP_FILE, art, reduced,
+                      has_data=any("step_time_ms" in r for r in rows))
     return art
 
 
@@ -851,6 +938,25 @@ def main():
     platform, bringup_err = _bring_up_backend()
     reduced = bool(os.environ.get("HETU_BENCH_SMALL")) or \
         platform in ("cpu", "cpu-fallback")
+
+    if os.environ.get("HETU_BENCH_CTR_ROWS"):
+        art = sweep_ctr_rows(platform, reduced)
+        best = max((r for r in art["rungs"] if "error" not in r),
+                   key=lambda r: r["rows"], default=None)
+        print(json.dumps({
+            "metric": "ctr_max_embedding_rows_per_chip",
+            "value": art["max_rows"], "unit": "rows",
+            "vs_baseline": None, "platform": platform,
+            "rows_per_sec_at_max": (best or {}).get(
+                "embedding_rows_per_sec"),
+            "rungs": [{"rows": r["rows"],
+                       **({"error": r["error"]} if "error" in r else
+                          {"rows_per_sec": r["embedding_rows_per_sec"]})}
+                      for r in art["rungs"]],
+            **({"not_written": art["not_written"]}
+               if "not_written" in art else
+               {"rows_file": os.path.basename(_CTR_ROWS_FILE)})}))
+        return
 
     if os.environ.get("HETU_BENCH_SWEEP"):
         art = sweep_bert(platform, reduced)
@@ -865,7 +971,9 @@ def main():
             "spearman_rho": pv.get("spearman_rho"),
             "measured_best": pv.get("measured_best"),
             "predicted_best": pv.get("predicted_best"),
-            "sweep_file": os.path.basename(_SWEEP_FILE)}))
+            **({"not_written": art["not_written"]}
+               if "not_written" in art else
+               {"sweep_file": os.path.basename(_SWEEP_FILE)})}))
         return
 
     sel = os.environ.get("HETU_BENCH_CONFIGS")

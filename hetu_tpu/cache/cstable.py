@@ -87,9 +87,8 @@ class CacheSparseTable:
                 if len(s_ids):
                     self.cache.refresh(s_ids, s_rows, s_vers)
                     self.num_synced_rows += len(s_ids)
-                    pos = {int(i): k for k, i in enumerate(uniq)}
-                    for j, sid in enumerate(s_ids):
-                        rows[pos[int(sid)]] = s_rows[j]
+                    # uniq is sorted (np.unique): vectorized placement
+                    rows[np.searchsorted(uniq, np.asarray(s_ids))] = s_rows
 
         # pull misses — one RPC: sync_embedding against -inf versions
         # returns (ids, rows, versions) together
@@ -106,18 +105,24 @@ class CacheSparseTable:
 
         return rows[inv].reshape(*shape, self.width)
 
-    def embedding_update(self, ids, deltas):
-        """Accumulate optimizer-scaled deltas; push when past push_bound."""
+    def embedding_update(self, ids, deltas, assume_unique=False):
+        """Accumulate optimizer-scaled deltas; push when past push_bound.
+        ``assume_unique``: ids are already deduplicated (the executor's
+        device-side segment-sum emits unique sorted rows) — skips the
+        host re-dedup pass."""
         with self._lock:
-            self._update(ids, deltas)
+            self._update(ids, deltas, assume_unique)
 
-    def _update(self, ids, deltas):
+    def _update(self, ids, deltas, assume_unique=False):
         flat = np.asarray(ids, np.int64).reshape(-1)
         deltas = np.asarray(deltas, np.float32).reshape(len(flat), self.width)
-        # merge duplicate ids (scatter-add semantics)
-        uniq, inv = np.unique(flat, return_inverse=True)
-        merged = np.zeros((len(uniq), self.width), np.float32)
-        np.add.at(merged, inv, deltas)
+        if assume_unique:
+            uniq, merged = flat, deltas
+        else:
+            # merge duplicate ids (scatter-add semantics)
+            uniq, inv = np.unique(flat, return_inverse=True)
+            merged = np.zeros((len(uniq), self.width), np.float32)
+            np.add.at(merged, inv, deltas)
         missed = self.cache.update(uniq, merged)
         if missed and self.comm is not None:
             # uncached ids (version query leaves policy state untouched):
@@ -167,8 +172,12 @@ class CacheSparseTable:
             s_ids, s_rows, s_vers = sync(
                 self.key, ids, np.full(len(ids), -1 << 40, np.int64), 0)
             if len(s_ids) == len(ids):
-                order = {int(i): k for k, i in enumerate(s_ids)}
-                perm = np.array([order[int(i)] for i in ids])
+                # align server order to request order, vectorized (the
+                # per-id dict loop here was the hottest line of the whole
+                # hybrid host path at CTR scale)
+                s_ids = np.asarray(s_ids, np.int64)
+                sort = np.argsort(s_ids)
+                perm = sort[np.searchsorted(s_ids[sort], ids)]
                 return (np.asarray(s_rows, np.float32)[perm],
                         np.asarray(s_vers, np.int64)[perm])
         return (np.asarray(self.comm.sparse_pull(self.key, ids),

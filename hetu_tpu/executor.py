@@ -71,6 +71,16 @@ class HetuConfig:
                      (multi-worker PS training).
       prefetch       overlap next batch's PS embedding lookup with the
                      current step (dataloader-fed ids only).
+      async_push     opt-in: drain phase B (grad D2H + PS/cache push)
+                     on a background worker; the next step's lookups
+                     join it first, so read-your-writes semantics (and
+                     the staleness-0 trajectory) are unchanged.  Pays
+                     off only when the training loop has host work to
+                     overlap (data augmentation, metrics, multi-table
+                     steps); in a tight run() loop the join lands
+                     immediately and the thread handoff is pure
+                     overhead (measured 27->41 ms/step on the CTR
+                     shape), so the default stays synchronous.
       use_sparse_pull sparse row pull vs full-table pull in PS mode.
       enable_lazy / overlap / use_nccl_collectives — no-ops by design:
                      everything is lazily traced into one jitted program,
@@ -87,7 +97,8 @@ class HetuConfig:
 
     def __init__(self, eval_node_list=None, train_name=None, val_name=None,
                  comm_mode=None, use_sparse_pull=True, cstable_policy=None,
-                 bsp=-1, prefetch=True, enable_lazy=False, cache_bound=100,
+                 bsp=-1, prefetch=True, async_push=False, enable_lazy=False,
+                 cache_bound=100,
                  log_path=None, my_eval_nodes=None, dist_strategy=None,
                  pipeline=None, overlap=True, use_preduce=False,
                  use_nccl_collectives=True, seed=0, mesh=None,
@@ -106,6 +117,7 @@ class HetuConfig:
         self.cstable_policy = cstable_policy
         self.bsp = bsp
         self.prefetch = prefetch
+        self.async_push = async_push
         self.enable_lazy = enable_lazy
         self.cache_bound = cache_bound
         self.log_path = log_path
@@ -316,6 +328,16 @@ class SubExecutor:
         self._ps_lookup_ids = set(id(n) for n in self.ps_lookups)
         self._prefetched = {}    # lookup node name -> (ids, Future)
         self._compiled = {}
+        # async phase B: one worker drains the grad D2H + PS/cache push
+        # off the critical path (reference overlaps push with the next
+        # batch via CSEvent streams, stream.py:90-105); ordering with
+        # the next lookup is enforced by _join_phase_b
+        self._phase_b_pool = None
+        if self.training and self.ps_var_names \
+                and executor.config.async_push:
+            from concurrent.futures import ThreadPoolExecutor
+            self._phase_b_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"psb-{name}")
 
     # ------------------------------------------------------------------ #
 
@@ -456,6 +478,9 @@ class SubExecutor:
     def run(self, feed_dict, convert_to_numpy_ret_vals=False):
         ex = self.executor
         feeds = gather_feeds(self, feed_dict)
+        # read-your-writes: the previous step's async push must land in
+        # the cache/PS before this step's lookups
+        ex.join_ps_push()
         ps_ids = self._ps_phase_a(feeds)
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feeds.items()))
@@ -467,8 +492,19 @@ class SubExecutor:
         ex.var_values, ex.opt_states, ex.step, ex.rng, outputs, side = fn(
             ex.var_values, ex.opt_states, ex.step, ex.rng, feeds)
         if self.ps_var_names and self.training:
-            self._ps_phase_b(side, ps_ids)
-        self._ps_prefetch()
+            if self._phase_b_pool is not None:
+                # the worker blocks on the grads' D2H, pushes, THEN
+                # prefetches (so the prefetched rows see the update);
+                # the main thread returns to the training loop
+                def _push():
+                    self._ps_phase_b(side, ps_ids)
+                    self._ps_prefetch()
+                ex._ps_push_future = self._phase_b_pool.submit(_push)
+            else:
+                self._ps_phase_b(side, ps_ids)
+                self._ps_prefetch()
+        else:
+            self._ps_prefetch()
         results = []
         for n, o in zip(self.eval_nodes, outputs):
             if o is None:
@@ -650,6 +686,7 @@ class Executor:
         self.ps_var_opt = {}
         self._ps_opt_specs = {}
         self._ssp_inited = False
+        self._ps_push_future = None   # pending async phase B (one step)
         if self.config.comm_mode in ("PS", "Hybrid"):
             self._setup_ps(all_nodes)
 
@@ -832,7 +869,10 @@ class Executor:
             # pushed used the pre-increment step's LR
             lr = float(np.asarray(opt.lr_value(
                 jnp.asarray(max(int(self.step) - 1, 0), jnp.int32))))
-            ct.embedding_update(flat, -lr * rows)
+            # phase B hands us the device-side segment-summed UNIQUE rows
+            # (_ps_phase_b passes phase A's sorted-unique ids) — skip the
+            # cache's host re-dedup pass
+            ct.embedding_update(flat, -lr * rows, assume_unique=True)
         else:
             self.ps_comm.sparse_push(name, flat, rows)
 
@@ -849,8 +889,17 @@ class Executor:
                 self._ssp_inited = True
             self.ps_comm.ssp_sync(0)
 
+    def join_ps_push(self):
+        """Wait for (and surface errors from) the pending async phase-B
+        push.  Called before any PS/cache read and before flush/save."""
+        fut = self._ps_push_future
+        if fut is not None:
+            self._ps_push_future = None
+            fut.result()
+
     def ps_perf_summary(self):
         """Cache counters per table (reference cstable perf counters)."""
+        self.join_ps_push()
         return {name: ct.perf_summary() for name, ct in self.cstables.items()}
 
     # ------------------------------------------------------------------ #
@@ -1045,6 +1094,7 @@ class Executor:
         required once params exceed one host's RAM), ``async_=True``
         returns immediately and flushes in the background
         (``wait_for_checkpoint()`` joins it)."""
+        self.join_ps_push()
         if sharded or async_:
             return self._save_orbax(path, async_=async_)
         if self.multiprocess:
@@ -1111,6 +1161,7 @@ class Executor:
     # ---- orbax path: sharded + async ---- #
 
     def _orbax_state(self):
+        self.join_ps_push()
         state = {"params": dict(self.var_values),
                  "opt_states": self.opt_states,
                  "step": self.step, "rng": self.rng}
@@ -1397,6 +1448,7 @@ class Executor:
             self._restore_loaders(ckpt["dataloaders"])
 
     def load_dict(self, state_dict):
+        self.join_ps_push()
         from .cache.cstable import CacheSparseTable
         for k, v in state_dict.items():
             if k in self.ps_sparse_vars or k in self.ps_dense_vars:
@@ -1439,6 +1491,7 @@ class Executor:
         self.rng = jax.random.PRNGKey(seed)
 
     def return_tensor_values(self):
+        self.join_ps_push()
         # copies, not views: the underlying buffers are donated next step
         out = {k: np.array(v, copy=True)
                for k, v in self.var_values.items()}

@@ -223,3 +223,48 @@ def test_cstable_async_overlap_consistency():
     t.flush()
     s = t.perf_summary()
     assert s["lookups"] == 50
+
+
+def test_update_assume_unique_matches_default():
+    """The executor's phase B passes device-deduped unique rows with
+    assume_unique=True; result must equal the default dedup path."""
+    from hetu_tpu.cache.cstable import CacheSparseTable
+    from hetu_tpu.ps.server import PSServer
+    W = 4
+    PSServer._instance = None
+    srv = PSServer.get()
+    for key, flag in (("au_a", False), ("au_b", True)):
+        srv.param_init(key, (32, W), "constant", 1.0)
+        t = CacheSparseTable(16, 32, W, key, comm=srv)
+        ids = np.array([3, 7, 11])
+        t.embedding_lookup(ids)
+        t.embedding_update(ids, np.full((3, W), 0.25, np.float32),
+                           assume_unique=flag)
+        t.flush()
+    a = srv.sparse_pull("au_a", np.array([3, 7, 11]))
+    b = srv.sparse_pull("au_b", np.array([3, 7, 11]))
+    np.testing.assert_allclose(a, b)
+    PSServer._instance = None
+
+
+def test_fetch_rows_alignment_with_shuffled_server_order():
+    """_fetch_rows must realign rows when the server returns ids in a
+    different order than requested (the vectorized argsort/searchsorted
+    path)."""
+    from hetu_tpu.cache.cstable import CacheSparseTable
+
+    class ShufflingComm:
+        """sync_embedding answering in REVERSED id order."""
+        def __init__(self, table):
+            self.table = table
+        def sync_embedding(self, key, ids, stored, bound):
+            ids = np.asarray(ids, np.int64)[::-1]
+            return ids, self.table[ids], np.ones(len(ids), np.int64)
+        def push_embedding(self, key, ids, rows, versions=None):
+            pass
+
+    table = np.arange(64, dtype=np.float32).reshape(16, 4)
+    t = CacheSparseTable(8, 16, 4, "shuf", comm=ShufflingComm(table))
+    ids = np.array([2, 9, 5])
+    rows = t.embedding_lookup(ids)
+    np.testing.assert_allclose(rows, table[ids])

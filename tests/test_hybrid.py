@@ -65,9 +65,13 @@ class TestHybridEquivalence:
         dict(comm_mode="Hybrid"),
         dict(comm_mode="Hybrid", cstable_policy="LFUOpt", cache_bound=64),
         dict(comm_mode="Hybrid", cstable_policy="LRU", cache_bound=8),
+        dict(comm_mode="Hybrid", async_push=True),
+        dict(comm_mode="Hybrid", cstable_policy="LFUOpt", cache_bound=64,
+             async_push=True),
         dict(comm_mode="PS"),
         dict(comm_mode="PS", use_sparse_pull=False),
-    ], ids=["hybrid", "hybrid+lfuopt", "hybrid+lru-tiny", "ps", "ps-full"])
+    ], ids=["hybrid", "hybrid+lfuopt", "hybrid+lru-tiny",
+            "hybrid+async", "hybrid+lfuopt+async", "ps", "ps-full"])
     def test_trajectory_matches_dense(self, dense_baseline, kwargs):
         w0, batches, base = dense_baseline
         fresh_ps()
