@@ -77,9 +77,13 @@ def _causal_q_row(bq, bk, n_q):
     return row
 
 
-def _fwd_kernel(*refs, scale, causal, masked, bq, bk, n_kv):
+def _fwd_kernel(*refs, scale, causal, masked, carried, bq, bk, n_kv):
+    oc_ref = lc_ref = None
     if masked:
         (kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_ref, l_ref, acc_ref) = refs
+    elif carried:
+        (q_ref, k_ref, v_ref, oc_ref, lc_ref, o_ref, lse_ref,
          m_ref, l_ref, acc_ref) = refs
     else:
         (q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -90,9 +94,24 @@ def _fwd_kernel(*refs, scale, causal, masked, bq, bk, n_kv):
 
     @pl.when(kj == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        if carried:
+            # fused merge epilogue (ring attention): seed the running
+            # (m, l, acc) from the PREVIOUS rotation's normalized output
+            # and lse.  Any (m, l, acc) with acc/l == o_c and
+            # m + log l == lse_c continues the stream exactly; we pick
+            # l = 1, m = lse_c — so the cross-rotation combine costs no
+            # separate pass over the output at all.
+            lse_c = lc_ref[0, 0]                       # [bq] f32
+            live = lse_c > NEG_INF / 2
+            m_ref[:] = jnp.broadcast_to(
+                jnp.where(live, lse_c, NEG_INF)[:, None], m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(
+                jnp.where(live, 1.0, 0.0)[:, None], l_ref.shape)
+            acc_ref[:] = oc_ref[0] * jnp.where(live, 1.0, 0.0)[:, None]
+        else:
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
 
     run = True
     if causal:
@@ -151,8 +170,14 @@ def _fwd_kernel(*refs, scale, causal, masked, bq, bk, n_kv):
         lse_ref[0, 0] = lse
 
 
-def _flash_fwd(q, k, v, kv_lens, *, causal, block_q, block_k, interpret):
-    """q, k, v: [BH, S, D] (+ optional kv_lens [BH]) -> o: [BH, S, D]."""
+def _flash_fwd(q, k, v, kv_lens, *, causal, block_q, block_k, interpret,
+               carry=None):
+    """q, k, v: [BH, S, D] (+ optional kv_lens [BH]) -> o: [BH, S, D].
+
+    ``carry``: optional (o_carry [BH, S, D] f32, lse_carry [BH, 1, S]
+    f32) — the previous partial's normalized output and lse, merged in
+    the kernel prologue (ring attention).  With a carry the output o is
+    f32 (it keeps accumulating across rotations)."""
     BH, S, D = q.shape
     Sk = k.shape[1]
     bq = _fit_block(block_q, S)
@@ -160,12 +185,20 @@ def _flash_fwd(q, k, v, kv_lens, *, causal, block_q, block_k, interpret):
     n_q, n_kv = S // bq, Sk // bk
     scale = D ** -0.5
     masked = kv_lens is not None
+    carried = carry is not None
+    assert not (masked and carried), "kv_lens + carry not combined"
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, masked=masked,
-        bq=bq, bk=bk, n_kv=n_kv)
+        carried=carried, bq=bq, bk=bk, n_kv=n_kv)
     lens_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)] if masked else []
     lens_arg = (kv_lens,) if masked else ()
+    carry_spec = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+    ] if carried else []
+    carry_arg = (carry[0].astype(jnp.float32),
+                 carry[1].astype(jnp.float32)) if carried else ()
 
     if causal:
         kv_idx = _causal_kv_index(bq, bk)
@@ -179,13 +212,14 @@ def _flash_fwd(q, k, v, kv_lens, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), kv_idx),
             pl.BlockSpec((1, bk, D), kv_idx),
-        ],
+        ] + carry_spec,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, D),
+                                 jnp.float32 if carried else q.dtype),
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
         scratch_shapes=[
@@ -194,7 +228,7 @@ def _flash_fwd(q, k, v, kv_lens, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((bq, D), jnp.float32),        # output accumulator
         ],
         interpret=interpret,
-    )(*lens_arg, q, k, v)
+    )(*lens_arg, q, k, v, *carry_arg)
 
 
 # --------------------------------------------------------------------------- #
@@ -477,6 +511,86 @@ def _flash_stats_bwd_rule(causal, block_q, block_k, res, g):
 
 
 _flash_stats.defvjp(_flash_stats_fwd_rule, _flash_stats_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_stats_carry(q, k, v, o_c, lse_c, causal, block_q, block_k):
+    """``_flash_stats`` with the cross-block merge fused into the kernel
+    prologue: (o_c, lse_c) is the previous partial (normalized output +
+    lse, [BH, S, D] f32 / [BH, S] f32) and the returned (o, lse) is the
+    EXACT streaming-softmax continuation — ring attention's per-rotation
+    combine costs zero extra passes over the output."""
+    o, lse = _flash_fwd(q, k, v, None, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=_use_interpret(),
+                        carry=(o_c, lse_c[:, None, :]))
+    return o, lse[:, 0, :]
+
+
+def _flash_stats_carry_fwd_rule(q, k, v, o_c, lse_c, causal, block_q,
+                                block_k):
+    o, lse = _flash_fwd(q, k, v, None, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=_use_interpret(),
+                        carry=(o_c, lse_c[:, None, :]))
+    return (o, lse[:, 0, :]), (q, k, v, o_c, lse_c, o, lse)
+
+
+def _flash_stats_carry_bwd_rule(causal, block_q, block_k, res, g):
+    """dq/dk/dv run the unchanged FA2 kernels — with the carry folded
+    into lse, the recomputed P = exp(s - lse_total) and delta =
+    rowsum(dO*O) are already the right normalized quantities.  The carry
+    behaves like one virtual key row with "value" o_c and score lse_c:
+
+        w_c    = exp(lse_c - lse_total)
+        d o_c  = w_c * dO
+        d lse_c = w_c * (dO . o_c - delta + g_lse)
+
+    (the same dS = P*(dP - delta + g_lse) shape the kernels use)."""
+    q, k, v, o_c, lse_c, o, lse = res
+    g_o, g_lse = g
+    dq, dk, dv = _flash_bwd(
+        q, k, v, None, o, lse, g_o.astype(q.dtype), causal=causal,
+        block_q=block_q, block_k=block_k, interpret=_use_interpret(),
+        g_lse=g_lse)
+    lse_tot = lse[:, 0, :]                               # [BH, S]
+    g_o32 = g_o.astype(jnp.float32)
+    w_c = jnp.where(lse_c <= NEG_INF / 2, 0.0,
+                    jnp.exp(lse_c - lse_tot))            # [BH, S]
+    d_o_c = w_c[:, :, None] * g_o32
+    delta = jnp.sum(g_o32 * o.astype(jnp.float32), axis=-1)
+    dot_c = jnp.sum(g_o32 * o_c.astype(jnp.float32), axis=-1)
+    g_lse32 = (jnp.zeros_like(delta) if g_lse is None
+               else g_lse.astype(jnp.float32))
+    d_lse_c = w_c * (dot_c - delta + g_lse32)
+    return dq, dk, dv, d_o_c, d_lse_c
+
+
+_flash_stats_carry.defvjp(_flash_stats_carry_fwd_rule,
+                          _flash_stats_carry_bwd_rule)
+
+
+def flash_attention_with_carry(q, k, v, o_carry, lse_carry, *,
+                               causal=False, block_q=512, block_k=1024):
+    """Flash attention on [B, S, H, D] continuing a previous partial.
+
+    ``o_carry`` [B, S, H, D] float32 (normalized), ``lse_carry``
+    [B, H, S] float32 (NEG_INF where the carry is empty).  Returns
+    (o [B, S, H, D] float32, lse [B, H, S] float32) — the streaming
+    combination of the carry with attention over THIS (k, v), exactly
+    equal to attending over the concatenated key sets.  Differentiable
+    in all five array arguments; ring attention chains it so the
+    per-rotation (o, lse) merge runs inside the kernel prologue instead
+    of as a separate elementwise pass."""
+    B, S, H, D = q.shape
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+    o, lse = _flash_stats_carry(
+        fold(q), fold(k), fold(v), fold(o_carry),
+        lse_carry.reshape(B * H, S), causal, block_q, block_k)
+    o = o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    lse = lse.reshape(B, H, S)
+    lse = jnp.where(lse >= -NEG_INF / 2, NEG_INF, lse)
+    return o, lse
 
 
 def flash_attention_with_lse(q, k, v, *, causal=False, block_q=512,

@@ -86,10 +86,12 @@ def ring_attention(q, k, v, *, mesh, axis="cp", causal=False, impl=None,
         otherwise).
       causal: apply a causal mask using global positions.
       impl: ``'flash'`` — each rotation's block runs the fused Pallas
-        kernel (``flash_attention_with_lse``) and partial (o, lse) pairs
-        merge by streaming logsumexp; blocks wholly above the causal
-        diagonal are SKIPPED (lax.switch), so causal costs ~half the
-        FLOPs.  ``'exact'`` — unfused streaming-softmax oracle.
+        kernel (``flash_attention_with_carry``): the previous rotation's
+        (o, lse) partial seeds the kernel's streaming state, so the
+        cross-rotation merge happens in the kernel prologue with no
+        separate pass; blocks wholly above the causal diagonal are
+        SKIPPED (lax.switch), so causal costs ~half the FLOPs.
+        ``'exact'`` — unfused streaming-softmax oracle.
         ``None`` — flash on TPU, exact elsewhere (the oracle doubles as
         the CPU-mesh test path; flash still runs there in interpret mode
         when requested explicitly).
@@ -154,18 +156,29 @@ def ring_attention(q, k, v, *, mesh, axis="cp", causal=False, impl=None,
 def _ring_attention_flash(q, k, v, *, mesh, axis, causal, block_q,
                           block_k):
     """Flash-in-ring (VERDICT r2 item 6): every rotation's (q-block,
-    kv-block) pair runs the fused Pallas kernel; partial outputs merge by
-    streaming logsumexp.  Per rotated block exactly one of three cases
-    applies, dispatched at runtime on the ring offset (lax.switch):
+    kv-block) pair runs the fused Pallas kernel.  Per rotated block
+    exactly one of three cases applies, dispatched at runtime on the
+    ring offset (lax.switch):
 
       kv_owner  > mine (causal): fully masked -> skipped outright
       kv_owner == mine (causal): the diagonal -> flash(causal=True)
       otherwise:                 fully live   -> flash(causal=False)
 
     Block alignment makes the diagonal case plain local causal masking,
-    so the kernel needs no offset plumbing.  Backward differentiates the
-    combine + the kernel's own fused FA2 VJP per block."""
-    from ..kernels.flash_attention import flash_attention_with_lse
+    so the kernel needs no offset plumbing.
+
+    Two r4 perf changes (VERDICT r3 item 2):
+    * the per-rotation (o, lse) merge is FUSED into the kernel prologue
+      — ``flash_attention_with_carry`` seeds the kernel's streaming
+      (m, l, acc) state from the previous rotation's partial, so no
+      separate elementwise pass over the output runs per rotation;
+    * the KV ppermute is issued BEFORE the block compute, so the
+      latency-hiding scheduler can run the ICI rotation underneath the
+      flash kernel (the next iteration, not this one, consumes it).
+
+    Backward differentiates the chained kernel VJPs (the carry behaves
+    as one virtual key row; see _flash_stats_carry_bwd_rule)."""
+    from ..kernels.flash_attention import flash_attention_with_carry
     cp = mesh.shape[axis]
     S = q.shape[1]
     assert S % cp == 0, f"seq {S} not divisible by cp={cp}"
@@ -178,50 +191,33 @@ def _ring_attention_flash(q, k, v, *, mesh, axis, causal, block_q,
         o0 = jnp.zeros((B, blk, H, D), jnp.float32)
         lse0 = jnp.full((B, H, blk), NEG_INF, jnp.float32)
 
-        def blk_full(k_t, v_t):
-            return flash_attention_with_lse(
-                q, k_t, v_t, causal=False,
+        def blk_full(k_t, v_t, o, lse):
+            return flash_attention_with_carry(
+                q, k_t, v_t, o, lse, causal=False,
                 block_q=block_q, block_k=block_k)
 
-        def blk_diag(k_t, v_t):
-            return flash_attention_with_lse(
-                q, k_t, v_t, causal=True,
+        def blk_diag(k_t, v_t, o, lse):
+            return flash_attention_with_carry(
+                q, k_t, v_t, o, lse, causal=True,
                 block_q=block_q, block_k=block_k)
 
-        def blk_skip(k_t, v_t):
-            return jnp.zeros((B, blk, H, D), q.dtype), lse0
+        def blk_skip(k_t, v_t, o, lse):
+            return o, lse
 
         def step(carry, t):
             k_t, v_t, o, lse = carry
+            # rotation first: independent of the block compute, so the
+            # scheduler can overlap the ppermute with the kernel
+            k_n = jax.lax.ppermute(k_t, axis, shift)
+            v_n = jax.lax.ppermute(v_t, axis, shift)
             kv_owner = (my - t) % cp
             if causal:
                 case = jnp.where(kv_owner > my, 2,
                                  jnp.where(kv_owner == my, 1, 0))
             else:
                 case = jnp.zeros((), jnp.int32)
-            o_i, lse_i = jax.lax.switch(
-                case, [blk_full, blk_diag, blk_skip], k_t, v_t)
-            # streaming logsumexp combine of normalized partials
-            lse_new = jnp.maximum(lse, lse_i)
-            safe = jnp.where(lse_new <= NEG_INF / 2, 0.0, lse_new)
-            a_old = jnp.where(lse <= NEG_INF / 2, 0.0,
-                              jnp.exp(lse - safe))
-            a_new = jnp.where(lse_i <= NEG_INF / 2, 0.0,
-                              jnp.exp(lse_i - safe))
-            # normalized o_i combine: weights are l_i ratios = exp(lse_i
-            # - lse_tot) after the final pass; streaming form keeps
-            # running l-weighted sum and renormalizes at the end
-            l_old = a_old
-            l_new = a_new
-            denom = l_old + l_new
-            denom_safe = jnp.where(denom == 0.0, 1.0, denom)
-            w_old = (l_old / denom_safe)[..., None].transpose(0, 2, 1, 3)
-            w_new = (l_new / denom_safe)[..., None].transpose(0, 2, 1, 3)
-            o = o * w_old + o_i.astype(jnp.float32) * w_new
-            lse = safe + jnp.log(denom_safe)
-            lse = jnp.where(denom == 0.0, NEG_INF, lse)
-            k_n = jax.lax.ppermute(k_t, axis, shift)
-            v_n = jax.lax.ppermute(v_t, axis, shift)
+            o, lse = jax.lax.switch(
+                case, [blk_full, blk_diag, blk_skip], k_t, v_t, o, lse)
             return (k_n, v_n, o, lse), None
 
         (_, _, o, lse), _ = jax.lax.scan(
