@@ -681,9 +681,16 @@ def bench_moe(platform, reduced):
 
     batch, tokens, model_dim, hidden, experts, iters = 8, 1024, 768, \
         3072, 8, 15
+    top_k = 2
     if reduced:
         batch, tokens, model_dim, hidden, experts, iters = 2, 64, 64, \
             128, 4, 2
+    # chip-fill tuning knobs for the on-chip re-measure (VERDICT r3
+    # item 4: the recorded config underfilled the chip)
+    if os.environ.get("HETU_BENCH_MOE_BATCH"):
+        batch = int(os.environ["HETU_BENCH_MOE_BATCH"])
+    if os.environ.get("HETU_BENCH_MOE_TOKENS"):
+        tokens = int(os.environ["HETU_BENCH_MOE_TOKENS"])
     rng = np.random.RandomState(0)
     # device-resident feeds: a 25MB host feed per step would measure the
     # tunnel's H2D, not the MoE step (jax.Arrays pass through the feed
@@ -698,7 +705,7 @@ def bench_moe(platform, reduced):
         y_ = ht.placeholder_op("y_")
         loss, _y = moe_mlp(x, y_, batch, tokens, model_dim, hidden,
                            num_local_experts=experts, gate_type="top",
-                           top_k=2, sparse_labels=True,
+                           top_k=top_k, sparse_labels=True,
                            expert_parallel=expert_parallel)
         train = ht.optim.AdamOptimizer(
             learning_rate=1e-4).minimize(loss)
@@ -744,6 +751,23 @@ def bench_moe(platform, reduced):
     # d x h each way per routed token
     useful_flops = 3.0 * 2 * (batch * tokens) * 4 * model_dim * hidden
     kind, tflops_chip, mfu = _mfu(useful_flops, dt, 1, platform)
+    # A2A accounting (BASELINE config 4 asks for the A2A time fraction).
+    # On ONE chip ep=1 and no all-to-all runs, so the single-chip row
+    # reports the MODEL-LEVEL a2a volume and an estimated fraction for
+    # an ep=experts deployment (one expert per device): the [E, cap, D]
+    # dispatch buffer crosses the exchange on dispatch + combine, each
+    # again in backward (4x), moving (ep-1)/ep of its bytes over ICI.
+    # same static-capacity formula the gate uses (layers/moe.py:44
+    # topkgating: k * ceil(num_tokens/num_experts * capacity_factor)),
+    # at the bench's default capacity_factor = 1.0
+    import math as _math
+    cap = top_k * _math.ceil(batch * tokens / experts * 1.0)
+    a2a_buffer_bytes = experts * cap * model_dim * 2      # bf16
+    ep_deploy = experts
+    a2a_bytes = 4.0 * a2a_buffer_bytes * (ep_deploy - 1) / ep_deploy
+    from hetu_tpu.planner.cost_model import ClusterSpec
+    ici = ClusterSpec().ici_bandwidth
+    a2a_est_s = a2a_bytes / ici
     return {
         "value": round(batch * tokens / dt, 1),
         "unit": "tokens/sec/chip",
@@ -753,10 +777,16 @@ def bench_moe(platform, reduced):
         "mfu": mfu,
         "best_variant": best,
         "variants": variants,
+        "a2a_bytes_per_step": int(a2a_bytes),
+        "a2a_fraction_est": round(a2a_est_s / (a2a_est_s + dt), 4),
+        "a2a_note": (f"single-chip run has ep=1 (no live all-to-all); "
+                     f"estimate assumes ep={ep_deploy} over spec ICI "
+                     f"{ici/1e9:.0f} GB/s (spec-assumed, unmeasurable "
+                     f"on one chip) against the measured compute step"),
         "reduced_scale": reduced,
         "config": {"batch": batch, "tokens": tokens,
                    "model_dim": model_dim, "hidden": hidden,
-                   "experts": experts, "top_k": 2},
+                   "experts": experts, "top_k": top_k},
     }
 
 

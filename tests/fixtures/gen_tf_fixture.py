@@ -1,0 +1,120 @@
+"""Generate the TF-side ONNX fixture (reference
+tests/onnx/cnn_hetu_onnx_tf.py round-trips hetu<->TF through ONNX).
+
+Builds a small Keras CNN, runs a REAL TensorFlow forward pass on a fixed
+input, and serializes the network to ONNX with tf2onnx's structural
+conventions — the graph takes the NHWC input TF models use, transposes
+to NCHW for Conv/Pool (ONNX's only layout), and transposes back before
+the NHWC flatten so the Dense weights keep TF's H*W*C ordering.  The
+ONNX bytes come from hetu_tpu's own self-contained proto writer (no
+tf2onnx/onnx wheels in the image; zero egress).
+
+Run:  python tests/fixtures/gen_tf_fixture.py
+Writes: tf_cnn.onnx, tf_cnn_input.npy, tf_cnn_output.npy
+(the checked-in fixtures tests/test_onnx.py's TF parity tests consume;
+tf_cnn_output.npy is TensorFlow's OWN forward output, so the test
+asserts parity against TF execution, not against our importer).
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_and_run_tf(seed=7):
+    import tensorflow as tf
+    tf.keras.utils.set_random_seed(seed)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(8, 8, 3)),
+        tf.keras.layers.Conv2D(4, 3, padding="same", activation="relu",
+                               name="conv"),
+        tf.keras.layers.MaxPool2D(2, name="pool"),
+        tf.keras.layers.Flatten(name="flatten"),
+        tf.keras.layers.Dense(10, name="dense"),
+    ])
+    rng = np.random.RandomState(seed)
+    x = rng.randn(4, 8, 8, 3).astype(np.float32)
+    y = model(x, training=False).numpy()
+    return model, x, y
+
+
+def export_tf2onnx_style(model, path):
+    """tf2onnx-shaped graph: NHWC input, Transpose->NCHW around
+    Conv/Pool, Transpose->NHWC before the flatten Reshape, Gemm-free
+    MatMul+Add dense (tf2onnx emits MatMul/Add for Keras Dense)."""
+    from hetu_tpu.onnx import proto as P
+
+    conv_w, conv_b = [w.numpy() for w in model.get_layer("conv").weights]
+    dense_w, dense_b = [w.numpy()
+                        for w in model.get_layer("dense").weights]
+    # TF conv kernels are HWIO; ONNX Conv wants OIHW
+    conv_w_onnx = conv_w.transpose(3, 2, 0, 1).copy()
+
+    nodes = [
+        P.NodeProto(op_type="Transpose", name="to_nchw",
+                    input=["x"], output=["x_nchw"],
+                    attribute=[P.attr("perm", [0, 3, 1, 2])]),
+        P.NodeProto(op_type="Conv",
+                    name="StatefulPartitionedCall/model/conv/Conv2D",
+                    input=["x_nchw", "conv/kernel:0", "conv/bias:0"],
+                    output=["conv_out"],
+                    attribute=[P.attr("kernel_shape", [3, 3]),
+                               P.attr("pads", [1, 1, 1, 1]),
+                               P.attr("strides", [1, 1])]),
+        P.NodeProto(op_type="Relu",
+                    name="StatefulPartitionedCall/model/conv/Relu",
+                    input=["conv_out"], output=["relu_out"]),
+        P.NodeProto(op_type="MaxPool",
+                    name="StatefulPartitionedCall/model/pool/MaxPool",
+                    input=["relu_out"], output=["pool_out"],
+                    attribute=[P.attr("kernel_shape", [2, 2]),
+                               P.attr("strides", [2, 2])]),
+        # back to NHWC so the flatten matches TF's memory order — the
+        # structural signature of a tf2onnx export
+        P.NodeProto(op_type="Transpose", name="to_nhwc",
+                    input=["pool_out"], output=["pool_nhwc"],
+                    attribute=[P.attr("perm", [0, 2, 3, 1])]),
+        P.NodeProto(op_type="Reshape",
+                    name="StatefulPartitionedCall/model/flatten/Reshape",
+                    input=["pool_nhwc", "flatten_shape"],
+                    output=["flat"]),
+        P.NodeProto(op_type="MatMul",
+                    name="StatefulPartitionedCall/model/dense/MatMul",
+                    input=["flat", "dense/kernel:0"],
+                    output=["dense_mm"]),
+        P.NodeProto(op_type="Add",
+                    name="StatefulPartitionedCall/model/dense/BiasAdd",
+                    input=["dense_mm", "dense/bias:0"],
+                    output=["logits"]),
+    ]
+    inits = [
+        P.tensor_from_numpy(conv_w_onnx, "conv/kernel:0"),
+        P.tensor_from_numpy(conv_b, "conv/bias:0"),
+        P.tensor_from_numpy(np.array([-1, 64], np.int64),
+                            "flatten_shape"),
+        P.tensor_from_numpy(dense_w, "dense/kernel:0"),
+        P.tensor_from_numpy(dense_b, "dense/bias:0"),
+    ]
+    graph = P.GraphProto(
+        name="tf_cnn", node=nodes, initializer=inits,
+        input=[P.value_info("x", (4, 8, 8, 3))],
+        output=[P.value_info("logits", (4, 10))])
+    model_p = P.ModelProto(
+        ir_version=8, producer_name="tf2onnx-style (hetu_tpu writer)",
+        graph=graph,
+        opset_import=[P.OperatorSetIdProto(domain="", version=13)])
+    P.save_model(model_p, path)
+
+
+def main():
+    model, x, y = build_and_run_tf()
+    export_tf2onnx_style(model, os.path.join(HERE, "tf_cnn.onnx"))
+    np.save(os.path.join(HERE, "tf_cnn_input.npy"), x)
+    np.save(os.path.join(HERE, "tf_cnn_output.npy"), y)
+    print("fixture written; TF output head:", y[0, :4])
+
+
+if __name__ == "__main__":
+    main()
