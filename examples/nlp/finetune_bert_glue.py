@@ -29,6 +29,8 @@ import time
 import numpy as np
 
 import hetu_tpu as ht
+from hetu_tpu.glue import (PROCESSORS, compute_metrics,
+                           convert_examples_to_arrays)
 from hetu_tpu.models import BertConfig, BertForSequenceClassification
 
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
@@ -49,6 +51,46 @@ def load_tsv(path, tokenizer_dir, seq_len, vocab_size):
             labels.append(int(lab))
     return (np.asarray(ids, np.int32) % vocab_size,
             np.asarray(labels, np.int32))
+
+
+def load_glue_task(task, data_dir, vocab_path, seq_len):
+    """Official-format GLUE TSVs through the task processor suite
+    (reference glue_processor/glue.py).  Returns (train arrays, dev
+    arrays, num_labels, vocab_size); each arrays tuple is
+    (input_ids, attention_mask, token_type_ids, labels)."""
+    import tempfile
+    from hetu_tpu.pretraining_data import load_or_build_tokenizer
+    proc = PROCESSORS[task.lower()]()
+    train_ex = proc.get_train_examples(data_dir)
+    dev_ex = proc.get_dev_examples(data_dir)
+    if not vocab_path:
+        cand = os.path.join(data_dir, "vocab.txt")
+        if os.path.exists(cand):
+            vocab_path = cand
+    if vocab_path:
+        tok = load_or_build_tokenizer(None, vocab_path)
+    else:
+        # hermetic fallback: a vocab from the task's own text, via the
+        # shared bootstrap (temp corpus cleaned up along with the
+        # derived vocab)
+        fd, corpus = tempfile.mkstemp(suffix=".txt")
+        try:
+            with os.fdopen(fd, "w") as f:
+                for ex in train_ex + dev_ex:
+                    f.write(ex.text_a + "\n")
+                    if ex.text_b:
+                        f.write(ex.text_b + "\n")
+            tok = load_or_build_tokenizer(corpus)
+        finally:
+            for path in (corpus, corpus + ".vocab.txt"):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+    lab = proc.get_labels()
+    return (convert_examples_to_arrays(train_ex, lab, seq_len, tok),
+            convert_examples_to_arrays(dev_ex, lab, seq_len, tok),
+            len(lab), len(tok.vocab))
 
 
 def synthetic(rng, n, seq_len, vocab_size):
@@ -75,6 +117,12 @@ def main():
     p.add_argument("--num-steps", type=int, default=40)
     p.add_argument("--eval-every", type=int, default=10)
     p.add_argument("--data", default=None, help="label<TAB>text TSV")
+    p.add_argument("--task", default=None,
+                   choices=sorted(PROCESSORS),
+                   help="GLUE task name; reads official TSVs from "
+                        "--data-dir via the processor suite")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--vocab-path", default=None)
     p.add_argument("--tokenizer-dir", default=None)
     p.add_argument("--init-checkpoint", default=None,
                    help="directory saved by a pretraining Executor; "
@@ -82,6 +130,16 @@ def main():
     p.add_argument("--comm-mode", default=None,
                    choices=[None, "AllReduce"])
     args = p.parse_args()
+
+    glue_train = glue_dev = None
+    if args.task:
+        assert args.data_dir, "--task needs --data-dir"
+        glue_train, glue_dev, args.num_labels, args.vocab = \
+            load_glue_task(args.task, args.data_dir, args.vocab_path,
+                           args.seq_len)
+        logger.info("task %s: %d train / %d dev examples, vocab %d",
+                    args.task, len(glue_train[0]), len(glue_dev[0]),
+                    args.vocab)
 
     import jax
     mesh = None
@@ -98,9 +156,11 @@ def main():
                      hidden_dropout_prob=0.1,
                      attention_probs_dropout_prob=0.1)
     ids = ht.placeholder_op("input_ids")
+    tok_ids = ht.placeholder_op("token_type_ids")
+    mask = ht.placeholder_op("attention_mask")
     labels = ht.placeholder_op("labels")
     model = BertForSequenceClassification(cfg, num_labels=args.num_labels)
-    loss, logits = model(ids, labels=labels)
+    loss, logits = model(ids, tok_ids, mask, labels=labels)
     opt = ht.optim.AdamWOptimizer(learning_rate=args.learning_rate,
                                   weight_decay=0.01)
     train = opt.minimize(loss)
@@ -119,35 +179,73 @@ def main():
                     len(pre), args.init_checkpoint)
 
     rng = np.random.RandomState(0)
-    if args.data:
-        all_ids, all_labels = load_tsv(args.data, args.tokenizer_dir,
-                                       args.seq_len, args.vocab)
+    if glue_train is not None:
+        tr_ids, tr_m, tr_t, tr_y = glue_train
+        ev_ids, ev_m, ev_t, ev_y = glue_dev
+        n_dev = len(ev_ids)
+        # pad dev to a batch multiple by WRAPPING, and remember each
+        # row's example index so metrics count every example exactly
+        # once (plain repetition would double-weight an arbitrary
+        # prefix and drop tails)
+        pad_to = max(args.batch_size,
+                     -(-n_dev // args.batch_size) * args.batch_size)
+        ev_index = np.arange(pad_to) % n_dev
+        ev_ids, ev_m, ev_t, ev_y = (a[ev_index]
+                                    for a in (ev_ids, ev_m, ev_t, ev_y))
+        reps_t = max(1, -(-2 * args.batch_size // max(len(tr_ids), 1)))
+        tr_ids, tr_m, tr_t, tr_y = (np.concatenate([a] * reps_t)
+                                    for a in (tr_ids, tr_m, tr_t, tr_y))
     else:
-        all_ids, all_labels = synthetic(rng, 4096, args.seq_len,
-                                        args.vocab)
-    split = int(0.9 * len(all_ids))
-    tr_ids, tr_y = all_ids[:split], all_labels[:split]
-    ev_ids, ev_y = all_ids[split:], all_labels[split:]
+        if args.data:
+            all_ids, all_labels = load_tsv(args.data, args.tokenizer_dir,
+                                           args.seq_len, args.vocab)
+        else:
+            all_ids, all_labels = synthetic(rng, 4096, args.seq_len,
+                                            args.vocab)
+        split = int(0.9 * len(all_ids))
+        tr_ids, tr_y = all_ids[:split], all_labels[:split]
+        ev_ids, ev_y = all_ids[split:], all_labels[split:]
+        tr_m = np.ones(tr_ids.shape, np.float32)
+        ev_m = np.ones(ev_ids.shape, np.float32)
+        tr_t = np.zeros(tr_ids.shape, np.int32)
+        ev_t = np.zeros(ev_ids.shape, np.int32)
 
     def evaluate():
-        correct = total = 0
+        preds, gold, idxs = [], [], []
         for i in range(0, len(ev_ids) - args.batch_size + 1,
                        args.batch_size):
-            xb = ev_ids[i:i + args.batch_size]
-            yb = ev_y[i:i + args.batch_size]
-            _, lg = ex.run("eval", feed_dict={ids: xb, labels: yb},
-                           convert_to_numpy_ret_vals=True)
-            correct += (lg.argmax(-1) == yb).sum()
-            total += len(yb)
-        return correct / max(total, 1)
+            sl = slice(i, i + args.batch_size)
+            _, lg = ex.run("eval", feed_dict={
+                ids: ev_ids[sl], tok_ids: ev_t[sl], mask: ev_m[sl],
+                labels: ev_y[sl]}, convert_to_numpy_ret_vals=True)
+            preds.append(lg.argmax(-1))
+            gold.append(ev_y[sl])
+            if args.task:
+                idxs.append(ev_index[sl])
+        if not preds:
+            return 0.0
+        preds = np.concatenate(preds)
+        gold = np.concatenate(gold)
+        if args.task:
+            # deduplicate the wrap-padding: one vote per dev example
+            uniq = {}
+            for j, pr, gl in zip(np.concatenate(idxs), preds, gold):
+                uniq[int(j)] = (pr, gl)
+            preds = np.array([v[0] for v in uniq.values()])
+            gold = np.array([v[1] for v in uniq.values()])
+            m = compute_metrics(args.task, preds, gold)
+            logger.info("eval metrics %s (%d examples)", m, len(preds))
+            return m["accuracy"]
+        return float((preds == gold).mean())
 
     logger.info("initial eval accuracy %.3f", evaluate())
     t0 = time.time()
     for step in range(args.num_steps):
         j = rng.randint(0, len(tr_ids) - args.batch_size)
-        xb = tr_ids[j:j + args.batch_size]
-        yb = tr_y[j:j + args.batch_size]
-        out = ex.run("train", feed_dict={ids: xb, labels: yb})
+        sl = slice(j, j + args.batch_size)
+        out = ex.run("train", feed_dict={
+            ids: tr_ids[sl], tok_ids: tr_t[sl], mask: tr_m[sl],
+            labels: tr_y[sl]})
         if (step + 1) % args.eval_every == 0:
             acc = evaluate()
             logger.info("step %d loss %.4f eval acc %.3f (%.1f s)",

@@ -1,0 +1,144 @@
+"""GLUE processor suite (reference
+examples/nlp/bert/glue_processor/glue.py): official TSV layouts ->
+examples -> dense arrays -> fine-tuning, hermetically from checked-in
+format-faithful fixtures."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from hetu_tpu.glue import (PROCESSORS, ColaProcessor, MnliProcessor,
+                           MrpcProcessor, Sst2Processor, accuracy,
+                           compute_metrics, convert_examples_to_arrays,
+                           f1, matthews_corr)
+from hetu_tpu.tokenizers import BertTokenizer
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "glue")
+
+
+@pytest.fixture(scope="module")
+def tokenizer():
+    return BertTokenizer.from_pretrained(os.path.join(FIX, "vocab.txt"))
+
+
+class TestProcessors:
+    def test_registry_covers_reference_tasks(self):
+        # reference PROCESSORS = {cola, mnli, mrpc, sst-2}; qqp added
+        for task in ("cola", "mnli", "mrpc", "sst-2", "qqp"):
+            assert task in PROCESSORS
+
+    def test_sst2_single_sentence(self):
+        proc = Sst2Processor()
+        train = proc.get_train_examples(os.path.join(FIX, "SST-2"))
+        dev = proc.get_dev_examples(os.path.join(FIX, "SST-2"))
+        assert len(train) == 80 and len(dev) == 16
+        assert all(ex.text_b is None for ex in train)
+        assert {ex.label for ex in train} == {"0", "1"}
+
+    def test_cola_no_header_col3(self):
+        proc = ColaProcessor()
+        train = proc.get_train_examples(os.path.join(FIX, "CoLA"))
+        assert len(train) == 8
+        assert all(" " in ex.text_a for ex in train)   # real sentences
+        assert {ex.label for ex in train} <= {"0", "1"}
+
+    def test_mrpc_pairs(self):
+        proc = MrpcProcessor()
+        train = proc.get_train_examples(os.path.join(FIX, "MRPC"))
+        assert len(train) == 6
+        assert all(ex.text_b for ex in train)
+
+    def test_mnli_three_way_and_dev_matched(self):
+        proc = MnliProcessor()
+        train = proc.get_train_examples(os.path.join(FIX, "MNLI"))
+        dev = proc.get_dev_examples(os.path.join(FIX, "MNLI"))
+        assert len(train) == 4 and len(dev) == 2
+        assert proc.get_labels() == ["contradiction", "entailment",
+                                     "neutral"]
+        assert all(ex.label in proc.get_labels() for ex in train + dev)
+
+
+class TestFeatureConversion:
+    def test_pair_layout_and_padding(self, tokenizer):
+        proc = MrpcProcessor()
+        exs = proc.get_train_examples(os.path.join(FIX, "MRPC"))
+        ids, mask, seg, labels = convert_examples_to_arrays(
+            exs, proc.get_labels(), 24, tokenizer)
+        v = tokenizer.vocab
+        assert ids.shape == (6, 24)
+        assert (ids[:, 0] == v["[CLS]"]).all()
+        for j in range(len(exs)):
+            valid = int(mask[j].sum())
+            seps = np.where(ids[j, :valid] == v["[SEP]"])[0]
+            assert len(seps) == 2 and seps[-1] == valid - 1
+            assert (seg[j, :seps[0] + 1] == 0).all()
+            assert (seg[j, seps[0] + 1:valid] == 1).all()
+            assert (ids[j, valid:] == v["[PAD]"]).all()
+        assert labels.dtype == np.int32
+
+    def test_single_sentence_truncation(self, tokenizer):
+        proc = Sst2Processor()
+        exs = proc.get_train_examples(os.path.join(FIX, "SST-2"))
+        ids, mask, seg, _ = convert_examples_to_arrays(
+            exs, proc.get_labels(), 5, tokenizer)     # force truncation
+        assert (mask.sum(axis=1) <= 5).all()
+        assert (seg == 0).all()                        # no pair -> seg 0
+
+    def test_mnli_label_map(self, tokenizer):
+        proc = MnliProcessor()
+        exs = proc.get_train_examples(os.path.join(FIX, "MNLI"))
+        _, _, _, labels = convert_examples_to_arrays(
+            exs, proc.get_labels(), 24, tokenizer)
+        assert set(labels) <= {0, 1, 2}
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        assert accuracy([1, 0, 1], [1, 1, 1]) == pytest.approx(2 / 3)
+
+    def test_matthews_known_value(self):
+        # perfect prediction -> 1; inverted -> -1; constant -> 0
+        assert matthews_corr([1, 0, 1, 0], [1, 0, 1, 0]) == 1.0
+        assert matthews_corr([0, 1, 0, 1], [1, 0, 1, 0]) == -1.0
+        assert matthews_corr([1, 1, 1, 1], [1, 0, 1, 0]) == 0.0
+
+    def test_f1_known_value(self):
+        # preds [1,1,0,0] vs gold [1,0,1,0]: tp=1 fp=1 fn=1 -> f1=0.5
+        assert f1([1, 1, 0, 0], [1, 0, 1, 0]) == pytest.approx(0.5)
+
+    def test_per_task_selection(self):
+        m = compute_metrics("cola", [1, 0], [1, 0])
+        assert "matthews_corr" in m
+        m = compute_metrics("mrpc", [1, 0], [1, 0])
+        assert "f1" in m
+        m = compute_metrics("sst-2", [1, 0], [1, 0])
+        assert set(m) == {"accuracy"}
+
+
+class TestEndToEnd:
+    def test_finetune_example_on_sst2_fixture(self):
+        """The example script drives a real task end-to-end: SST-2
+        fixture through the processor suite; the tiny task (good/bad
+        word polarity) must be learned above chance."""
+        import importlib.util
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "nlp", "finetune_bert_glue.py")
+        spec = importlib.util.spec_from_file_location("ex_glue_task",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        old = sys.argv
+        sys.argv = ["prog", "--task", "sst-2", "--data-dir",
+                    os.path.join(FIX, "SST-2"), "--vocab-path",
+                    os.path.join(FIX, "vocab.txt"),
+                    "--num-layers", "1", "--hidden", "32", "--heads", "2",
+                    "--batch-size", "8", "--seq-len", "16",
+                    "--num-steps", "120", "--eval-every", "120",
+                    "--learning-rate", "2e-3"]
+        try:
+            acc = mod.main()
+        finally:
+            sys.argv = old
+        assert acc > 0.7, acc
