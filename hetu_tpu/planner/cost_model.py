@@ -140,6 +140,22 @@ class LayerSpec:
                    act_bytes_per_sample=act * bytes_per_act,
                    seq_len=seq_len, hidden=hidden, tp_comm_factor=4)
 
+    @classmethod
+    def transformer_decoder(cls, hidden, seq_len, ffn_mult=4, name="dec",
+                            bytes_per_param=4, bytes_per_act=2):
+        """Decoder-only (GPT) block: same params as an encoder layer but
+        CAUSAL attention halves the score/context matmul flops, and the
+        reference prices decoders at a higher per-layer TP activation
+        traffic (cost_model.py:102-103 uses 4 for encoders, 6 for
+        decoders)."""
+        spec = cls.transformer_encoder(hidden, seq_len, ffn_mult=ffn_mult,
+                                       name=name,
+                                       bytes_per_param=bytes_per_param,
+                                       bytes_per_act=bytes_per_act)
+        spec.flops_per_sample -= 2 * seq_len * seq_len * hidden  # causal
+        spec.tp_comm_factor = 6
+        return spec
+
 
 class MemoryCostModel:
     """Per-device memory for one layer under a strategy.

@@ -1,0 +1,201 @@
+"""Environment profiler CLI (reference tools/Galvatron/test_env:
+bandwidth_test / bandwidth_test_dist / overlap_test driven by
+profile_env_8gpus.sh — standalone scripts whose measured coefficients
+feed the cost model).
+
+Profiles the CURRENT jax topology per mesh axis and writes
+ENV_PROFILE.json:
+
+* achieved bf16 matmul TFLOP/s (the compute term),
+* per-axis collective bandwidth — allreduce, all-gather, all-to-all,
+  and neighbor ppermute (the ring/ICI terms the TimeCostModel prices
+  dp grad sync, fsdp gathers, MoE dispatch, and cp KV rotation with),
+* the comm/compute overlap coefficient per axis (the reference's
+  overlap_test measures exactly this; ClusterSpec.overlap consumes it).
+
+Run on any topology:
+
+    python -m hetu_tpu.planner.env_profile --axes dp=4,tp=2
+
+On the virtual CPU mesh the numbers characterize the HOST (useful for
+testing the machinery); on a real multi-chip mesh they are the ICI/DCN
+measurements the one-chip calibration (chip_calibration.py) must
+otherwise leave 'spec-assumed'.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .profiler import _timeit, profile_matmul_throughput
+from ..parallel.mesh import make_mesh
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+ENV_PROFILE_FILE = os.path.join(_REPO, "ENV_PROFILE.json")
+
+
+def _axis_collective_bw(mesh, axis, size_mb=8):
+    """Measured bytes/s for the four collective shapes the cost model
+    prices over one mesh axis."""
+    k = mesh.shape[axis]
+    if k <= 1:
+        return None
+    # k*k so the per-shard buffer also splits k ways (the all-to-all
+    # probe reshapes its shard into k parts)
+    n = int(size_mb * (1 << 20) / 4)
+    n -= n % (k * k)
+    x = jnp.ones((n,), jnp.float32)
+    spec = P(axis)
+
+    def run(body, in_spec, out_spec):
+        # check_vma off: the input is replicated over the mesh's OTHER
+        # axes, which the static varying-axes inference can't always
+        # prove for out_specs P()
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=in_spec,
+                              out_specs=out_spec, check_vma=False))
+        return _timeit(f, x)
+
+    out = {}
+    # ring allreduce: the canonical probe (profiler.py) — one source of
+    # the psum-under-shard_map measurement
+    from .profiler import profile_collective_bandwidth
+    out["allreduce_bytes_per_s"] = profile_collective_bandwidth(
+        mesh, axis, size_mb=size_mb)
+
+    # all-gather: (k-1)/k * N
+    t = run(lambda v: jax.lax.all_gather(v, axis, tiled=True), spec, P())
+    out["allgather_bytes_per_s"] = (k - 1) / k * (n * 4 / k) / t
+
+    # all-to-all: each device exchanges (k-1)/k of its shard
+    def a2a(v):
+        parts = v.reshape(k, -1)
+        return jax.lax.all_to_all(parts, axis, split_axis=0,
+                                  concat_axis=0).reshape(-1)
+    t = run(a2a, spec, spec)
+    out["alltoall_bytes_per_s"] = (k - 1) / k * (n * 4 / k) / t
+
+    # neighbor ppermute (the cp KV rotation primitive): N/k per hop
+    shift = [(i, (i + 1) % k) for i in range(k)]
+    t = run(lambda v: jax.lax.ppermute(v, axis, shift), spec, spec)
+    out["ppermute_bytes_per_s"] = (n * 4 / k) / t
+    return {kk: round(v, 1) for kk, v in out.items()}
+
+
+def _axis_overlap(mesh, axis, compute_dim=1024, size_mb=4):
+    """Comm/compute overlap coefficient over one axis (reference
+    overlap_test): how much of an allreduce hides under an independent
+    matmul dispatched in the same program.
+
+        overlap = (t_compute + t_comm - t_together) / min(t_comm, t_compute)
+    """
+    k = mesh.shape[axis]
+    if k <= 1:
+        return None
+    n = int(size_mb * (1 << 20) / 4)
+    n -= n % k
+    x = jnp.ones((n,), jnp.float32)
+    a = jnp.full((compute_dim, compute_dim), 0.5, jnp.bfloat16)
+
+    def comm(v):
+        return jax.lax.psum(v, axis)
+
+    def compute(m):
+        return m @ m
+
+    f_comm = jax.jit(shard_map(comm, mesh=mesh, in_specs=P(axis),
+                               out_specs=P(), check_vma=False))
+    f_comp = jax.jit(compute)
+
+    def together(v, m):
+        # one program holding both; outputs combined so neither can be
+        # dead-code-eliminated and completion awaits both
+        c = shard_map(comm, mesh=mesh, in_specs=P(axis),
+                      out_specs=P(), check_vma=False)(v)
+        d = compute(m)
+        return c[0] + d[0, 0].astype(jnp.float32)
+    f_both = jax.jit(together)
+
+    t_comm = _timeit(f_comm, x)
+    t_comp = _timeit(f_comp, a)
+    t_both = _timeit(f_both, x, a)
+    saved = t_comm + t_comp - t_both
+    denom = min(t_comm, t_comp)
+    return {
+        "t_comm_ms": round(t_comm * 1e3, 3),
+        "t_compute_ms": round(t_comp * 1e3, 3),
+        "t_together_ms": round(t_both * 1e3, 3),
+        "overlap": round(max(0.0, min(1.0, saved / denom)), 4)
+        if denom > 0 else 0.0,
+    }
+
+
+def profile_env(axes=None, size_mb=8, compute_dim=1024):
+    """Full environment profile for a mesh of ``axes`` (default: one
+    'dp' axis over every visible device)."""
+    if not axes:
+        axes = {"dp": jax.device_count()}
+    mesh = make_mesh(axes)
+    art = {
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "mesh_axes": dict(mesh.shape),
+        # 6 decimals: a CPU-mesh probe at small dims is ~1e-4 TFLOP/s
+        # and must not round to a fake zero
+        "matmul_tflops_bf16": round(
+            profile_matmul_throughput(dim=compute_dim) / 1e12, 6),
+        "axes": {},
+    }
+    for axis in mesh.shape:
+        if mesh.shape[axis] <= 1:
+            continue
+        art["axes"][axis] = {
+            "size": mesh.shape[axis],
+            "collectives": _axis_collective_bw(mesh, axis,
+                                               size_mb=size_mb),
+            "overlap": _axis_overlap(mesh, axis,
+                                     compute_dim=compute_dim,
+                                     size_mb=max(1, size_mb // 2)),
+        }
+    return art
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--axes", default=None,
+                    help="mesh axes, e.g. dp=4,tp=2 (default: dp over "
+                         "all visible devices)")
+    ap.add_argument("--size-mb", type=int, default=8)
+    ap.add_argument("--compute-dim", type=int, default=1024)
+    ap.add_argument("--out", default=ENV_PROFILE_FILE)
+    args = ap.parse_args()
+    axes = None
+    if args.axes:
+        axes = {kv.split("=")[0]: int(kv.split("=")[1])
+                for kv in args.axes.split(",")}
+    art = profile_env(axes, size_mb=args.size_mb,
+                      compute_dim=args.compute_dim)
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({
+        "platform": art["platform"],
+        "matmul_tflops_bf16": art["matmul_tflops_bf16"],
+        "axes": {a: {"allreduce_GBps": round(
+            v["collectives"]["allreduce_bytes_per_s"] / 1e9, 3),
+            "overlap": v["overlap"]["overlap"]}
+            for a, v in art["axes"].items()},
+        "out": os.path.basename(args.out)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -111,10 +111,15 @@ def profile_collective_bandwidth(mesh, axis, size_mb=16):
     if k <= 1:
         return float("inf")
     n = int(size_mb * 1024 * 1024 / 4)
+    n -= n % k
     x = jnp.ones((n,), jnp.float32)
 
+    # check_vma off: the input may be replicated over the mesh's other
+    # axes, which static varying-axes inference can't always prove for
+    # out_specs P()
     f = jax.jit(shard_map(lambda v: jax.lax.psum(v, axis), mesh=mesh,
-                          in_specs=P(axis), out_specs=P()))
+                          in_specs=P(axis), out_specs=P(),
+                          check_vma=False))
     t = _timeit(f, x)
     nbytes = n * 4 / k  # per-device message size (input sharded over axis)
     return 2.0 * (k - 1) / k * nbytes / t

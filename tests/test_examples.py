@@ -51,6 +51,13 @@ def test_plan_bert_example_runs():
                     "--global-batch", "16", "--steps", "1"])
 
 
+def test_plan_gpt_example_runs():
+    mod = _load("nlp/plan_gpt.py", "ex_plan_gpt")
+    _run_main(mod, ["--hidden", "32", "--layers", "2", "--heads", "2",
+                    "--seq-len", "16", "--vocab", "100",
+                    "--global-batch", "16", "--steps", "1"])
+
+
 def test_transformer_mt_learns():
     mod = _load("nlp/train_transformer.py", "ex_mt")
     acc = _run_main(mod, ["--num-steps", "80", "--log-every", "80"])

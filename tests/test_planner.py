@@ -6,6 +6,8 @@ behavior), end-to-end search on a transformer stack, and applying a plan
 to an Executor on the 8-device CPU mesh.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -497,3 +499,51 @@ class TestPlanAssumedConstants:
         assert j["assumed_constants"]["ici_bandwidth"]["provenance"] == \
             "spec-assumed"
         assert "NOT from measurement" in plan.describe()
+
+
+class TestEnvProfiler:
+    """Environment profiler CLI (reference tools/Galvatron/test_env
+    bandwidth/overlap scripts): per-axis collective bandwidths + overlap
+    coefficient measured on the current mesh."""
+
+    def test_profile_env_structure(self, tmp_path):
+        from hetu_tpu.planner.env_profile import profile_env
+        art = profile_env({"dp": 2, "tp": 2}, size_mb=1, compute_dim=128)
+        assert set(art["axes"]) == {"dp", "tp"}
+        for ax in ("dp", "tp"):
+            c = art["axes"][ax]["collectives"]
+            for key in ("allreduce_bytes_per_s", "allgather_bytes_per_s",
+                        "alltoall_bytes_per_s", "ppermute_bytes_per_s"):
+                assert c[key] > 0, (ax, key)
+            ov = art["axes"][ax]["overlap"]
+            assert 0.0 <= ov["overlap"] <= 1.0
+        assert art["matmul_tflops_bf16"] > 0
+
+    def test_cli_writes_artifact(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+        out = tmp_path / "env.json"
+        r = subprocess.run(
+            [sys.executable, "-m", "hetu_tpu.planner.env_profile",
+             "--axes", "dp=2", "--size-mb", "1", "--compute-dim", "128",
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ,
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                 "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.join(os.path.dirname(__file__), ".."))
+        assert r.returncode == 0, r.stderr[-500:]
+        art = json.loads(out.read_text())
+        assert "dp" in art["axes"]
+
+
+class TestDecoderLayerSpec:
+    def test_decoder_vs_encoder(self):
+        enc = LayerSpec.transformer_encoder(64, 32)
+        dec = LayerSpec.transformer_decoder(64, 32)
+        assert dec.param_bytes == enc.param_bytes
+        # causal halves the 2*2*S^2*H attention flops
+        assert dec.flops_per_sample == \
+            enc.flops_per_sample - 2 * 32 * 32 * 64
+        assert dec.tp_comm_factor == 6 and enc.tp_comm_factor == 4
