@@ -1,0 +1,266 @@
+// Native PS "van": a C++ TCP serving loop for the sparse hot path.
+//
+// Reference: ps-lite's Van tier (ps-lite/src/zmq_van.h, p3_van.h) — the
+// reference serves its KV traffic entirely from C++ threads; the Python
+// PSServer here is the correctness/feature surface (full PSFunc API,
+// SSP/BSP, cache sync), and this van is the THROUGHPUT tier for the one
+// pattern that dominates CTR training: sparse push / pull / push-pull
+// on embedding tables with a server-side optimizer.
+//
+// Design:
+//   * the table's numpy buffer is REGISTERED (pointer + shape) — zero
+//     serialization between the van and the Python-visible array;
+//   * one acceptor thread + one thread per connection (worker counts
+//     are small); blocking I/O, one reusable buffer per connection;
+//   * binary little-endian framing (u32 len | u8 op | u32 key | u32 n |
+//     i64 ids[n] | f32 rows[n*dim]); responses are (u32 len | u8 ok |
+//     f32 rows...) — no Python, no pickle, no text on the wire;
+//   * per-table mutex, also exported (van_table_lock/unlock) so Python
+//     paths touching a registered table can coordinate;
+//   * sequential scatter handles duplicate ids exactly like the Python
+//     server's dedup-merge does for SGD (order-insensitive sum).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 -pthread ps_van.cpp
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Table {
+  float* value = nullptr;
+  int64_t nrows = 0;
+  int64_t dim = 0;
+  float lr = 0.0f;           // server-side SGD step
+  int64_t* versions = nullptr;  // optional HET version counters
+  std::mutex mu;
+};
+
+struct Van {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread acceptor;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;        // for shutdown() at stop
+  std::mutex conns_mu;
+  std::map<uint32_t, Table*> tables;
+  std::mutex tables_mu;
+  ~Van() {
+    for (auto& kv : tables) delete kv.second;
+  }
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+enum Op : uint8_t { kPush = 1, kPull = 2, kPushPull = 3 };
+
+void serve_conn(Van* van, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<char> buf;
+  std::vector<char> out;
+  while (van->running.load()) {
+    uint32_t len = 0;
+    if (!read_exact(fd, &len, 4)) break;
+    if (len < 9 || len > (1u << 30)) break;   // 1 GiB frame cap
+    buf.resize(len);
+    if (!read_exact(fd, buf.data(), len)) break;
+    uint8_t op = static_cast<uint8_t>(buf[0]);
+    uint32_t key, n;
+    std::memcpy(&key, buf.data() + 1, 4);
+    std::memcpy(&n, buf.data() + 5, 4);
+    Table* t = nullptr;
+    {
+      std::lock_guard<std::mutex> g(van->tables_mu);
+      auto it = van->tables.find(key);
+      if (it != van->tables.end()) t = it->second;
+    }
+    size_t ids_bytes = static_cast<size_t>(n) * 8;
+    const int64_t* ids =
+        reinterpret_cast<const int64_t*>(buf.data() + 9);
+    bool ok = t != nullptr && 9 + ids_bytes <= len;
+    size_t row_bytes =
+        t ? static_cast<size_t>(n) * t->dim * 4 : 0;
+    const float* rows =
+        reinterpret_cast<const float*>(buf.data() + 9 + ids_bytes);
+    if (ok && (op == kPush || op == kPushPull))
+      ok = 9 + ids_bytes + row_bytes == len;
+    if (ok && op == kPull) ok = 9 + ids_bytes == len;
+    if (ok) {
+      for (uint32_t i = 0; i < n; ++i)
+        if (ids[i] < 0 || ids[i] >= t->nrows) { ok = false; break; }
+    }
+    uint32_t out_payload =
+        ok && (op == kPull || op == kPushPull)
+            ? static_cast<uint32_t>(row_bytes) : 0;
+    out.resize(4 + 1 + out_payload);
+    uint32_t out_len = 1 + out_payload;
+    std::memcpy(out.data(), &out_len, 4);
+    out[4] = ok ? 1 : 0;
+    if (ok) {
+      std::lock_guard<std::mutex> g(t->mu);
+      if (op == kPush || op == kPushPull) {
+        const int64_t dim = t->dim;
+        for (uint32_t i = 0; i < n; ++i) {
+          float* dst = t->value + ids[i] * dim;
+          const float* src = rows + static_cast<int64_t>(i) * dim;
+          const float lr = t->lr;
+          for (int64_t d = 0; d < dim; ++d) dst[d] -= lr * src[d];
+        }
+        if (t->versions != nullptr)
+          for (uint32_t i = 0; i < n; ++i) ++t->versions[ids[i]];
+      }
+      if (op == kPull || op == kPushPull) {
+        const int64_t dim = t->dim;
+        float* dst = reinterpret_cast<float*>(out.data() + 5);
+        for (uint32_t i = 0; i < n; ++i)
+          std::memcpy(dst + static_cast<int64_t>(i) * dim,
+                      t->value + ids[i] * dim, dim * 4);
+      }
+    }
+    if (!write_all(fd, out.data(), out.size())) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Van* van) {
+  while (van->running.load()) {
+    int fd = ::accept(van->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (!van->running.load()) break;
+      continue;
+    }
+    std::lock_guard<std::mutex> g(van->conns_mu);
+    van->conn_fds.push_back(fd);
+    van->conns.emplace_back(serve_conn, van, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* van_create() { return new Van(); }
+
+// 0 on failure; the bound port otherwise (pass port=0 for ephemeral)
+int van_listen(void* h, int port) {
+  Van* van = static_cast<Van*>(h);
+  van->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (van->listen_fd < 0) return 0;
+  int one = 1;
+  ::setsockopt(van->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
+               sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(van->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0)
+    return 0;
+  if (::listen(van->listen_fd, 64) != 0) return 0;
+  socklen_t alen = sizeof(addr);
+  ::getsockname(van->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                &alen);
+  van->port = ntohs(addr.sin_port);
+  van->running.store(true);
+  van->acceptor = std::thread(accept_loop, van);
+  return van->port;
+}
+
+void van_register_sgd_table(void* h, uint32_t key, float* value,
+                            int64_t nrows, int64_t dim, float lr,
+                            int64_t* versions) {
+  Van* van = static_cast<Van*>(h);
+  Table* t = new Table();
+  t->value = value;
+  t->nrows = nrows;
+  t->dim = dim;
+  t->lr = lr;
+  t->versions = versions;
+  std::lock_guard<std::mutex> g(van->tables_mu);
+  auto it = van->tables.find(key);
+  if (it != van->tables.end()) delete it->second;
+  van->tables[key] = t;
+}
+
+// Python paths touching a registered table's buffer coordinate here
+void van_table_lock(void* h, uint32_t key) {
+  Van* van = static_cast<Van*>(h);
+  Table* t = nullptr;
+  {
+    std::lock_guard<std::mutex> g(van->tables_mu);
+    auto it = van->tables.find(key);
+    if (it == van->tables.end()) return;
+    t = it->second;
+  }
+  t->mu.lock();
+}
+
+void van_table_unlock(void* h, uint32_t key) {
+  Van* van = static_cast<Van*>(h);
+  Table* t = nullptr;
+  {
+    std::lock_guard<std::mutex> g(van->tables_mu);
+    auto it = van->tables.find(key);
+    if (it == van->tables.end()) return;
+    t = it->second;
+  }
+  t->mu.unlock();
+}
+
+void van_stop(void* h) {
+  Van* van = static_cast<Van*>(h);
+  if (!van->running.exchange(false)) return;
+  if (van->listen_fd >= 0) ::shutdown(van->listen_fd, SHUT_RDWR);
+  if (van->listen_fd >= 0) ::close(van->listen_fd);
+  if (van->acceptor.joinable()) van->acceptor.join();
+  {
+    // unblock readers; their own close() runs at thread exit
+    std::lock_guard<std::mutex> g(van->conns_mu);
+    for (int fd : van->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& th : van->conns)
+    if (th.joinable()) th.join();
+  van->conns.clear();
+  van->conn_fds.clear();
+  van->listen_fd = -1;
+}
+
+void van_destroy(void* h) {
+  van_stop(h);
+  delete static_cast<Van*>(h);
+}
+
+}  // extern "C"
