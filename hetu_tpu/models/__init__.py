@@ -16,7 +16,8 @@ BERT/Transformer are classes (the reference's BERT is class-based too).
 
 from .cnn import (
     mlp, logreg, cnn_3_layers, lenet, alexnet, vgg, vgg16, vgg19,
-    resnet, resnet18, resnet34, resnet50, rnn, lstm, fc,
+    resnet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    rnn, lstm, fc,
 )
 from .bert import (
     BertConfig, BertModel, BertForPreTraining,

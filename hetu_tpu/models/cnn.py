@@ -237,6 +237,14 @@ def resnet50(x, y_, num_class=10):
     return resnet(x, y_, num_layers=50, num_class=num_class)
 
 
+def resnet101(x, y_, num_class=10):
+    return resnet(x, y_, num_layers=101, num_class=num_class)
+
+
+def resnet152(x, y_, num_class=10):
+    return resnet(x, y_, num_layers=152, num_class=num_class)
+
+
 # ---------------------------------------------------------------- recurrent
 #
 # The reference unrolls 28 timesteps at graph-build time (RNN.py:39-55,

@@ -89,6 +89,14 @@ class TestConvModels:
         loss, pred = models.resnet50(x, y_)
         assert loss is not None
 
+    def test_resnet101_and_152_build(self):
+        # full reference depth coverage (ResNet.py plans table)
+        for fn in (models.resnet101, models.resnet152):
+            x = ht.placeholder_op("x")
+            y_ = ht.placeholder_op("y_")
+            loss, pred = fn(x, y_)
+            assert loss is not None
+
     def test_alexnet(self):
         self._run(models.alexnet, lr=1e-4)
 
