@@ -34,6 +34,7 @@
 #include <map>
 #include <mutex>
 #include <thread>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -111,44 +112,61 @@ void serve_conn(Van* van, int fd) {
     const int64_t* ids =
         reinterpret_cast<const int64_t*>(buf.data() + 9);
     bool ok = t != nullptr && 9 + ids_bytes <= len;
-    size_t row_bytes =
-        t ? static_cast<size_t>(n) * t->dim * 4 : 0;
-    const float* rows =
-        reinterpret_cast<const float*>(buf.data() + 9 + ids_bytes);
-    if (ok && (op == kPush || op == kPushPull))
-      ok = 9 + ids_bytes + row_bytes == len;
-    if (ok && op == kPull) ok = 9 + ids_bytes == len;
     if (ok) {
-      for (uint32_t i = 0; i < n; ++i)
-        if (ids[i] < 0 || ids[i] >= t->nrows) { ok = false; break; }
-    }
-    uint32_t out_payload =
-        ok && (op == kPull || op == kPushPull)
-            ? static_cast<uint32_t>(row_bytes) : 0;
-    out.resize(4 + 1 + out_payload);
-    uint32_t out_len = 1 + out_payload;
-    std::memcpy(out.data(), &out_len, 4);
-    out[4] = ok ? 1 : 0;
-    if (ok) {
+      // the WHOLE request — shape reads, bounds validation, scatter,
+      // gather — runs under the table mutex: an in-place re-register
+      // may change value/nrows/dim between any two of those steps
       std::lock_guard<std::mutex> g(t->mu);
-      if (op == kPush || op == kPushPull) {
-        const int64_t dim = t->dim;
-        for (uint32_t i = 0; i < n; ++i) {
-          float* dst = t->value + ids[i] * dim;
-          const float* src = rows + static_cast<int64_t>(i) * dim;
-          const float lr = t->lr;
-          for (int64_t d = 0; d < dim; ++d) dst[d] -= lr * src[d];
-        }
-        if (t->versions != nullptr)
-          for (uint32_t i = 0; i < n; ++i) ++t->versions[ids[i]];
-      }
-      if (op == kPull || op == kPushPull) {
-        const int64_t dim = t->dim;
-        float* dst = reinterpret_cast<float*>(out.data() + 5);
+      size_t row_bytes = static_cast<size_t>(n) * t->dim * 4;
+      const float* rows =
+          reinterpret_cast<const float*>(buf.data() + 9 + ids_bytes);
+      if (op == kPush || op == kPushPull)
+        ok = 9 + ids_bytes + row_bytes == len;
+      else
+        ok = 9 + ids_bytes == len;
+      if (ok) {
         for (uint32_t i = 0; i < n; ++i)
-          std::memcpy(dst + static_cast<int64_t>(i) * dim,
-                      t->value + ids[i] * dim, dim * 4);
+          if (ids[i] < 0 || ids[i] >= t->nrows) { ok = false; break; }
       }
+      uint32_t out_payload =
+          ok && (op == kPull || op == kPushPull)
+              ? static_cast<uint32_t>(row_bytes) : 0;
+      out.resize(4 + 1 + out_payload);
+      uint32_t out_len = 1 + out_payload;
+      std::memcpy(out.data(), &out_len, 4);
+      out[4] = ok ? 1 : 0;
+      if (ok) {
+        if (op == kPush || op == kPushPull) {
+          const int64_t dim = t->dim;
+          for (uint32_t i = 0; i < n; ++i) {
+            float* dst = t->value + ids[i] * dim;
+            const float* src = rows + static_cast<int64_t>(i) * dim;
+            const float lr = t->lr;
+            for (int64_t d = 0; d < dim; ++d) dst[d] -= lr * src[d];
+          }
+          if (t->versions != nullptr) {
+            // one bump per UNIQUE id, matching the python tier's
+            // ps_bump_versions dedup — HET staleness counters must not
+            // diverge by tier
+            std::unordered_set<int64_t> seen;
+            seen.reserve(n);
+            for (uint32_t i = 0; i < n; ++i)
+              if (seen.insert(ids[i]).second) ++t->versions[ids[i]];
+          }
+        }
+        if (op == kPull || op == kPushPull) {
+          const int64_t dim = t->dim;
+          float* dst = reinterpret_cast<float*>(out.data() + 5);
+          for (uint32_t i = 0; i < n; ++i)
+            std::memcpy(dst + static_cast<int64_t>(i) * dim,
+                        t->value + ids[i] * dim, dim * 4);
+        }
+      }
+    } else {
+      out.resize(5);
+      uint32_t out_len = 1;
+      std::memcpy(out.data(), &out_len, 4);
+      out[4] = 0;
     }
     if (!write_all(fd, out.data(), out.size())) break;
   }
@@ -203,16 +221,32 @@ void van_register_sgd_table(void* h, uint32_t key, float* value,
                             int64_t nrows, int64_t dim, float lr,
                             int64_t* versions) {
   Van* van = static_cast<Van*>(h);
-  Table* t = new Table();
-  t->value = value;
-  t->nrows = nrows;
-  t->dim = dim;
-  t->lr = lr;
-  t->versions = versions;
-  std::lock_guard<std::mutex> g(van->tables_mu);
-  auto it = van->tables.find(key);
-  if (it != van->tables.end()) delete it->second;
-  van->tables[key] = t;
+  Table* existing = nullptr;
+  {
+    std::lock_guard<std::mutex> g(van->tables_mu);
+    auto it = van->tables.find(key);
+    if (it == van->tables.end()) {
+      Table* t = new Table();
+      t->value = value;
+      t->nrows = nrows;
+      t->dim = dim;
+      t->lr = lr;
+      t->versions = versions;
+      van->tables[key] = t;
+      return;
+    }
+    existing = it->second;
+  }
+  // re-register updates IN PLACE under the table mutex, which is taken
+  // AFTER releasing tables_mu: holding both here would ABBA-deadlock
+  // against van_table_unlock (holds t->mu, then looks up via
+  // tables_mu).  Tables are never deleted, so `existing` stays valid.
+  std::lock_guard<std::mutex> tg(existing->mu);
+  existing->value = value;
+  existing->nrows = nrows;
+  existing->dim = dim;
+  existing->lr = lr;
+  existing->versions = versions;
 }
 
 // Python paths touching a registered table's buffer coordinate here

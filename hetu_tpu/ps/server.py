@@ -348,10 +348,67 @@ class PSServer:
         self._tcp = _serve_object_tcp(self, port, block)
         return self._tcp
 
+    def serve_van(self, keys=None, port=0):
+        """Attach the native C++ van (ps/van.py, reference ps-lite
+        zmq_van tier): the selected tables' sparse push/pull/push-pull
+        are served zero-copy by C++ threads ON THE SAME BUFFERS the
+        python PSFunc surface uses.  Only 2-D float32 tables with a
+        server-side SGD optimizer qualify (the van applies SGD
+        in-kernel); their python lock becomes a composite lock shared
+        with the van's per-table mutex, so both tiers serialize.
+
+        Returns (port, {key: van_key_id}) — VanClient speaks van ids.
+        """
+        from .van import NativeVan, VanSharedLock
+        with self.lock:
+            if getattr(self, "_van", None) is None:
+                self._van = NativeVan()
+                self._van_port = self._van.listen(port)
+                self._van_keys = {}
+            if keys is None:
+                keys = [k for k, p in self.params.items()
+                        if isinstance(p.optimizer, ServerSGD)
+                        and p.value.ndim == 2
+                        and p.value.dtype == np.float32]
+            for k in keys:
+                if k in self._van_keys:
+                    continue
+                p = self.params[k]
+                if not (isinstance(p.optimizer, ServerSGD)
+                        and p.value.ndim == 2
+                        and p.value.dtype == np.float32):
+                    raise ValueError(
+                        f"van can only serve 2-D float32 SGD tables; "
+                        f"{k!r} is {p.value.dtype}/{p.value.ndim}-D with "
+                        f"{type(p.optimizer).__name__}")
+                kid = len(self._van_keys)
+                # the registered (contiguous) array IS the served
+                # buffer; the param points at exactly it and shares the
+                # van's per-table mutex
+                p.value = self._van.register_sgd_table(
+                    kid, p.value, lr=p.optimizer.lr, versions=p.versions)
+                p.lock = VanSharedLock(p.lock, self._van, kid)
+                self._van_keys[k] = kid
+        return self._van_port, dict(self._van_keys)
+
     def shutdown(self):
         if getattr(self, "_tcp", None) is not None:
             self._tcp.shutdown()
             self._tcp = None
+        if getattr(self, "_van", None) is not None:
+            from .van import VanSharedLock
+            with self.lock:
+                # restore plain python locks BEFORE stopping the van: a
+                # VanSharedLock over a destroyed handle would crash any
+                # later PSFunc op on the key
+                for k in getattr(self, "_van_keys", {}):
+                    p = self.params.get(k)
+                    if p is not None and isinstance(p.lock,
+                                                    VanSharedLock):
+                        p.lock = p.lock.pylock
+                self._van_keys = {}
+            self._van.stop()
+            self._van = None
 
     # ---------------- PSFunc surface ---------------- #
 
@@ -396,6 +453,11 @@ class PSServer:
         if opt is not None:
             optimizer = SERVER_OPTIMIZERS[opt](**(opt_args or {}))
         with self.lock:
+            if key in getattr(self, "_van_keys", {}):
+                raise ValueError(
+                    f"{key!r} is served by the native van; replacing its "
+                    f"buffer would detach the C++ tier — use "
+                    f"param_assign (in-place) instead")
             self.params[key] = _Param(value, optimizer)
             return True
 
@@ -415,6 +477,10 @@ class PSServer:
 
     def param_clear(self, key):
         with self.lock:
+            if key in getattr(self, "_van_keys", {}):
+                raise ValueError(
+                    f"{key!r} is served by the native van; clearing it "
+                    f"would leave the C++ tier serving freed memory")
             self.params.pop(key, None)
 
     def param_save(self, key, path):

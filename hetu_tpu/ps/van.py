@@ -120,6 +120,28 @@ class NativeVan:
             pass
 
 
+class VanSharedLock:
+    """Composite lock for a table served by BOTH tiers: acquires the
+    python _Param lock AND the van's per-table C++ mutex, so python
+    PSFunc paths and C++ van threads serialize on the same buffer.
+    Drop-in for the ``with p.lock:`` sites in ps/server.py."""
+
+    def __init__(self, pylock, van, key_id):
+        self.pylock = pylock
+        self.van = van
+        self.key_id = int(key_id)
+
+    def __enter__(self):
+        self.pylock.acquire()
+        self.van.table_lock(self.key_id)
+        return self
+
+    def __exit__(self, *exc):
+        self.van.table_unlock(self.key_id)
+        self.pylock.release()
+        return False
+
+
 class VanClient:
     """Blocking binary-protocol client for one van."""
 

@@ -154,7 +154,8 @@ class TestNativeVan:
                                lr=0.1, versions=versions)
         cli = VanClient("127.0.0.1", port, dim=2)
         cli.push(1, np.array([2, 2, 5]), np.ones((3, 2), np.float32))
-        assert versions[2] == 2 and versions[5] == 1
+        # one bump per UNIQUE id per request (python-tier parity)
+        assert versions[2] == 1 and versions[5] == 1
         assert versions[0] == 0
         cli.close()
         van.stop()
@@ -187,3 +188,169 @@ class TestNativeVan:
         # every update applied exactly once: value = -N*per
         np.testing.assert_allclose(value, -float(N * per))
         van.stop()
+
+
+class TestVanServerIntegration:
+    """PSServer.serve_van: one table served by BOTH tiers — the python
+    PSFunc surface and the C++ van — consistently on the same buffer."""
+
+    def test_both_tiers_update_one_buffer(self):
+        from hetu_tpu.ps.server import PSServer
+        from hetu_tpu.ps.van import VanClient, van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        PSServer._instance = None
+        srv = PSServer.get()
+        srv.param_init("emb", (32, 4), "constant", 0.0, opt="sgd",
+                       opt_args={"learning_rate": 1.0})
+        port, keymap = srv.serve_van(["emb"])
+        try:
+            cli = VanClient("127.0.0.1", port, dim=4)
+            ids = np.arange(8)
+            g = np.ones((8, 4), np.float32)
+            cli.push(keymap["emb"], ids, g)          # via the van
+            srv.sparse_push("emb", ids, g)           # via python PSFunc
+            # both updates landed on the SAME buffer
+            got = srv.sparse_pull("emb", ids)
+            np.testing.assert_allclose(got, -2.0)
+            got_van = cli.pull(keymap["emb"], ids)
+            np.testing.assert_allclose(got_van, -2.0)
+            # versions bumped by both tiers (HET sync sees van pushes)
+            s_ids, _, vers = srv.sync_embedding(
+                "emb", ids, np.zeros(8, np.int64), 0)
+            assert len(s_ids) == 8 and (vers == 2).all()
+            cli.close()
+        finally:
+            srv.shutdown()
+            PSServer._instance = None
+
+    def test_concurrent_tiers_serialize(self):
+        from hetu_tpu.ps.server import PSServer
+        from hetu_tpu.ps.van import VanClient, van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        import threading
+        PSServer._instance = None
+        srv = PSServer.get()
+        srv.param_init("t", (64, 4), "constant", 0.0, opt="sgd",
+                       opt_args={"learning_rate": 1.0})
+        port, keymap = srv.serve_van(["t"])
+        try:
+            ids = np.arange(64)
+            g = np.ones((64, 4), np.float32)
+            per = 40
+
+            def via_van():
+                c = VanClient("127.0.0.1", port, dim=4)
+                for _ in range(per):
+                    c.push(keymap["t"], ids, g)
+                c.close()
+
+            def via_python():
+                for _ in range(per):
+                    srv.sparse_push("t", ids, g)
+
+            ts = [threading.Thread(target=via_van),
+                  threading.Thread(target=via_python),
+                  threading.Thread(target=via_van)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            np.testing.assert_allclose(srv.sparse_pull("t", ids),
+                                       -float(3 * per))
+        finally:
+            srv.shutdown()
+            PSServer._instance = None
+
+    def test_non_sgd_table_rejected(self):
+        from hetu_tpu.ps.server import PSServer
+        from hetu_tpu.ps.van import van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        PSServer._instance = None
+        srv = PSServer.get()
+        srv.param_init("ad", (8, 2), "constant", 0.0, opt="adam",
+                       opt_args={"learning_rate": 0.1})
+        try:
+            with pytest.raises(ValueError):
+                srv.serve_van(["ad"])
+            # auto-selection simply skips non-qualifying tables
+            port, keymap = srv.serve_van()
+            assert "ad" not in keymap
+        finally:
+            srv.shutdown()
+            PSServer._instance = None
+
+
+def test_van_served_keys_refuse_buffer_replacement():
+    from hetu_tpu.ps.server import PSServer
+    from hetu_tpu.ps.van import van_available
+    if not van_available():
+        pytest.skip("no C++ toolchain")
+    PSServer._instance = None
+    srv = PSServer.get()
+    srv.param_init("k", (8, 2), "constant", 0.0, opt="sgd",
+                   opt_args={"learning_rate": 0.1})
+    srv.serve_van(["k"])
+    try:
+        with pytest.raises(ValueError):
+            srv.param_set("k", np.ones((8, 2), np.float32))
+        with pytest.raises(ValueError):
+            srv.param_clear("k")
+        # the in-place path stays open (checkpoint restore)
+        srv.param_assign("k", np.full((8, 2), 3.0, np.float32))
+        np.testing.assert_allclose(
+            srv.sparse_pull("k", np.arange(8)), 3.0)
+    finally:
+        srv.shutdown()
+        PSServer._instance = None
+
+
+def test_van_version_dedup_matches_python_tier():
+    """[5,5,5] in one push bumps versions[5] ONCE on both tiers (HET
+    staleness counters must not diverge by tier)."""
+    from hetu_tpu.ps.server import PSServer
+    from hetu_tpu.ps.van import VanClient, van_available
+    if not van_available():
+        pytest.skip("no C++ toolchain")
+    PSServer._instance = None
+    srv = PSServer.get()
+    srv.param_init("vd", (16, 2), "constant", 0.0, opt="sgd",
+                   opt_args={"learning_rate": 0.1})
+    port, keymap = srv.serve_van(["vd"])
+    try:
+        cli = VanClient("127.0.0.1", port, dim=2)
+        dup = np.array([5, 5, 5, 2])
+        cli.push(keymap["vd"], dup, np.ones((4, 2), np.float32))
+        srv.sparse_push("vd", dup, np.ones((4, 2), np.float32))
+        _, _, vers = srv.sync_embedding("vd", np.array([5, 2]),
+                                        np.zeros(2, np.int64), 0)
+        assert list(vers) == [2, 2], vers   # one bump per tier each
+        cli.close()
+    finally:
+        srv.shutdown()
+        PSServer._instance = None
+
+
+def test_shutdown_restores_python_locks():
+    """PSFunc ops on a formerly-van-served key keep working after
+    shutdown (the composite lock is unwound, no dead C++ handle)."""
+    from hetu_tpu.ps.server import PSServer
+    from hetu_tpu.ps.van import van_available
+    if not van_available():
+        pytest.skip("no C++ toolchain")
+    PSServer._instance = None
+    srv = PSServer.get()
+    srv.param_init("s", (8, 2), "constant", 1.0, opt="sgd",
+                   opt_args={"learning_rate": 0.5})
+    srv.serve_van(["s"])
+    srv.shutdown()
+    # van gone: the python surface still serves the key...
+    np.testing.assert_allclose(srv.sparse_pull("s", np.arange(8)), 1.0)
+    srv.sparse_push("s", np.array([0]), np.ones((1, 2), np.float32))
+    np.testing.assert_allclose(srv.sparse_pull("s", np.array([0])), 0.5)
+    # ...and the replace/clear guards lift
+    srv.param_set("s", np.zeros((8, 2), np.float32))
+    srv.param_clear("s")
+    PSServer._instance = None
