@@ -53,6 +53,7 @@ from . import tokenizers
 from . import planner
 from . import onnx
 from . import graphboard
+from . import hf
 from . import launcher
 
 # MoE / communication op surface

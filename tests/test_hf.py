@@ -1,0 +1,153 @@
+"""HuggingFace checkpoint import parity (hetu_tpu/hf.py): the SAME
+random transformers weights produce the SAME outputs through torch and
+through this framework's executor — numerical validation of the BERT
+and GPT-2 families against the canonical implementations (beyond the
+reference, which has no pretrained-weight interop)."""
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _bert_pair(heads=False):
+    from transformers import BertConfig as HFC
+    from transformers import BertForPreTraining as HFPre
+    from transformers import BertModel as HFM
+    hf_cfg = HFC(vocab_size=120, hidden_size=32, num_hidden_layers=2,
+                 num_attention_heads=2, intermediate_size=64,
+                 max_position_embeddings=16, hidden_act="gelu_new",
+                 hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = (HFPre if heads else HFM)(hf_cfg).eval()
+    from hetu_tpu.models import BertConfig
+    cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=16, batch_size=2, seq_len=8,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    return hf, cfg
+
+
+def _feed():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 120, (2, 8))
+    tt = np.zeros((2, 8))
+    return ids, tt
+
+
+class TestBertImport:
+    def test_backbone_forward_parity(self):
+        hf, cfg = _bert_pair()
+        ids_np, tt_np = _feed()
+        with torch.no_grad():
+            o = hf(input_ids=torch.tensor(ids_np),
+                   token_type_ids=torch.tensor(tt_np.astype(np.int64)))
+        from hetu_tpu.models import BertModel
+        m = BertModel(cfg, name="hfb")
+        ids = ht.placeholder_op("hfb_ids")
+        tt = ht.placeholder_op("hfb_tt")
+        seq, pooled = m(ids, tt)
+        ex = ht.Executor({"fwd": [seq, pooled]})
+        params = ht.hf.convert_bert(hf.state_dict(), name="hfb")
+        ex.load_dict(params)   # load_dict skips unknown keys itself
+        got_seq, got_pool = ex.run(
+            "fwd", feed_dict={ids: ids_np.astype(np.int32),
+                              tt: tt_np.astype(np.int32)},
+            convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(
+            got_seq, o.last_hidden_state.numpy().reshape(16, 32),
+            atol=2e-4)
+        np.testing.assert_allclose(got_pool, o.pooler_output.numpy(),
+                                   atol=2e-4)
+
+    def test_pretraining_heads_logit_parity(self):
+        hf, cfg = _bert_pair(heads=True)
+        ids_np, tt_np = _feed()
+        with torch.no_grad():
+            o = hf(input_ids=torch.tensor(ids_np),
+                   token_type_ids=torch.tensor(tt_np.astype(np.int64)))
+        from hetu_tpu.models import BertForPreTraining
+        m = BertForPreTraining(cfg, name="hfp")
+        ids = ht.placeholder_op("hfp_ids")
+        tt = ht.placeholder_op("hfp_tt")
+        logits, nsp_logits = m(ids, tt)
+        ex = ht.Executor({"fwd": [logits, nsp_logits]})
+        params = ht.hf.convert_bert_pretraining_heads(hf.state_dict(),
+                                                      name="hfp")
+        missing = set(ex.var_values) - set(params)
+        assert not missing, missing
+        ex.load_dict(params)
+        got_mlm, got_nsp = ex.run(
+            "fwd", feed_dict={ids: ids_np.astype(np.int32),
+                              tt: tt_np.astype(np.int32)},
+            convert_to_numpy_ret_vals=True)
+        # fp32 accumulation through the [*, vocab] head matmul widens
+        # the backbone's ~1e-4 to ~1e-3 on logit scale
+        np.testing.assert_allclose(
+            got_mlm, o.prediction_logits.numpy().reshape(16, 120),
+            atol=2e-3)
+        np.testing.assert_allclose(
+            got_nsp, o.seq_relationship_logits.numpy(), atol=2e-4)
+
+
+class TestGPT2Import:
+    def _pair(self, lm=False):
+        from transformers import GPT2Config as HFC
+        from transformers import GPT2LMHeadModel as HFLM
+        from transformers import GPT2Model as HFM
+        hf_cfg = HFC(vocab_size=130, n_embd=32, n_layer=2, n_head=2,
+                     n_positions=16, resid_pdrop=0.0, embd_pdrop=0.0,
+                     attn_pdrop=0.0)
+        torch.manual_seed(1)
+        hf = (HFLM if lm else HFM)(hf_cfg).eval()
+        from hetu_tpu.models import GPTConfig
+        cfg = GPTConfig(vocab_size=130, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=2,
+                        max_position_embeddings=16, batch_size=2,
+                        seq_len=8, dropout_rate=0.0)
+        return hf, cfg
+
+    def test_backbone_forward_parity(self):
+        hf, cfg = self._pair()
+        rng = np.random.RandomState(0)
+        ids_np = rng.randint(0, 130, (2, 8))
+        with torch.no_grad():
+            o = hf(input_ids=torch.tensor(ids_np))
+        from hetu_tpu.models import GPTModel
+        m = GPTModel(cfg, name="hfg")
+        ids = ht.placeholder_op("hfg_ids")
+        h = m(ids)
+        ex = ht.Executor({"fwd": [h]})
+        params = ht.hf.convert_gpt2(hf.state_dict(), name="hfg")
+        missing = set(ex.var_values) - set(params)
+        assert not missing, missing
+        ex.load_dict(params)
+        got = ex.run("fwd", feed_dict={ids: ids_np.astype(np.int32)},
+                     convert_to_numpy_ret_vals=True)[0]
+        np.testing.assert_allclose(
+            got, o.last_hidden_state.numpy().reshape(16, 32), atol=2e-5)
+
+    def test_lm_logits_parity_through_tied_head(self):
+        hf, cfg = self._pair(lm=True)
+        rng = np.random.RandomState(0)
+        ids_np = rng.randint(0, 130, (2, 8))
+        with torch.no_grad():
+            o = hf(input_ids=torch.tensor(ids_np))
+        from hetu_tpu.models import GPTForCausalLM
+        m = GPTForCausalLM(cfg, name="hfl")
+        ids = ht.placeholder_op("hfl_ids")
+        logits = m(ids)
+        ex = ht.Executor({"fwd": [logits]})
+        params = ht.hf.convert_gpt2(hf.state_dict(), name="hfl",
+                                    prefix="transformer.")
+        # our head bias is a fresh zero param; HF's tied head has none
+        ex.load_dict(params)
+        got = ex.run("fwd", feed_dict={ids: ids_np.astype(np.int32)},
+                     convert_to_numpy_ret_vals=True)[0]
+        np.testing.assert_allclose(
+            got, o.logits.numpy().reshape(16, 130), atol=5e-4)
