@@ -164,6 +164,14 @@ def main():
                               cfg.seq_len)
         logger.info("greedy continuation of %s: %s", prompt,
                     seq[len(prompt):])
+        # same weights through the KV-cached scan (the serving path):
+        # O(S) attention per token instead of a full forward per token
+        from hetu_tpu.models.gpt_decode import generate_fast
+        fast = generate_fast(executor.var_values, cfg, prompt,
+                             num_tokens=n)
+        logger.info("kv-cached continuation: %s (match=%s)",
+                    fast[0, len(prompt):].tolist(),
+                    fast[0].tolist() == seq)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,184 @@
+"""Fast autoregressive decoding for the GPT family: KV-cached
+incremental steps inside ONE jitted lax.scan.
+
+``greedy_generate`` (gpt.py) re-runs the full fixed-S forward per token
+— O(S^2) attention per token, O(S^3) per sequence — which is the
+static-shape-simple demo path.  This module is the serving path: a
+preallocated [L, B, S_max, H, Dh] KV cache updated at the current
+position via dynamic_update_slice, attention masked to the filled
+prefix, the WHOLE generation (prompt teacher-forcing + sampling) one
+compiled scan.  O(S) attention per token; one compile per
+(batch, S_max) shape.
+
+Weights come from the executor's named parameters (the same contract
+hf.py's importers target), so a trained-or-imported model decodes with
+no re-tracing of the training graph:
+
+    out = generate_fast(ex.var_values, cfg, prompts, num_tokens=50,
+                        temperature=0.8, top_k=40, seed=0)
+
+Sampling: greedy (temperature=0), temperature, and top-k.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    m = x.mean(axis=-1, keepdims=True)
+    v = ((x - m) ** 2).mean(axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * scale + bias
+
+
+def _gelu_tanh(x):
+    # tanh approximation — the framework's gelu_op (reference kernel
+    # parity; equals HF gelu_new)
+    return 0.5 * x * (1.0 + jnp.tanh(
+        0.7978845608028654 * (x + 0.044715 * x ** 3)))
+
+
+def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
+    """One incremental position: token [B] int32 at position ``pos``.
+    Returns (logits [B, V], new cache_k, new cache_v)."""
+    name, L, H, Dh, S_max = cfg_tuple
+    B = token.shape[0]
+    hdim = H * Dh
+    h = params[f"{name}_wte_table"][token] + params[f"{name}_wpe"][pos]
+
+    live = (jnp.arange(S_max) <= pos)[None, None, :]       # [1,1,S]
+    for i in range(L):
+        us = f"{name}_h{i}"
+        x = _ln(h, params[f"{us}_ln1_scale"], params[f"{us}_ln1_bias"])
+        q = x @ params[f"{us}_attn_q_weight"] + params[f"{us}_attn_q_bias"]
+        k = x @ params[f"{us}_attn_k_weight"] + params[f"{us}_attn_k_bias"]
+        v = x @ params[f"{us}_attn_v_weight"] + params[f"{us}_attn_v_bias"]
+        q = q.reshape(B, H, Dh)
+        k = k.reshape(B, H, Dh)
+        v = v.reshape(B, H, Dh)
+        # write this position's k/v into the cache
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k[None, :, None], (i, 0, pos, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v[None, :, None], (i, 0, pos, 0, 0))
+        ks = cache_k[i]                                    # [B,S,H,Dh]
+        vs = cache_v[i]
+        s = jnp.einsum("bhd,bshd->bhs", q, ks) * (Dh ** -0.5)
+        s = jnp.where(live, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhs,bshd->bhd", p, vs).reshape(B, hdim)
+        o = o @ params[f"{us}_attn_proj_weight"] \
+            + params[f"{us}_attn_proj_bias"]
+        h = h + o
+        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
+                       + params[f"{us}_ffn_wi_bias"])
+        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
+        h = h + f
+
+    h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
+    logits = h @ params[f"{name}_wte_table"].T \
+        + params.get(f"{name}_head_bias", 0.0)
+    return logits, cache_k, cache_v
+
+
+def _sample(logits, temperature, top_k, key):
+    """``temperature`` is a TRACED scalar (0 = greedy, selected inside
+    the program — no recompile per setting); ``top_k`` is static (XLA's
+    top_k needs a static k; a handful of k settings is a handful of
+    compiles)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t_safe = jnp.maximum(temperature, 1e-6)
+    scaled = logits / t_safe
+    if top_k:
+        kth = jax.lax.top_k(scaled, int(top_k))[0][:, -1:]   # O(V)
+        scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    sampled = jax.random.categorical(key, scaled,
+                                     axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg_tuple", "top_k"))
+def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
+                   temperature, top_k, rng):
+    """The whole generation as one scan over ALL S_max-1 positions: at
+    positions < prompt_len the next input token is the PROMPT's
+    (teacher forcing); beyond it, the sampled one.  Scanning to the
+    static S_max (rather than the request's length) keeps prompt length
+    and num_tokens TRACED — one compile serves every request shape at
+    this (batch, S_max); the host slices the requested span after."""
+    name, L, H, Dh, S_max = cfg_tuple
+    B = prompt_padded.shape[0]
+    cache_k = jnp.zeros((L, B, S_max, H, Dh), jnp.float32)
+    cache_v = jnp.zeros((L, B, S_max, H, Dh), jnp.float32)
+
+    def step(carry, t):
+        cache_k, cache_v, token, rng = carry
+        logits, cache_k, cache_v = _decode_step(
+            params, cfg_tuple, cache_k, cache_v, t, token)
+        rng, sub = jax.random.split(rng)
+        sampled = _sample(logits, temperature, top_k, sub)
+        # next input: prompt token while still inside the prompt
+        nxt = jnp.where(t + 1 < prompt_len,
+                        prompt_padded[:, jnp.minimum(t + 1, S_max - 1)],
+                        sampled)
+        return (cache_k, cache_v, nxt, rng), nxt
+
+    first = prompt_padded[:, 0]
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (cache_k, cache_v, first, rng), jnp.arange(S_max - 1))
+    # toks[t] is the input token for position t+1
+    return jnp.concatenate([first[:, None], toks.T], axis=1)
+
+
+def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
+                  top_k=0, seed=0, name=None):
+    """KV-cached generation.
+
+    params: {name: array} (e.g. ``executor.var_values`` — pass it
+      directly — or the output of ``hf.convert_gpt2``); config:
+      GPTConfig (hidden size, layers, heads, max_position_embeddings);
+      prompts: non-empty list of token-id lists (same length each, or a
+      [B, P] array); name: the model's parameter-name prefix — inferred
+      when the params hold exactly one ``*_wte_table``.  Returns
+      [B, P + num_tokens] numpy int32.
+    """
+    prompts = np.asarray(prompts, np.int32)
+    if prompts.ndim == 1:
+        prompts = prompts[None]
+    B, P = prompts.shape
+    if P < 1:
+        raise ValueError("prompt must hold at least one token")
+    if num_tokens < 1:
+        raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
+    total = P + int(num_tokens)
+    c = config
+    if name is None:
+        tables = [k[:-len("_wte_table")] for k in params
+                  if k.endswith("_wte_table")]
+        if len(tables) != 1:
+            raise ValueError(
+                f"params hold {len(tables)} *_wte_table entries "
+                f"({tables}); pass name= to pick the model")
+        name = tables[0]
+    S_max = c.max_position_embeddings
+    if total > S_max:
+        raise ValueError(f"prompt + num_tokens = {total} exceeds "
+                         f"max_position_embeddings {S_max}")
+    Dh = c.hidden_size // c.num_attention_heads
+    cfg_tuple = (name, c.num_hidden_layers, c.num_attention_heads,
+                 Dh, S_max)
+    pad = np.zeros((B, S_max), np.int32)
+    pad[:, :P] = prompts
+    params = {k: jnp.asarray(np.asarray(v), jnp.float32)
+              for k, v in params.items() if k.startswith(name + "_")}
+    out = _generate_scan(params, cfg_tuple, jnp.asarray(pad),
+                         jnp.int32(P), jnp.float32(temperature),
+                         int(top_k), jax.random.PRNGKey(seed))
+    return np.asarray(out[:, :total])
