@@ -306,6 +306,9 @@ class _Param:
         self.lock = threading.Lock()
 
 
+_AUTOSERVE = object()     # sentinel: serve_van registers future tables too
+
+
 class PSServer:
     """The parameter server.  All public methods are the PSFunc surface."""
 
@@ -340,6 +343,12 @@ class PSServer:
         port = int(os.environ.get("HETU_PS_PORT", "23455"))
         server = cls.get()
         tcp = server.serve_tcp(port, block=False)
+        if os.environ.get("HETU_PS_VAN"):
+            # fast tier: qualifying tables auto-register as clients
+            # create them; workers discover it via the van_info RPC
+            vport = server.enable_van_autoserve(
+                int(os.environ.get("HETU_PS_VAN_PORT", "0")))
+            print(f"[ps] native van listening on :{vport}", flush=True)
         # announce to the rendezvous scheduler, if one is configured
         _register_with_scheduler(port)
         tcp.serve_forever()
@@ -359,37 +368,72 @@ class PSServer:
 
         Returns (port, {key: van_key_id}) — VanClient speaks van ids.
         """
-        from .van import NativeVan, VanSharedLock
         with self.lock:
-            if getattr(self, "_van", None) is None:
-                self._van = NativeVan()
-                self._van_port = self._van.listen(port)
-                self._van_keys = {}
-            if keys is None:
-                keys = [k for k, p in self.params.items()
-                        if isinstance(p.optimizer, ServerSGD)
-                        and p.value.ndim == 2
-                        and p.value.dtype == np.float32]
-            for k in keys:
-                if k in self._van_keys:
-                    continue
-                p = self.params[k]
-                if not (isinstance(p.optimizer, ServerSGD)
-                        and p.value.ndim == 2
-                        and p.value.dtype == np.float32):
-                    raise ValueError(
-                        f"van can only serve 2-D float32 SGD tables; "
-                        f"{k!r} is {p.value.dtype}/{p.value.ndim}-D with "
-                        f"{type(p.optimizer).__name__}")
-                kid = len(self._van_keys)
-                # the registered (contiguous) array IS the served
-                # buffer; the param points at exactly it and shares the
-                # van's per-table mutex
-                p.value = self._van.register_sgd_table(
-                    kid, p.value, lr=p.optimizer.lr, versions=p.versions)
-                p.lock = VanSharedLock(p.lock, self._van, kid)
-                self._van_keys[k] = kid
+            return self._serve_van_locked(keys, port)
+
+    @staticmethod
+    def _van_qualifies(p):
+        """The van applies SGD in-kernel on a 2-D float32 buffer."""
+        return (isinstance(p.optimizer, ServerSGD) and p.value.ndim == 2
+                and p.value.dtype == np.float32)
+
+    def _serve_van_locked(self, keys=None, port=0):
+        """serve_van body; caller holds self.lock (param_init's
+        autoserve hook runs inside its own locked region)."""
+        from .van import NativeVan, VanSharedLock
+        if getattr(self, "_van", None) is None:
+            self._van = NativeVan()
+            self._van_port = self._van.listen(port)
+            self._van_keys = {}
+        if keys is _AUTOSERVE:
+            # every FUTURE qualifying table registers on creation
+            # (heturun deployments init tables over RPC after the
+            # server is up — see enable_van_autoserve)
+            self._van_auto = True
+            keys = None
+        if keys is None:
+            keys = [k for k, p in self.params.items()
+                    if self._van_qualifies(p)]
+        for k in keys:
+            if k in self._van_keys:
+                continue
+            p = self.params[k]
+            if not self._van_qualifies(p):
+                raise ValueError(
+                    f"van can only serve 2-D float32 SGD tables; "
+                    f"{k!r} is {p.value.dtype}/{p.value.ndim}-D with "
+                    f"{type(p.optimizer).__name__}")
+            kid = len(self._van_keys)
+            # the registered (contiguous) array IS the served buffer;
+            # the param points at exactly it and shares the van's
+            # per-table mutex
+            p.value = self._van.register_sgd_table(
+                kid, p.value, lr=p.optimizer.lr, versions=p.versions)
+            p.lock = VanSharedLock(p.lock, self._van, kid)
+            self._van_keys[k] = kid
         return self._van_port, dict(self._van_keys)
+
+    def enable_van_autoserve(self, port=0):
+        """heturun deployment hook (HETU_PS_VAN=1): start the van now
+        and auto-register every qualifying table as clients create it;
+        workers discover the port/key map via ``van_info`` RPC."""
+        return self.serve_van(keys=_AUTOSERVE, port=port)[0]
+
+    def van_info(self):
+        """(van port | None, {key: van key id}) — the RPC workers call
+        to discover the fast tier."""
+        with self.lock:      # the TCP server is threaded; shutdown()
+            if getattr(self, "_van", None) is None:   # mutates under
+                return None, {}                        # this lock
+            return self._van_port, dict(self._van_keys)
+
+    def _van_autoserve_locked(self, key):
+        """Called at table creation (self.lock held) when autoserve is
+        on; non-qualifying tables stay python-tier, but a registration
+        FAILURE on a qualifying table stays loud."""
+        if getattr(self, "_van_auto", False) and \
+                self._van_qualifies(self.params[key]):
+            self._serve_van_locked([key])
 
     def shutdown(self):
         if getattr(self, "_tcp", None) is not None:
@@ -407,6 +451,7 @@ class PSServer:
                                                     VanSharedLock):
                         p.lock = p.lock.pylock
                 self._van_keys = {}
+                self._van_auto = False
             self._van.stop()
             self._van = None
 
@@ -436,6 +481,7 @@ class PSServer:
             if opt is not None:
                 optimizer = SERVER_OPTIMIZERS[opt](**(opt_args or {}))
             self.params[key] = _Param(value, optimizer)
+            self._van_autoserve_locked(key)
             return True
 
     def param_set(self, key, value, opt=None, opt_args=None):
@@ -459,6 +505,7 @@ class PSServer:
                     f"buffer would detach the C++ tier — use "
                     f"param_assign (in-place) instead")
             self.params[key] = _Param(value, optimizer)
+            self._van_autoserve_locked(key)
             return True
 
     def param_assign(self, key, value):

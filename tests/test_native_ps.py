@@ -354,3 +354,41 @@ def test_shutdown_restores_python_locks():
     srv.param_set("s", np.zeros((8, 2), np.float32))
     srv.param_clear("s")
     PSServer._instance = None
+
+
+def test_van_autoserve_and_discovery_over_tcp():
+    """The heturun deployment shape: a TCP PSServer with autoserve on —
+    tables created by clients over RPC register with the van as they
+    appear; workers discover the fast tier via the van_info RPC and
+    push through it consistently with the python surface."""
+    from hetu_tpu.ps.server import PSServer
+    from hetu_tpu.ps.client import PSClient, _TCPTransport
+    from hetu_tpu.ps.van import VanClient, van_available
+    if not van_available():
+        pytest.skip("no C++ toolchain")
+    PSServer._instance = None
+    PSClient._instance = None
+    srv = PSServer.get()
+    srv.serve_tcp(23993, block=False)
+    vport = srv.enable_van_autoserve()
+    try:
+        c = PSClient(transport=_TCPTransport("127.0.0.1", 23993))
+        # created AFTER autoserve was enabled -> auto-registered
+        c.parameter_init("auto", (16, 4), "constant", 0.0, opt="sgd",
+                         opt_args={"learning_rate": 1.0})
+        # a non-qualifying table stays python-tier without error
+        c.parameter_init("adam_t", (8, 2), "constant", 0.0, opt="adam",
+                         opt_args={"learning_rate": 0.1})
+        got_port, keymap = c.t.call("van_info")
+        assert got_port == vport
+        assert "auto" in keymap and "adam_t" not in keymap
+        vc = VanClient("127.0.0.1", got_port, dim=4)
+        ids = np.arange(8)
+        vc.push(keymap["auto"], ids, np.ones((8, 4), np.float32))
+        np.testing.assert_allclose(c.sparse_pull("auto", ids), -1.0)
+        vc.close()
+        c.finalize()
+    finally:
+        srv.shutdown()
+        PSServer._instance = None
+        PSClient._instance = None
