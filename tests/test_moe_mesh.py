@@ -329,3 +329,51 @@ class TestBertMoEFlagship:
         # the dense block's FFN replicates across the expert axis
         assert {s.data.shape for s in dense.addressable_shards} == \
             {(32, 64)}
+
+
+def test_bert_moe_under_pipeline_trains():
+    """Composition row: the MoE flagship through Executor(pipeline=
+    'gpipe').  EXACT trajectory equality with the full-batch run is
+    deliberately NOT the contract here: TopKGate's static capacity is
+    k*ceil(tokens/E) of the COMPILED batch, so each microbatch routes
+    against its own (smaller) capacity pool and token-drop patterns
+    differ from full-batch routing — the same per-chunk semantics every
+    capacity-based MoE has under gradient accumulation (and the same
+    caveat bert.py documents for the masked mean).  The contract: the
+    composition runs and trains."""
+    from hetu_tpu.models import BertMoEConfig, BertMoEForPreTraining
+
+    # the graph bakes the MICROBATCH size (global batch 8 / M=2); the
+    # pipeline splits each fed global batch across microbatches
+    cfg = BertMoEConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=2, intermediate_size=64,
+        batch_size=4, seq_len=8, num_experts=4, top_k=1,
+        moe_every=2, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = BertMoEForPreTraining(cfg, name="plb")
+    nodes = tuple(ht.placeholder_op(f"plb_{nm}")
+                  for nm in ("ids", "tt", "mlm", "nsp"))
+    loss, _, _ = m(nodes[0], nodes[1], masked_lm_labels=nodes[2],
+                   next_sentence_label=nodes[3])
+    train = ht.optim.SGDOptimizer(learning_rate=0.05).minimize(loss)
+
+    def batches(n=4):
+        rng = np.random.RandomState(3)
+        out = []
+        for _ in range(n):
+            iv = rng.randint(0, 64, (8, 8)).astype(np.int32)
+            mv = np.where(rng.rand(8, 8) < 0.3, iv, -1).astype(np.int32)
+            out.append((iv, np.zeros((8, 8), np.int32), mv,
+                        np.zeros((8,), np.int32)))
+        return out
+
+    ex2 = ht.Executor({"train": [loss, train]}, pipeline="gpipe",
+                      num_microbatches=2)
+    tr = []
+    for iv, tv, mv, nv in batches(8):
+        out = ex2.run("train", feed_dict=dict(zip(nodes,
+                                                  (iv, tv, mv, nv))))
+        tr.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert all(np.isfinite(v) for v in tr)
+    assert np.mean(tr[-3:]) < np.mean(tr[:3]), tr
