@@ -1,0 +1,53 @@
+#!/bin/bash
+# The on-chip measurement battery, in priority order (VERDICT r3 items
+# 1/2/4/5/6 measurement halves; see round4 COMPONENTS.md closure table).
+# Run when a TPU answers; every stage is guarded against clobbering
+# full-scale records with degraded runs, so re-running is always safe.
+#
+#   bash bin/run_onchip_suite.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/onchip_$(date -u +%H%M)}
+mkdir -p "$LOG"
+echo "logging to $LOG"
+
+run() {  # name, env..., -- handled by eval of the remainder
+  local name=$1; shift
+  echo "=== $name: $* ==="
+  (time "$@") >"$LOG/$name.log" 2>&1
+  local rc=$?
+  tail -2 "$LOG/$name.log"
+  echo "=== $name rc=$rc ==="
+}
+
+# 1. full matrix under honest accounting (bert_base probes pick the
+#    batch; pin with HETU_BENCH_BERT_BATCH=32 if probes misbehave)
+run matrix python bench.py
+
+# 2. the (batch x attention x head) ablation sweep + planner validation
+HETU_BENCH_SWEEP=1 run sweep python bench.py
+
+# 3. max embedding rows per chip (1M..256M ladder)
+HETU_BENCH_CTR_ROWS=1 run ctr_rows python bench.py
+
+# 4. refresh the chip calibration artifact (raw + clamped curves)
+run calibration python -m hetu_tpu.planner.chip_calibration
+
+# 5. long-context tile tuning: A/B a couple of block shapes at 32k
+for blocks in "512,1024" "1024,1024" "1024,2048" "512,2048"; do
+  HETU_BENCH_LC_BLOCKS=$blocks HETU_BENCH_CONFIGS=long_context \
+    run "lc_${blocks/,/x}" python bench.py
+done
+
+# 6. MoE chip-fill A/B (the recorded config underfilled the chip)
+for tok in 1024 2048 4096; do
+  HETU_BENCH_MOE_TOKENS=$tok HETU_BENCH_CONFIGS=moe \
+    run "moe_t${tok}" python bench.py
+done
+
+# NOTE: stages 5/6 leave the LAST A/B variant in BENCH_MATRIX.json —
+# read the logs, then re-run the winning setting (its env + the config
+# name) so the matrix records the best measured configuration.
+
+echo "done; artifacts: BENCH_MATRIX.json SWEEP_BERT_BASE.json \
+BENCH_CTR_ROWS.json CALIBRATION_TPU.json (logs in $LOG)"
