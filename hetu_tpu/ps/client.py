@@ -146,6 +146,48 @@ class PSClient:
         self.nrank = nrank
         self._pool = ThreadPoolExecutor(max_workers=4,
                                         thread_name_prefix="ps-client")
+        self._hb_stop = None
+
+    def start_heartbeat(self, interval=5.0, role="worker", node_id=None):
+        """Beat the scheduler's liveness map (HETU_SCHEDULER_ADDR) every
+        ``interval`` seconds from a daemon thread — the ps-lite
+        Postoffice heartbeat role.  No-op without a scheduler."""
+        sched = os.environ.get("HETU_SCHEDULER_ADDR")
+        if not sched or self._hb_stop is not None:
+            return False
+        host, port = sched.rsplit(":", 1)
+        node = str(self.rank if node_id is None else node_id)
+        stop = threading.Event()
+        self._hb_stop = stop
+
+        def beat():
+            # short timeout, one retry: a stalled RPC must cost one
+            # beat, not wedge the loop past the staleness window
+            t = _TCPTransport(host, int(port),
+                              timeout=max(1.0, interval / 2),
+                              connect_timeout=max(1.0, interval / 2),
+                              retries=1)
+            first = True
+            while True:
+                if not first and stop.wait(interval):
+                    break
+                first = False
+                try:
+                    # immediate first beat: an early-crashing node must
+                    # still APPEAR in the health map before dying
+                    t.call("heartbeat", role, node)
+                except Exception:
+                    pass          # scheduler gone: detection is ITS job
+            t.close()
+
+        threading.Thread(target=beat, daemon=True,
+                         name=f"ps-heartbeat-{role}-{node}").start()
+        return True
+
+    def stop_heartbeat(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
 
     @classmethod
     def get(cls):

@@ -839,12 +839,34 @@ class Scheduler:
     def __init__(self):
         self._servers = {}           # index -> addr
         self._cv = threading.Condition()
+        self._beats = {}             # "role:id" -> last monotonic beat
 
     def register_server(self, index, addr):
         with self._cv:
             self._servers[int(index)] = str(addr)
+            self._beats[f"server:{int(index)}"] = time.monotonic()
             self._cv.notify_all()
         return True
+
+    # ---- liveness (ps-lite postoffice heartbeat-map parity) ---- #
+
+    def heartbeat(self, role, node_id):
+        """Record a node's liveness beat (ps-lite Postoffice keeps the
+        same heartbeat map; there is no elastic replacement in the
+        reference either — SURVEY §5.3 — detection feeds the operator /
+        launcher, recovery is checkpoint/restart)."""
+        with self._cv:
+            self._beats[f"{role}:{node_id}"] = time.monotonic()
+        return True
+
+    def health(self, stale_after=15.0):
+        """{node: {age_s, alive}} for every node that ever beat; a node
+        silent for > stale_after seconds reports alive=False."""
+        now = time.monotonic()
+        with self._cv:
+            return {node: {"age_s": round(now - t, 3),
+                           "alive": (now - t) <= float(stale_after)}
+                    for node, t in self._beats.items()}
 
     def get_servers(self, expected, timeout=60.0):
         """Block until ``expected`` servers registered; return addresses
@@ -882,7 +904,10 @@ class Scheduler:
 
 def _register_with_scheduler(port):
     """Server-side registration (called by serve_from_env when a
-    scheduler is configured)."""
+    scheduler is configured).  Also starts the server's ongoing
+    liveness beats: register_server only SEEDS the health map — without
+    beats every healthy server would read dead after the staleness
+    window."""
     sched = os.environ.get("HETU_SCHEDULER_ADDR")
     if not sched:
         return
@@ -894,5 +919,21 @@ def _register_with_scheduler(port):
                          f"{socket.gethostname()}:{port}")
     t.call("register_server", index, adv)
     t.close()
+    interval = float(os.environ.get("HETU_HEARTBEAT_INTERVAL", "5"))
+
+    def beat():
+        bt = _TCPTransport(host, int(sport),
+                           timeout=max(1.0, interval / 2),
+                           connect_timeout=max(1.0, interval / 2),
+                           retries=1)
+        while True:
+            try:
+                bt.call("heartbeat", "server", index)
+            except Exception:
+                pass
+            time.sleep(interval)
+
+    threading.Thread(target=beat, daemon=True,
+                     name=f"ps-heartbeat-server-{index}").start()
 
 

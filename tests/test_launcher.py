@@ -187,3 +187,58 @@ open(os.path.join(OUT, f"trained{rank}"), "w").write(
         assert code == 0
         assert os.path.exists(os.path.join(d, "trained0"))
         assert os.path.exists(os.path.join(d, "trained1"))
+
+
+class TestSchedulerHeartbeat:
+    """ps-lite Postoffice heartbeat-map parity (SURVEY §5.3): liveness
+    DETECTION at the scheduler; recovery stays checkpoint/restart, as
+    in the reference (no elastic replacement there either)."""
+
+    def test_health_marks_silent_nodes_dead(self):
+        import time as _t
+        from hetu_tpu.ps.server import Scheduler
+        sched = Scheduler()
+        sched.heartbeat("worker", 0)
+        sched.heartbeat("worker", 1)
+        sched.heartbeat("server", 0)
+        h = sched.health(stale_after=15.0)
+        assert set(h) == {"worker:0", "worker:1", "server:0"}
+        assert all(v["alive"] for v in h.values())
+        # worker:1 goes silent; a tight staleness window flags it
+        _t.sleep(0.25)
+        sched.heartbeat("worker", 0)
+        h = sched.health(stale_after=0.2)
+        assert h["worker:0"]["alive"]
+        assert not h["worker:1"]["alive"]
+
+    def test_client_heartbeat_thread_over_tcp(self):
+        import os
+        import time as _t
+        from hetu_tpu.ps.server import Scheduler
+        from hetu_tpu.ps.client import PSClient, _LocalTransport
+        import socket as _sock
+        sched = Scheduler()
+        srv = _sock.socket()
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        srv.close()
+        sched.serve_tcp(port, block=False)
+        old = os.environ.get("HETU_SCHEDULER_ADDR")
+        os.environ["HETU_SCHEDULER_ADDR"] = f"127.0.0.1:{port}"
+        try:
+            c = PSClient(transport=_LocalTransport())
+            assert c.start_heartbeat(interval=0.1, node_id=7)
+            deadline = _t.time() + 10
+            while _t.time() < deadline:
+                if "worker:7" in sched.health():
+                    break
+                _t.sleep(0.05)
+            h = sched.health(stale_after=5.0)
+            assert h["worker:7"]["alive"]
+            c.stop_heartbeat()
+        finally:
+            if old is None:
+                os.environ.pop("HETU_SCHEDULER_ADDR", None)
+            else:
+                os.environ["HETU_SCHEDULER_ADDR"] = old
+            sched.shutdown()
