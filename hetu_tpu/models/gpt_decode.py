@@ -88,6 +88,17 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
     return logits, cache_k, cache_v
 
 
+def _prep_param(v):
+    """float32 on device, PRESERVING any existing placement: a
+    tp_shard_params NamedSharding must survive into the scan (a
+    np.asarray round-trip would gather the shards to host and re-place
+    them replicated on one device, silently killing tensor-parallel
+    decode)."""
+    if isinstance(v, jax.Array):
+        return v if v.dtype == jnp.float32 else v.astype(jnp.float32)
+    return jnp.asarray(np.asarray(v), jnp.float32)
+
+
 def _sample(logits, temperature, top_k, key):
     """``temperature`` is a TRACED scalar (0 = greedy, selected inside
     the program — no recompile per setting); ``top_k`` is static (XLA's
@@ -137,6 +148,55 @@ def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
     return jnp.concatenate([first[:, None], toks.T], axis=1)
 
 
+def _infer_name(params, name=None):
+    """The model's parameter-name prefix; explicit ``name`` wins, else
+    inferred when exactly one ``*_wte_table`` is present."""
+    if name is not None:
+        return name
+    tables = [k[:-len("_wte_table")] for k in params
+              if k.endswith("_wte_table")]
+    if len(tables) != 1:
+        raise ValueError(
+            f"params hold {len(tables)} *_wte_table entries ({tables}); "
+            f"pass name= to pick the model")
+    return tables[0]
+
+
+def tp_shard_params(params, mesh, config, axis="tp", name=None):
+    """Place a GPT parameter dict for TENSOR-PARALLEL decoding: the
+    Megatron column/row split by name (q/k/v and ffn_wi column-split
+    over ``axis``, attn_proj and ffn_wo row-split, embeddings/LNs
+    replicated).  ``generate_fast`` needs no other change — GSPMD
+    propagates the shardings through the decode scan, splitting the
+    per-head attention and FFN across the mesh (multi-chip serving).
+
+    Requires num_attention_heads % mesh.shape[axis] == 0 so the column
+    split lands on head boundaries."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tp = mesh.shape[axis]
+    if config.num_attention_heads % tp:
+        raise ValueError(
+            f"num_attention_heads={config.num_attention_heads} not "
+            f"divisible by {axis}={tp}: the column split must land on "
+            f"head boundaries")
+    name = _infer_name(params, name)
+
+    def spec_for(k):
+        if any(t in k for t in ("_attn_q_weight", "_attn_k_weight",
+                                "_attn_v_weight", "_ffn_wi_weight")):
+            return P(None, axis)
+        if any(t in k for t in ("_attn_proj_weight", "_ffn_wo_weight")):
+            return P(axis, None)
+        if any(t in k for t in ("_attn_q_bias", "_attn_k_bias",
+                                "_attn_v_bias", "_ffn_wi_bias")):
+            return P(axis)
+        return P()
+
+    return {k: jax.device_put(np.asarray(v),
+                              NamedSharding(mesh, spec_for(k)))
+            for k, v in params.items() if k.startswith(name + "_")}
+
+
 def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
                   top_k=0, seed=0, name=None):
     """KV-cached generation.
@@ -159,14 +219,7 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
         raise ValueError(f"num_tokens must be >= 1, got {num_tokens}")
     total = P + int(num_tokens)
     c = config
-    if name is None:
-        tables = [k[:-len("_wte_table")] for k in params
-                  if k.endswith("_wte_table")]
-        if len(tables) != 1:
-            raise ValueError(
-                f"params hold {len(tables)} *_wte_table entries "
-                f"({tables}); pass name= to pick the model")
-        name = tables[0]
+    name = _infer_name(params, name)
     S_max = c.max_position_embeddings
     if total > S_max:
         raise ValueError(f"prompt + num_tokens = {total} exceeds "
@@ -176,7 +229,7 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
                  Dh, S_max)
     pad = np.zeros((B, S_max), np.int32)
     pad[:, :P] = prompts
-    params = {k: jnp.asarray(np.asarray(v), jnp.float32)
+    params = {k: _prep_param(v)
               for k, v in params.items() if k.startswith(name + "_")}
     out = _generate_scan(params, cfg_tuple, jnp.asarray(pad),
                          jnp.int32(P), jnp.float32(temperature),

@@ -436,6 +436,10 @@ class PSServer:
             self._serve_van_locked([key])
 
     def shutdown(self):
+        hb = getattr(self, "_server_hb_stop", None)
+        if hb is not None:
+            hb.set()             # a dead server must stop reading alive
+            self._server_hb_stop = None
         if getattr(self, "_tcp", None) is not None:
             self._tcp.shutdown()
             self._tcp = None
@@ -920,18 +924,28 @@ def _register_with_scheduler(port):
     t.call("register_server", index, adv)
     t.close()
     interval = float(os.environ.get("HETU_HEARTBEAT_INTERVAL", "5"))
+    srv = PSServer.get()
+    # stoppable + restart-safe: shutdown() must silence the beats (a
+    # dead server that keeps beating defeats the liveness map), and a
+    # re-register must not stack threads for a stale index
+    old = getattr(srv, "_server_hb_stop", None)
+    if old is not None:
+        old.set()
+    stop = threading.Event()
+    srv._server_hb_stop = stop
 
     def beat():
         bt = _TCPTransport(host, int(sport),
                            timeout=max(1.0, interval / 2),
                            connect_timeout=max(1.0, interval / 2),
                            retries=1)
-        while True:
+        while not stop.is_set():
             try:
                 bt.call("heartbeat", "server", index)
             except Exception:
                 pass
-            time.sleep(interval)
+            stop.wait(interval)
+        bt.close()
 
     threading.Thread(target=beat, daemon=True,
                      name=f"ps-heartbeat-server-{index}").start()
