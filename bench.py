@@ -867,6 +867,54 @@ _CONFIGS = {
 }
 
 
+_DECODE_FILE = os.path.join(_HERE, "BENCH_DECODE.json")
+
+
+def bench_decode(platform, reduced):
+    """KV-cached serving throughput (models/gpt_decode.py): GPT-2-small
+    shape, one compiled scan, batched prompts; tokens/s = generated
+    tokens per wall second after the compile is warm."""
+    import jax
+    import hetu_tpu as ht
+    from hetu_tpu.models import GPTConfig, GPTForCausalLM
+    from hetu_tpu.models.gpt_decode import generate_fast
+
+    S_max, hidden, layers_n, heads, vocab, batch, gen = \
+        1024, 768, 12, 12, 50257, 8, 896
+    if reduced:
+        S_max, hidden, layers_n, heads, vocab, batch, gen = \
+            64, 64, 2, 2, 256, 2, 48
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_hidden_layers=layers_n,
+                    num_attention_heads=heads,
+                    max_position_embeddings=S_max, batch_size=batch,
+                    seq_len=S_max, dropout_rate=0.0)
+    model = GPTForCausalLM(cfg, name="dec")
+    ids = ht.placeholder_op("dec_ids")
+    logits = model(ids)
+    ex = ht.Executor({"gen": [logits]})     # materializes init params
+    del logits
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, vocab, (batch, 16)).astype(np.int32)
+    generate_fast(ex.var_values, cfg, prompts, num_tokens=4)  # compile
+    t0 = time.perf_counter()
+    out = generate_fast(ex.var_values, cfg, prompts, num_tokens=gen)
+    dt = time.perf_counter() - t0
+    assert out.shape == (batch, 16 + gen)
+    art = {
+        "platform": platform,
+        "reduced_scale": reduced,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "tokens_per_sec": round(batch * gen / dt, 1),
+        "seconds": round(dt, 3),
+        "config": {"batch": batch, "s_max": S_max, "hidden": hidden,
+                   "layers": layers_n, "heads": heads, "vocab": vocab,
+                   "generated": gen, "kernel": "kv_cached_scan"},
+    }
+    _persist_artifact(_DECODE_FILE, art, reduced, has_data=True)
+    return art
+
+
 _SWEEP_FILE = os.path.join(_HERE, "SWEEP_BERT_BASE.json")
 
 _PROBE_SWEEP_SRC = """
@@ -980,6 +1028,19 @@ def main():
     platform, bringup_err = _bring_up_backend()
     reduced = bool(os.environ.get("HETU_BENCH_SMALL")) or \
         platform in ("cpu", "cpu-fallback")
+
+    if os.environ.get("HETU_BENCH_DECODE"):
+        art = bench_decode(platform, reduced)
+        print(json.dumps({
+            "metric": "gpt_decode_tokens_per_sec",
+            "value": art["tokens_per_sec"], "unit": "tokens/sec",
+            "vs_baseline": None, "platform": platform,
+            "batch": art["config"]["batch"],
+            "s_max": art["config"]["s_max"],
+            **({"not_written": art["not_written"]}
+               if "not_written" in art else
+               {"decode_file": os.path.basename(_DECODE_FILE)})}))
+        return
 
     if os.environ.get("HETU_BENCH_CTR_ROWS"):
         art = sweep_ctr_rows(platform, reduced)

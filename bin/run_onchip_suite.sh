@@ -33,6 +33,9 @@ HETU_BENCH_CTR_ROWS=1 run ctr_rows python bench.py
 # 4. refresh the chip calibration artifact (raw + clamped curves)
 run calibration python -m hetu_tpu.planner.chip_calibration
 
+# 4b. KV-cached serving throughput (BENCH_DECODE.json)
+HETU_BENCH_DECODE=1 run decode python bench.py
+
 # 5. long-context tile tuning: A/B a couple of block shapes at 32k
 for blocks in "512,1024" "1024,1024" "1024,2048" "512,2048"; do
   HETU_BENCH_LC_BLOCKS=$blocks HETU_BENCH_CONFIGS=long_context \
