@@ -27,7 +27,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["convert_bert", "convert_bert_pretraining_heads",
-           "convert_gpt2"]
+           "convert_bert_classifier", "convert_gpt2"]
 
 
 def _np(t):
@@ -105,6 +105,17 @@ def convert_bert_pretraining_heads(state_dict, name="bert"):
     w, b = _lin(sd, "cls.seq_relationship")
     out[f"{name}_nsp_weight"] = w
     out[f"{name}_nsp_bias"] = b
+    return out
+
+
+def convert_bert_classifier(state_dict, name="bert"):
+    """HF ``BertForSequenceClassification`` -> backbone + classifier
+    params (the import path for fine-tuning an HF-pretrained BERT
+    through the GLUE pipeline)."""
+    out = convert_bert(state_dict, name=name, prefix="bert.")
+    w, b = _lin(state_dict, "classifier")
+    out[f"{name}_classifier_weight"] = w
+    out[f"{name}_classifier_bias"] = b
     return out
 
 

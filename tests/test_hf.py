@@ -13,22 +13,23 @@ torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
 
-def _bert_pair(heads=False):
+def _bert_pair(hf_cls=None, max_pos=16, batch=2, seq=8, seed=0, **hf_kw):
+    """Matched (HF model, our BertConfig) pair — ONE source of truth for
+    the parity-critical knobs (sizes pinned, dropout 0, gelu_new)."""
     from transformers import BertConfig as HFC
-    from transformers import BertForPreTraining as HFPre
     from transformers import BertModel as HFM
     hf_cfg = HFC(vocab_size=120, hidden_size=32, num_hidden_layers=2,
                  num_attention_heads=2, intermediate_size=64,
-                 max_position_embeddings=16, hidden_act="gelu_new",
+                 max_position_embeddings=max_pos, hidden_act="gelu_new",
                  hidden_dropout_prob=0.0,
-                 attention_probs_dropout_prob=0.0)
-    torch.manual_seed(0)
-    hf = (HFPre if heads else HFM)(hf_cfg).eval()
+                 attention_probs_dropout_prob=0.0, **hf_kw)
+    torch.manual_seed(seed)
+    hf = (hf_cls or HFM)(hf_cfg).eval()
     from hetu_tpu.models import BertConfig
     cfg = BertConfig(vocab_size=120, hidden_size=32, num_hidden_layers=2,
                      num_attention_heads=2, intermediate_size=64,
-                     max_position_embeddings=16, batch_size=2, seq_len=8,
-                     hidden_dropout_prob=0.0,
+                     max_position_embeddings=max_pos, batch_size=batch,
+                     seq_len=seq, hidden_dropout_prob=0.0,
                      attention_probs_dropout_prob=0.0)
     return hf, cfg
 
@@ -66,7 +67,8 @@ class TestBertImport:
                                    atol=2e-4)
 
     def test_pretraining_heads_logit_parity(self):
-        hf, cfg = _bert_pair(heads=True)
+        from transformers import BertForPreTraining as HFPre
+        hf, cfg = _bert_pair(hf_cls=HFPre)
         ids_np, tt_np = _feed()
         with torch.no_grad():
             o = hf(input_ids=torch.tensor(ids_np),
@@ -151,3 +153,65 @@ class TestGPT2Import:
                      convert_to_numpy_ret_vals=True)[0]
         np.testing.assert_allclose(
             got, o.logits.numpy().reshape(16, 130), atol=5e-4)
+
+
+class TestBertClassifierImport:
+    def test_seqclass_logit_parity_and_finetune(self):
+        """The real user story: an HF classification checkpoint imports
+        with logit parity AND then fine-tunes through our GLUE pipeline
+        (loss drops on the SST-2 fixture)."""
+        import os
+        from transformers import BertForSequenceClassification as HFSC
+        from hetu_tpu.models import BertForSequenceClassification
+        hf, cfg = _bert_pair(hf_cls=HFSC, max_pos=32, batch=4, seq=16,
+                             seed=5, num_labels=2)
+        m = BertForSequenceClassification(cfg, num_labels=2, name="hfc")
+        ids = ht.placeholder_op("hfc_ids")
+        tt = ht.placeholder_op("hfc_tt")
+        mask = ht.placeholder_op("hfc_mask")
+        labels = ht.placeholder_op("hfc_y")
+        loss, logits = m(ids, tt, mask, labels=labels)
+        train = ht.optim.AdamOptimizer(learning_rate=2e-3).minimize(loss)
+        ex = ht.Executor({"train": [loss, train], "eval": [logits]})
+        params = ht.hf.convert_bert_classifier(hf.state_dict(),
+                                               name="hfc")
+        missing = set(ex.var_values) - set(params)
+        assert not missing, missing
+        ex.load_dict(params)
+
+        rng = np.random.RandomState(0)
+        iv = rng.randint(0, 120, (4, 16))
+        tv = np.zeros((4, 16))
+        with torch.no_grad():
+            want = hf(input_ids=torch.tensor(iv),
+                      token_type_ids=torch.tensor(
+                          tv.astype(np.int64))).logits.numpy()
+        got = ex.run("eval", feed_dict={
+            ids: iv.astype(np.int32), tt: tv.astype(np.int32),
+            mask: np.ones((4, 16), np.float32)},
+            convert_to_numpy_ret_vals=True)[0]
+        np.testing.assert_allclose(got, want, atol=3e-4)
+
+        # fine-tune the imported weights on the SST-2 fixture
+        from hetu_tpu.glue import (Sst2Processor,
+                                   convert_examples_to_arrays)
+        from hetu_tpu.tokenizers import BertTokenizer
+        FIX = os.path.join(os.path.dirname(__file__), "fixtures", "glue")
+        tok = BertTokenizer.from_pretrained(
+            os.path.join(FIX, "vocab.txt"))
+        proc = Sst2Processor()
+        exs = proc.get_train_examples(os.path.join(FIX, "SST-2"))
+        g_ids, g_mask, g_seg, g_y = convert_examples_to_arrays(
+            exs, proc.get_labels(), 16, tok)
+        g_ids = g_ids % 120                 # fixture vocab -> model vocab
+        losses = []
+        srng = np.random.RandomState(2)
+        for step in range(150):
+            sel = srng.choice(len(g_ids), 4, replace=False)
+            out = ex.run("train", feed_dict={
+                ids: g_ids[sel], tt: g_seg[sel], mask: g_mask[sel],
+                labels: g_y[sel]})
+            losses.append(float(np.asarray(out[0])))
+        assert all(np.isfinite(v) for v in losses)
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]), (
+            losses[:5], losses[-5:])
