@@ -32,9 +32,16 @@ NEG_INF = -1e30
 
 
 def _ln(x, scale, bias, eps=1e-5):
-    m = x.mean(axis=-1, keepdims=True)
-    v = ((x - m) ** 2).mean(axis=-1, keepdims=True)
-    return (x - m) * jax.lax.rsqrt(v + eps) * scale + bias
+    # statistics in f32 regardless of the compute dtype: bf16 mean/var
+    # over outlier channels (GPT-2 residual streams have them) loses
+    # enough mantissa to flip close argmax decisions; the cast costs
+    # nothing next to the matmuls
+    x32 = x.astype(jnp.float32)
+    m = x32.mean(axis=-1, keepdims=True)
+    v = ((x32 - m) ** 2).mean(axis=-1, keepdims=True)
+    y = (x32 - m) * jax.lax.rsqrt(v + eps) * scale.astype(jnp.float32) \
+        + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
 
 
 def _gelu_tanh(x):
@@ -83,20 +90,22 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
         h = h + f
 
     h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
-    logits = h @ params[f"{name}_wte_table"].T \
+    # logits in f32 regardless of compute dtype: sampling compares and
+    # exponentiates them
+    logits = (h @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
         + params.get(f"{name}_head_bias", 0.0)
     return logits, cache_k, cache_v
 
 
-def _prep_param(v):
-    """float32 on device, PRESERVING any existing placement: a
+def _prep_param(v, dtype=jnp.float32):
+    """``dtype`` on device, PRESERVING any existing placement: a
     tp_shard_params NamedSharding must survive into the scan (a
     np.asarray round-trip would gather the shards to host and re-place
     them replicated on one device, silently killing tensor-parallel
     decode)."""
     if isinstance(v, jax.Array):
-        return v if v.dtype == jnp.float32 else v.astype(jnp.float32)
-    return jnp.asarray(np.asarray(v), jnp.float32)
+        return v if v.dtype == dtype else v.astype(dtype)
+    return jnp.asarray(np.asarray(v), dtype)
 
 
 def _sample(logits, temperature, top_k, key):
@@ -126,8 +135,11 @@ def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
     this (batch, S_max); the host slices the requested span after."""
     name, L, H, Dh, S_max = cfg_tuple
     B = prompt_padded.shape[0]
-    cache_k = jnp.zeros((L, B, S_max, H, Dh), jnp.float32)
-    cache_v = jnp.zeros((L, B, S_max, H, Dh), jnp.float32)
+    # cache dtype follows the weights: bf16 decode halves the KV cache
+    # and runs the matmuls on the fast MXU path
+    cdtype = params[f"{name}_wte_table"].dtype
+    cache_k = jnp.zeros((L, B, S_max, H, Dh), cdtype)
+    cache_v = jnp.zeros((L, B, S_max, H, Dh), cdtype)
 
     def step(carry, t):
         cache_k, cache_v, token, rng = carry
@@ -198,7 +210,7 @@ def tp_shard_params(params, mesh, config, axis="tp", name=None):
 
 
 def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
-                  top_k=0, seed=0, name=None):
+                  top_k=0, seed=0, name=None, dtype=None):
     """KV-cached generation.
 
     params: {name: array} (e.g. ``executor.var_values`` — pass it
@@ -206,8 +218,10 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
       GPTConfig (hidden size, layers, heads, max_position_embeddings);
       prompts: non-empty list of token-id lists (same length each, or a
       [B, P] array); name: the model's parameter-name prefix — inferred
-      when the params hold exactly one ``*_wte_table``.  Returns
-      [B, P + num_tokens] numpy int32.
+      when the params hold exactly one ``*_wte_table``; dtype:
+      ``jnp.bfloat16`` halves weights AND the KV cache and takes the
+      fast MXU path (logits/sampling stay f32); default float32.
+      Returns [B, P + num_tokens] numpy int32.
     """
     prompts = np.asarray(prompts, np.int32)
     if prompts.ndim == 1:
@@ -229,7 +243,8 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
                  Dh, S_max)
     pad = np.zeros((B, S_max), np.int32)
     pad[:, :P] = prompts
-    params = {k: _prep_param(v)
+    dtype = dtype or jnp.float32
+    params = {k: _prep_param(v, dtype)
               for k, v in params.items() if k.startswith(name + "_")}
     out = _generate_scan(params, cfg_tuple, jnp.asarray(pad),
                          jnp.int32(P), jnp.float32(temperature),

@@ -14,6 +14,11 @@ from hetu_tpu.models.gpt import greedy_generate
 from hetu_tpu.models.gpt_decode import generate_fast
 
 
+@pytest.fixture(scope="module")
+def trained():
+    return _trained_model()
+
+
 def _trained_model():
     cfg = GPTConfig(vocab_size=61, hidden_size=32, num_hidden_layers=2,
                     num_attention_heads=2, max_position_embeddings=16,
@@ -35,10 +40,10 @@ def _trained_model():
 
 
 class TestFastDecode:
-    def test_matches_graph_greedy_generate(self):
+    def test_matches_graph_greedy_generate(self, trained):
         """Same trained weights: the KV-cached scan and the per-token
         full-forward path must emit the identical greedy sequence."""
-        cfg, ex, gen_ids = _trained_model()
+        cfg, ex, gen_ids = trained
         slow = greedy_generate(ex, "gen", gen_ids, 0, [7, 8, 9], 8, 16)
         cfg1 = GPTConfig(vocab_size=61, hidden_size=32,
                          num_hidden_layers=2, num_attention_heads=2,
@@ -74,8 +79,8 @@ class TestFastDecode:
                                pad_token_id=0)
         assert ours[0].tolist() == want[0].tolist()
 
-    def test_sampling_contract(self):
-        cfg, ex, _ = _trained_model()
+    def test_sampling_contract(self, trained):
+        cfg, ex, _ = trained
         cfg1 = GPTConfig(vocab_size=61, hidden_size=32,
                          num_hidden_layers=2, num_attention_heads=2,
                          max_position_embeddings=16, batch_size=1,
@@ -93,8 +98,8 @@ class TestFastDecode:
         assert (a[0, :2] == [3, 4]).all()         # prompt preserved
         assert not np.array_equal(a, c) or True   # different seed free
 
-    def test_batched_prompts(self):
-        cfg, ex, _ = _trained_model()
+    def test_batched_prompts(self, trained):
+        cfg, ex, _ = trained
         cfg2 = GPTConfig(vocab_size=61, hidden_size=32,
                          num_hidden_layers=2, num_attention_heads=2,
                          max_position_embeddings=16, batch_size=2,
@@ -105,8 +110,8 @@ class TestFastDecode:
         assert out[0].tolist() == list(range(7, 16))
         assert out[1].tolist() == list(range(20, 29))
 
-    def test_overlong_request_raises(self):
-        cfg, ex, _ = _trained_model()
+    def test_overlong_request_raises(self, trained):
+        cfg, ex, _ = trained
         with pytest.raises(ValueError):
             generate_fast(ex.var_values, cfg, [1, 2], num_tokens=100)
         with pytest.raises(ValueError):
@@ -180,3 +185,18 @@ def test_prep_param_preserves_sharding():
     # non-jax inputs still land as f32 jax arrays
     out2 = _prep_param(np.ones((4,), np.float64))
     assert out2.dtype == jnp.float32
+
+
+def test_bf16_decode_matches_f32_greedy(trained):
+    """dtype=bfloat16 halves weights + KV cache (LN statistics stay
+    f32); on the near-deterministic trained chain the greedy sequence
+    is unchanged — the f32 sequence is already pinned to the same
+    literal by test_matches_graph_greedy_generate."""
+    cfg, ex, _ = trained
+    cfg1 = GPTConfig(vocab_size=61, hidden_size=32,
+                     num_hidden_layers=2, num_attention_heads=2,
+                     max_position_embeddings=16, batch_size=1,
+                     seq_len=16, dropout_rate=0.0)
+    bf16 = generate_fast(ex.var_values, cfg1, [7, 8, 9], num_tokens=6,
+                         dtype=jnp.bfloat16)
+    assert bf16[0].tolist() == list(range(7, 16))
