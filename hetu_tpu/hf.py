@@ -27,7 +27,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["convert_bert", "convert_bert_pretraining_heads",
-           "convert_bert_classifier", "convert_gpt2"]
+           "convert_bert_classifier", "convert_gpt2",
+           "export_bert", "export_gpt2"]
 
 
 def _np(t):
@@ -153,5 +154,99 @@ def convert_gpt2(state_dict, name="gpt", prefix=""):
         out[f"{us}_ffn_wi_bias"] = _np(sd[f"{hf}.mlp.c_fc.bias"])
         out[f"{us}_ffn_wo_weight"] = _np(sd[f"{hf}.mlp.c_proj.weight"])
         out[f"{us}_ffn_wo_bias"] = _np(sd[f"{hf}.mlp.c_proj.bias"])
+        i += 1
+    return out
+
+
+# ------------------------------------------------------------------ #
+# the REVERSE direction: our trained parameters -> HF state_dicts, so
+# models trained here load into transformers (torch) for serving /
+# evaluation in that ecosystem.  Exact inverses of the importers.
+# ------------------------------------------------------------------ #
+
+def _t(arr):
+    import torch
+    return torch.from_numpy(np.ascontiguousarray(np.asarray(arr),
+                                                 np.float32))
+
+
+def export_bert(params, name="bert", prefix=""):
+    """{our param name: array} -> HF ``BertModel`` state_dict keys
+    (load with ``hf_model.load_state_dict(out, strict=False)``)."""
+    p = {k[len(name) + 1:]: v for k, v in params.items()
+         if k.startswith(name + "_")}
+    out = {}
+
+    def put(hf_key, arr, transpose=False):
+        a = np.asarray(arr)
+        out[prefix + hf_key] = _t(a.T if transpose else a)
+
+    put("embeddings.word_embeddings.weight",
+        p["embeddings_word_embeddings"])
+    put("embeddings.position_embeddings.weight",
+        p["embeddings_position_embeddings"])
+    if "embeddings_token_type_embeddings" in p:
+        put("embeddings.token_type_embeddings.weight",
+            p["embeddings_token_type_embeddings"])
+    put("embeddings.LayerNorm.weight", p["embeddings_ln_scale"])
+    put("embeddings.LayerNorm.bias", p["embeddings_ln_bias"])
+    i = 0
+    while f"layer{i}_attn_q_weight" in p:
+        us = f"layer{i}"
+        hf = f"encoder.layer.{i}"
+        for uname, hname in (("attn_q", "attention.self.query"),
+                             ("attn_k", "attention.self.key"),
+                             ("attn_v", "attention.self.value"),
+                             ("attn_proj", "attention.output.dense"),
+                             ("intermediate", "intermediate.dense"),
+                             ("output", "output.dense")):
+            put(f"{hf}.{hname}.weight", p[f"{us}_{uname}_weight"],
+                transpose=True)
+            put(f"{hf}.{hname}.bias", p[f"{us}_{uname}_bias"])
+        put(f"{hf}.attention.output.LayerNorm.weight",
+            p[f"{us}_attn_ln_scale"])
+        put(f"{hf}.attention.output.LayerNorm.bias",
+            p[f"{us}_attn_ln_bias"])
+        put(f"{hf}.output.LayerNorm.weight", p[f"{us}_out_ln_scale"])
+        put(f"{hf}.output.LayerNorm.bias", p[f"{us}_out_ln_bias"])
+        i += 1
+    if "pooler_dense_weight" in p:
+        put("pooler.dense.weight", p["pooler_dense_weight"],
+            transpose=True)
+        put("pooler.dense.bias", p["pooler_dense_bias"])
+    return out
+
+
+def export_gpt2(params, name="gpt", prefix=""):
+    """{our param name: array} -> HF ``GPT2Model`` state_dict keys
+    (Conv1D layout kept; q/k/v re-fused into c_attn)."""
+    p = {k[len(name) + 1:]: v for k, v in params.items()
+         if k.startswith(name + "_")}
+    out = {
+        prefix + "wte.weight": _t(p["wte_table"]),
+        prefix + "wpe.weight": _t(p["wpe"]),
+        prefix + "ln_f.weight": _t(p["ln_f_scale"]),
+        prefix + "ln_f.bias": _t(p["ln_f_bias"]),
+    }
+    i = 0
+    while f"h{i}_ln1_scale" in p:
+        us = f"h{i}"
+        hf = prefix + f"h.{i}"
+        out[f"{hf}.ln_1.weight"] = _t(p[f"{us}_ln1_scale"])
+        out[f"{hf}.ln_1.bias"] = _t(p[f"{us}_ln1_bias"])
+        out[f"{hf}.ln_2.weight"] = _t(p[f"{us}_ln2_scale"])
+        out[f"{hf}.ln_2.bias"] = _t(p[f"{us}_ln2_bias"])
+        out[f"{hf}.attn.c_attn.weight"] = _t(np.concatenate(
+            [np.asarray(p[f"{us}_attn_{nm}_weight"])
+             for nm in ("q", "k", "v")], axis=1))
+        out[f"{hf}.attn.c_attn.bias"] = _t(np.concatenate(
+            [np.asarray(p[f"{us}_attn_{nm}_bias"])
+             for nm in ("q", "k", "v")]))
+        out[f"{hf}.attn.c_proj.weight"] = _t(p[f"{us}_attn_proj_weight"])
+        out[f"{hf}.attn.c_proj.bias"] = _t(p[f"{us}_attn_proj_bias"])
+        out[f"{hf}.mlp.c_fc.weight"] = _t(p[f"{us}_ffn_wi_weight"])
+        out[f"{hf}.mlp.c_fc.bias"] = _t(p[f"{us}_ffn_wi_bias"])
+        out[f"{hf}.mlp.c_proj.weight"] = _t(p[f"{us}_ffn_wo_weight"])
+        out[f"{hf}.mlp.c_proj.bias"] = _t(p[f"{us}_ffn_wo_bias"])
         i += 1
     return out

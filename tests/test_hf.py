@@ -97,22 +97,27 @@ class TestBertImport:
             got_nsp, o.seq_relationship_logits.numpy(), atol=2e-4)
 
 
+def _gpt2_pair(lm=False, seed=1):
+    """Matched (HF GPT-2 model, our GPTConfig) — one source of truth."""
+    from transformers import GPT2Config as HFC
+    from transformers import GPT2LMHeadModel as HFLM
+    from transformers import GPT2Model as HFM
+    hf_cfg = HFC(vocab_size=130, n_embd=32, n_layer=2, n_head=2,
+                 n_positions=16, resid_pdrop=0.0, embd_pdrop=0.0,
+                 attn_pdrop=0.0)
+    torch.manual_seed(seed)
+    hf = (HFLM if lm else HFM)(hf_cfg).eval()
+    from hetu_tpu.models import GPTConfig
+    cfg = GPTConfig(vocab_size=130, hidden_size=32,
+                    num_hidden_layers=2, num_attention_heads=2,
+                    max_position_embeddings=16, batch_size=2,
+                    seq_len=8, dropout_rate=0.0)
+    return hf, cfg
+
+
 class TestGPT2Import:
     def _pair(self, lm=False):
-        from transformers import GPT2Config as HFC
-        from transformers import GPT2LMHeadModel as HFLM
-        from transformers import GPT2Model as HFM
-        hf_cfg = HFC(vocab_size=130, n_embd=32, n_layer=2, n_head=2,
-                     n_positions=16, resid_pdrop=0.0, embd_pdrop=0.0,
-                     attn_pdrop=0.0)
-        torch.manual_seed(1)
-        hf = (HFLM if lm else HFM)(hf_cfg).eval()
-        from hetu_tpu.models import GPTConfig
-        cfg = GPTConfig(vocab_size=130, hidden_size=32,
-                        num_hidden_layers=2, num_attention_heads=2,
-                        max_position_embeddings=16, batch_size=2,
-                        seq_len=8, dropout_rate=0.0)
-        return hf, cfg
+        return _gpt2_pair(lm=lm)
 
     def test_backbone_forward_parity(self):
         hf, cfg = self._pair()
@@ -215,3 +220,51 @@ class TestBertClassifierImport:
         assert all(np.isfinite(v) for v in losses)
         assert np.mean(losses[-10:]) < np.mean(losses[:10]), (
             losses[:5], losses[-5:])
+
+
+class TestExportToHF:
+    """The reverse trip: OUR parameters load into transformers and
+    torch reproduces our forward — models trained here are usable in
+    the HF ecosystem."""
+
+    def test_gpt2_roundtrip_through_torch(self):
+        from hetu_tpu.models import GPTModel
+        hf, cfg = _gpt2_pair()       # hf is reloaded from OUR weights
+        m = GPTModel(cfg, name="xg")
+        ids = ht.placeholder_op("xg_ids")
+        h = m(ids)
+        ex = ht.Executor({"fwd": [h]})     # OUR random init
+        rng = np.random.RandomState(4)
+        iv = rng.randint(0, 130, (2, 8))
+        ours = ex.run("fwd", feed_dict={ids: iv.astype(np.int32)},
+                      convert_to_numpy_ret_vals=True)[0]
+
+        sd = ht.hf.export_gpt2(ex.var_values, name="xg")
+        missing, unexpected = hf.load_state_dict(sd, strict=False)
+        assert not unexpected, unexpected
+        # ONLY the causal-mask buffers may be absent — a dropped
+        # parameter (e.g. a real *.bias) must fail here, not fall back
+        # to HF init
+        assert all(k.endswith(("attn.bias", "attn.masked_bias"))
+                   for k in missing), missing
+        with torch.no_grad():
+            theirs = hf(input_ids=torch.tensor(iv)).last_hidden_state
+        np.testing.assert_allclose(ours,
+                                   theirs.numpy().reshape(16, 32),
+                                   atol=2e-5)
+
+    def test_bert_export_is_exact_inverse_of_import(self):
+        hf, _cfg = _bert_pair()
+        params = ht.hf.convert_bert(hf.state_dict(), name="rb")
+        back = ht.hf.export_bert(params, name="rb")
+        want = hf.state_dict()
+        # completeness: every non-buffer HF key must be exported (a
+        # silently-partial export would pass a values-only comparison)
+        want_keys = {k for k in want
+                     if not k.endswith(("attn.bias",
+                                        "attn.masked_bias"))}
+        assert set(back) == want_keys, \
+            want_keys.symmetric_difference(back)
+        for k, v in back.items():
+            np.testing.assert_array_equal(
+                v.numpy(), want[k].numpy(), err_msg=k)
