@@ -142,3 +142,27 @@ class TestEndToEnd:
         finally:
             sys.argv = old
         assert acc > 0.7, acc
+
+
+def test_finetune_example_mnli_three_way_smoke():
+    """The 3-label path end-to-end: MNLI's processor (dev_matched split,
+    three-way labels) drives the example; num_labels comes from the
+    processor, not the flag."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "nlp", "finetune_bert_glue.py")
+    spec = importlib.util.spec_from_file_location("ex_glue_mnli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    old = sys.argv
+    sys.argv = ["prog", "--task", "mnli", "--data-dir",
+                os.path.join(FIX, "MNLI"), "--vocab-path",
+                os.path.join(FIX, "vocab.txt"),
+                "--num-layers", "1", "--hidden", "32", "--heads", "2",
+                "--batch-size", "4", "--seq-len", "24",
+                "--num-steps", "4", "--eval-every", "4"]
+    try:
+        acc = mod.main()
+    finally:
+        sys.argv = old
+    assert np.isfinite(acc) and 0.0 <= acc <= 1.0
