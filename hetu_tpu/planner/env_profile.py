@@ -30,7 +30,6 @@ import json
 import os
 import time
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import shard_map

@@ -26,7 +26,6 @@ Dataloader ring unchanged.
 from __future__ import annotations
 
 import collections
-import os
 
 import numpy as np
 
