@@ -17,14 +17,19 @@ import ctypes
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def build_and_load(src_name, lib_name, extra_flags=()):
+def build_and_load(src_name, lib_name, extra_flags=(), deps=()):
     """Compile ``src_name`` to ``lib_name`` (if stale) and dlopen it.
-    Returns the ctypes.CDLL or None when no compiler is available."""
+    ``deps`` are additional files (headers) whose mtimes also count for
+    staleness.  Returns the ctypes.CDLL or None when no compiler is
+    available."""
     src = os.path.join(_DIR, src_name)
     lib = os.path.join(_DIR, lib_name)
     try:
+        newest = max([os.path.getmtime(src)]
+                     + [os.path.getmtime(os.path.join(_DIR, d))
+                        for d in deps])
         if (not os.path.exists(lib)
-                or os.path.getmtime(lib) < os.path.getmtime(src)):
+                or os.path.getmtime(lib) < newest):
             cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                    *extra_flags, src, "-o", lib]
             subprocess.run(cmd, check=True, capture_output=True)

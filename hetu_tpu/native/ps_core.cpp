@@ -15,9 +15,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cmath>
-#include <unordered_map>
-#include <unordered_set>
-#include <vector>
+
+#include "ps_kernels.h"
 
 extern "C" {
 
@@ -66,102 +65,33 @@ void ps_dense_adam(float* value, float* m, float* v, const float* grad,
 
 // ------------------------------------------------------------ sparse
 // ids: (k,) int64 row indices (may repeat); rows: (k, cols) gradients.
-// merge duplicates, then apply the optimizer row-wise.
-
-static void merge_rows(const int64_t* ids, const float* rows, int64_t k,
-                       int64_t cols, std::vector<int64_t>& uniq,
-                       std::vector<float>& merged) {
-    std::unordered_map<int64_t, int64_t> pos;
-    pos.reserve((size_t)k * 2);
-    for (int64_t i = 0; i < k; ++i) {
-        auto it = pos.find(ids[i]);
-        int64_t j;
-        if (it == pos.end()) {
-            j = (int64_t)uniq.size();
-            pos.emplace(ids[i], j);
-            uniq.push_back(ids[i]);
-            merged.insert(merged.end(), cols, 0.0f);
-        } else {
-            j = it->second;
-        }
-        float* dst = merged.data() + j * cols;
-        const float* src = rows + i * cols;
-        for (int64_t c = 0; c < cols; ++c) dst[c] += src[c];
-    }
-}
+// The row kernels live in ps_kernels.h, SHARED with the TCP van
+// (ps_van.cpp) — both tiers mutate the same buffers and must stay
+// bit-identical, so the loops exist once.
 
 void ps_sparse_sgd(float* value, const int64_t* ids, const float* rows,
                    int64_t k, int64_t cols, float lr) {
-    // stateless: no dedup needed, updates are additive
-    for (int64_t i = 0; i < k; ++i) {
-        float* dst = value + ids[i] * cols;
-        const float* src = rows + i * cols;
-        for (int64_t c = 0; c < cols; ++c) dst[c] -= lr * src[c];
-    }
+    hetu_ps::sparse_sgd(value, ids, rows, k, cols, lr);
 }
 
 void ps_sparse_momentum(float* value, float* vel, const int64_t* ids,
                         const float* rows, int64_t k, int64_t cols,
                         float lr, float momentum, int nesterov) {
-    std::vector<int64_t> uniq;
-    std::vector<float> merged;
-    merge_rows(ids, rows, k, cols, uniq, merged);
-    for (size_t u = 0; u < uniq.size(); ++u) {
-        float* val = value + uniq[u] * cols;
-        float* vl = vel + uniq[u] * cols;
-        const float* g = merged.data() + u * cols;
-        if (nesterov) {
-            for (int64_t c = 0; c < cols; ++c) {
-                vl[c] = momentum * vl[c] - lr * g[c];
-                val[c] += momentum * vl[c] - lr * g[c];
-            }
-        } else {
-            for (int64_t c = 0; c < cols; ++c) {
-                vl[c] = momentum * vl[c] - lr * g[c];
-                val[c] += vl[c];
-            }
-        }
-    }
+    hetu_ps::sparse_momentum(value, vel, ids, rows, k, cols, lr,
+                             momentum, nesterov);
 }
 
 void ps_sparse_adagrad(float* value, float* acc, const int64_t* ids,
                        const float* rows, int64_t k, int64_t cols,
                        float lr, float eps) {
-    std::vector<int64_t> uniq;
-    std::vector<float> merged;
-    merge_rows(ids, rows, k, cols, uniq, merged);
-    for (size_t u = 0; u < uniq.size(); ++u) {
-        float* val = value + uniq[u] * cols;
-        float* a = acc + uniq[u] * cols;
-        const float* g = merged.data() + u * cols;
-        for (int64_t c = 0; c < cols; ++c) {
-            a[c] += g[c] * g[c];
-            val[c] -= lr * g[c] / (std::sqrt(a[c]) + eps);
-        }
-    }
+    hetu_ps::sparse_adagrad(value, acc, ids, rows, k, cols, lr, eps);
 }
 
 void ps_sparse_adam(float* value, float* m, float* v, const int64_t* ids,
                     const float* rows, int64_t k, int64_t cols, float lr,
                     float b1, float b2, float eps, int64_t t) {
-    // lazy/per-row bias correction with the global step, matching the
-    // reference's sparse Adam (src/ops/OptimizersSparse.cu semantics)
-    std::vector<int64_t> uniq;
-    std::vector<float> merged;
-    merge_rows(ids, rows, k, cols, uniq, merged);
-    const float bc1 = 1.0f - std::pow(b1, (float)t);
-    const float bc2 = 1.0f - std::pow(b2, (float)t);
-    for (size_t u = 0; u < uniq.size(); ++u) {
-        float* val = value + uniq[u] * cols;
-        float* mm = m + uniq[u] * cols;
-        float* vv = v + uniq[u] * cols;
-        const float* g = merged.data() + u * cols;
-        for (int64_t c = 0; c < cols; ++c) {
-            mm[c] = b1 * mm[c] + (1.0f - b1) * g[c];
-            vv[c] = b2 * vv[c] + (1.0f - b2) * g[c] * g[c];
-            val[c] -= lr * (mm[c] / bc1) / (std::sqrt(vv[c] / bc2) + eps);
-        }
-    }
+    hetu_ps::sparse_adam(value, m, v, ids, rows, k, cols, lr, b1, b2,
+                         eps, t);
 }
 
 // plain accumulate (no optimizer): value[ids] += rows, dup-safe
@@ -186,11 +116,7 @@ void ps_sparse_gather(const float* value, const int64_t* ids, float* out,
 // bump version counters for the unique ids (HET cache bookkeeping,
 // src/hetu_cache embedding.h Line::version)
 void ps_bump_versions(int64_t* versions, const int64_t* ids, int64_t k) {
-    std::unordered_set<int64_t> seen;
-    seen.reserve((size_t)k * 2);
-    for (int64_t i = 0; i < k; ++i) {
-        if (seen.insert(ids[i]).second) versions[ids[i]] += 1;
-    }
+    hetu_ps::bump_versions(versions, ids, k);
 }
 
 }  // extern "C"

@@ -1,0 +1,144 @@
+// Shared server-side sparse-optimizer kernels.
+//
+// Both native tiers apply these to the SAME numpy-owned buffers — the
+// ctypes entry points in ps_core.cpp (called by the python PSServer)
+// and the TCP van in ps_van.cpp (serving workers directly from C++
+// threads).  The two tiers must stay bit-identical forever, so the row
+// loops live ONCE, here (ADVICE r4 / review r5: the van originally
+// re-implemented them).
+//
+// Reference: ps-lite include/ps/server/optimizer.h:36-275 sparse paths;
+// duplicate-id handling mirrors IndexedSlices deduplicate
+// (src/ops/IndexedSlices.cu) — stateful optimizers must see each row
+// once per request, so sparse entry points first merge duplicate ids'
+// gradients, then apply per unique row.
+
+#ifndef HETU_TPU_NATIVE_PS_KERNELS_H_
+#define HETU_TPU_NATIVE_PS_KERNELS_H_
+
+#include <cmath>
+#include <cstdint>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace hetu_ps {
+
+// Dedup-merge duplicate ids (sum of their rows), first-seen order.
+inline void merge_rows(const int64_t* ids, const float* rows, int64_t k,
+                       int64_t cols, std::vector<int64_t>& uniq,
+                       std::vector<float>& merged) {
+    std::unordered_map<int64_t, int64_t> pos;
+    pos.reserve((size_t)k * 2);
+    uniq.clear();
+    merged.clear();
+    for (int64_t i = 0; i < k; ++i) {
+        auto it = pos.find(ids[i]);
+        int64_t j;
+        if (it == pos.end()) {
+            j = (int64_t)uniq.size();
+            pos.emplace(ids[i], j);
+            uniq.push_back(ids[i]);
+            merged.insert(merged.end(), cols, 0.0f);
+        } else {
+            j = it->second;
+        }
+        float* dst = merged.data() + j * cols;
+        const float* src = rows + i * cols;
+        for (int64_t c = 0; c < cols; ++c) dst[c] += src[c];
+    }
+}
+
+inline void sparse_sgd(float* value, const int64_t* ids,
+                       const float* rows, int64_t k, int64_t cols,
+                       float lr) {
+    // stateless: no dedup needed, updates are additive
+    for (int64_t i = 0; i < k; ++i) {
+        float* dst = value + ids[i] * cols;
+        const float* src = rows + i * cols;
+        for (int64_t c = 0; c < cols; ++c) dst[c] -= lr * src[c];
+    }
+}
+
+// Velocity convention matches the python fallback (v carries -lr*g) so
+// slot state stays interchangeable between engines.
+inline void sparse_momentum(float* value, float* vel, const int64_t* ids,
+                            const float* rows, int64_t k, int64_t cols,
+                            float lr, float momentum, int nesterov) {
+    std::vector<int64_t> uniq;
+    std::vector<float> merged;
+    merge_rows(ids, rows, k, cols, uniq, merged);
+    for (size_t u = 0; u < uniq.size(); ++u) {
+        float* val = value + uniq[u] * cols;
+        float* vl = vel + uniq[u] * cols;
+        const float* g = merged.data() + u * cols;
+        if (nesterov) {
+            for (int64_t c = 0; c < cols; ++c) {
+                vl[c] = momentum * vl[c] - lr * g[c];
+                val[c] += momentum * vl[c] - lr * g[c];
+            }
+        } else {
+            for (int64_t c = 0; c < cols; ++c) {
+                vl[c] = momentum * vl[c] - lr * g[c];
+                val[c] += vl[c];
+            }
+        }
+    }
+}
+
+inline void sparse_adagrad(float* value, float* acc, const int64_t* ids,
+                           const float* rows, int64_t k, int64_t cols,
+                           float lr, float eps) {
+    std::vector<int64_t> uniq;
+    std::vector<float> merged;
+    merge_rows(ids, rows, k, cols, uniq, merged);
+    for (size_t u = 0; u < uniq.size(); ++u) {
+        float* val = value + uniq[u] * cols;
+        float* a = acc + uniq[u] * cols;
+        const float* g = merged.data() + u * cols;
+        for (int64_t c = 0; c < cols; ++c) {
+            a[c] += g[c] * g[c];
+            val[c] -= lr * g[c] / (std::sqrt(a[c]) + eps);
+        }
+    }
+}
+
+inline void sparse_adam(float* value, float* m, float* v,
+                        const int64_t* ids, const float* rows, int64_t k,
+                        int64_t cols, float lr, float b1, float b2,
+                        float eps, int64_t t) {
+    // lazy/per-row bias correction with the global step, matching the
+    // reference's sparse Adam (src/ops/OptimizersSparse.cu semantics)
+    std::vector<int64_t> uniq;
+    std::vector<float> merged;
+    merge_rows(ids, rows, k, cols, uniq, merged);
+    const float bc1 = 1.0f - std::pow(b1, (float)t);
+    const float bc2 = 1.0f - std::pow(b2, (float)t);
+    for (size_t u = 0; u < uniq.size(); ++u) {
+        float* val = value + uniq[u] * cols;
+        float* mm = m + uniq[u] * cols;
+        float* vv = v + uniq[u] * cols;
+        const float* g = merged.data() + u * cols;
+        for (int64_t c = 0; c < cols; ++c) {
+            mm[c] = b1 * mm[c] + (1.0f - b1) * g[c];
+            vv[c] = b2 * vv[c] + (1.0f - b2) * g[c] * g[c];
+            val[c] -= lr * (mm[c] / bc1) / (std::sqrt(vv[c] / bc2) + eps);
+        }
+    }
+}
+
+// bump version counters ONCE per unique id (HET cache bookkeeping,
+// src/hetu_cache embedding.h Line::version) — staleness counters must
+// not diverge by tier
+inline void bump_versions(int64_t* versions, const int64_t* ids,
+                          int64_t k) {
+    std::unordered_set<int64_t> seen;
+    seen.reserve((size_t)k * 2);
+    for (int64_t i = 0; i < k; ++i) {
+        if (seen.insert(ids[i]).second) versions[ids[i]] += 1;
+    }
+}
+
+}  // namespace hetu_ps
+
+#endif  // HETU_TPU_NATIVE_PS_KERNELS_H_

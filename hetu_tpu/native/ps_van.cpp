@@ -1,24 +1,39 @@
 // Native PS "van": a C++ TCP serving loop for the sparse hot path.
 //
 // Reference: ps-lite's Van tier (ps-lite/src/zmq_van.h, p3_van.h) — the
-// reference serves its KV traffic entirely from C++ threads; the Python
-// PSServer here is the correctness/feature surface (full PSFunc API,
-// SSP/BSP, cache sync), and this van is the THROUGHPUT tier for the one
-// pattern that dominates CTR training: sparse push / pull / push-pull
-// on embedding tables with a server-side optimizer.
+// reference serves its KV traffic entirely from C++ threads, with the
+// full server-optimizer family applied in-kernel
+// (ps-lite/include/ps/server/optimizer.h:36-275).  The Python PSServer
+// here is the correctness/feature surface (full PSFunc API, SSP/BSP,
+// cache sync); this van is the THROUGHPUT tier for the pattern that
+// dominates CTR training: sparse push / pull / push-pull on embedding
+// tables with a server-side optimizer (SGD / Momentum / Nesterov /
+// AdaGrad / Adam — same family as the reference's C++ tier).
 //
 // Design:
-//   * the table's numpy buffer is REGISTERED (pointer + shape) — zero
-//     serialization between the van and the Python-visible array;
+//   * the table's numpy buffers are REGISTERED (pointers + shape) — the
+//     value AND the optimizer slot state (velocity / accumulator / m,v
+//     and the Adam step counter) are the SAME memory the Python tier
+//     uses, so the two tiers may serve one table interchangeably;
 //   * one acceptor thread + one thread per connection (worker counts
 //     are small); blocking I/O, one reusable buffer per connection;
 //   * binary little-endian framing (u32 len | u8 op | u32 key | u32 n |
 //     i64 ids[n] | f32 rows[n*dim]); responses are (u32 len | u8 ok |
-//     f32 rows...) — no Python, no pickle, no text on the wire;
+//     f32 rows...) — no Python, no pickle, no text on the wire.  The
+//     9-byte header is read separately from the body so ids/rows land
+//     on the allocator's (16-byte) alignment — no misaligned int64 / f32
+//     loads (frames put ids at offset 9, which is NOT 8-aligned);
+//   * requests and responses are both capped at 1 GiB: a pull whose
+//     n*dim*4 exceeds the cap is REJECTED (ok=0) before any gather, so
+//     the u32 response length can never truncate and the gather can
+//     never outrun the output buffer;
 //   * per-table mutex, also exported (van_table_lock/unlock) so Python
 //     paths touching a registered table can coordinate;
-//   * sequential scatter handles duplicate ids exactly like the Python
-//     server's dedup-merge does for SGD (order-insensitive sum).
+//   * duplicate ids: SGD scatters sequentially (order-insensitive sum,
+//     exactly the Python tier's dedup-merge result); the stateful
+//     optimizers dedup-MERGE first so each touched row's slot state
+//     advances once per request, matching ServerMomentum/AdaGrad/Adam
+//     ._sparse_rows (ps/server.py) and the reference's sparse kernels.
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 -pthread ps_van.cpp
 
@@ -34,16 +49,34 @@
 #include <map>
 #include <mutex>
 #include <thread>
-#include <unordered_set>
 #include <vector>
 
+#include "ps_kernels.h"
+
 namespace {
+
+constexpr size_t kFrameCap = 1ull << 30;  // 1 GiB, both directions
+
+enum OptKind : int {
+  kOptSGD = 0,
+  kOptMomentum = 1,   // nesterov is a flag on momentum
+  kOptAdaGrad = 2,
+  kOptAdam = 3,
+};
 
 struct Table {
   float* value = nullptr;
   int64_t nrows = 0;
   int64_t dim = 0;
-  float lr = 0.0f;           // server-side SGD step
+  int opt = kOptSGD;
+  float lr = 0.0f;
+  float hp1 = 0.0f;      // momentum | adam beta1
+  float hp2 = 0.0f;      // adam beta2
+  float eps = 0.0f;      // adagrad/adam epsilon
+  int nesterov = 0;
+  float* s1 = nullptr;   // velocity | accumulator | adam m   [nrows*dim]
+  float* s2 = nullptr;   // adam v                            [nrows*dim]
+  int64_t* step = nullptr;   // adam step counter (shared with python)
   int64_t* versions = nullptr;  // optional HET version counters
   std::mutex mu;
 };
@@ -87,21 +120,75 @@ bool write_all(int fd, const void* buf, size_t n) {
 
 enum Op : uint8_t { kPush = 1, kPull = 2, kPushPull = 3 };
 
+// Apply the table's server-side optimizer to a pushed batch.  Caller
+// holds t->mu.  The row kernels are the SAME code the python tier's
+// ctypes path runs (ps_kernels.h, also compiled into ps_core.cpp) —
+// the slot state is shared memory, so the tiers cannot diverge.
+void apply_push(Table* t, const int64_t* ids, const float* rows,
+                uint32_t n) {
+  const int64_t k = static_cast<int64_t>(n);
+  switch (t->opt) {
+    case kOptSGD:
+      hetu_ps::sparse_sgd(t->value, ids, rows, k, t->dim, t->lr);
+      break;
+    case kOptMomentum:
+      hetu_ps::sparse_momentum(t->value, t->s1, ids, rows, k, t->dim,
+                               t->lr, t->hp1, t->nesterov);
+      break;
+    case kOptAdaGrad:
+      hetu_ps::sparse_adagrad(t->value, t->s1, ids, rows, k, t->dim,
+                              t->lr, t->eps);
+      break;
+    case kOptAdam:
+      // one step bump per request (ServerAdam.apply_sparse) — counter
+      // memory is shared with python state["t"]
+      hetu_ps::sparse_adam(t->value, t->s1, t->s2, ids, rows, k, t->dim,
+                           t->lr, t->hp1, t->hp2, t->eps, ++(*t->step));
+      break;
+  }
+}
+
 void serve_conn(Van* van, int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  std::vector<char> buf;
+  std::vector<char> body;     // ids + rows, 16-byte aligned at data()
   std::vector<char> out;
+  auto send_reject = [&]() {  // the one ok=0 wire shape, both paths
+    out.resize(5);
+    uint32_t out_len = 1;
+    std::memcpy(out.data(), &out_len, 4);
+    out[4] = 0;
+    return write_all(fd, out.data(), out.size());
+  };
   while (van->running.load()) {
     uint32_t len = 0;
     if (!read_exact(fd, &len, 4)) break;
-    if (len < 9 || len > (1u << 30)) break;   // 1 GiB frame cap
-    buf.resize(len);
-    if (!read_exact(fd, buf.data(), len)) break;
-    uint8_t op = static_cast<uint8_t>(buf[0]);
+    if (len < 9) break;     // malformed header: protocol desync, drop
+    if (len > kFrameCap) {
+      // oversize frame: DRAIN it and reply ok=0 so the client sees a
+      // clean rejection (closing mid-request would read as
+      // "maybe-applied" and needlessly abort the caller's step)
+      char sink[1 << 16];
+      size_t left = len;
+      bool drained = true;
+      while (left > 0) {
+        size_t chunk = left < sizeof(sink) ? left : sizeof(sink);
+        if (!read_exact(fd, sink, chunk)) { drained = false; break; }
+        left -= chunk;
+      }
+      if (!drained) break;
+      if (!send_reject()) break;
+      continue;
+    }
+    char hdr[9];
+    if (!read_exact(fd, hdr, 9)) break;
+    size_t body_len = len - 9;
+    body.resize(body_len);
+    if (body_len > 0 && !read_exact(fd, body.data(), body_len)) break;
+    uint8_t op = static_cast<uint8_t>(hdr[0]);
     uint32_t key, n;
-    std::memcpy(&key, buf.data() + 1, 4);
-    std::memcpy(&n, buf.data() + 5, 4);
+    std::memcpy(&key, hdr + 1, 4);
+    std::memcpy(&n, hdr + 5, 4);
     Table* t = nullptr;
     {
       std::lock_guard<std::mutex> g(van->tables_mu);
@@ -109,21 +196,28 @@ void serve_conn(Van* van, int fd) {
       if (it != van->tables.end()) t = it->second;
     }
     size_t ids_bytes = static_cast<size_t>(n) * 8;
-    const int64_t* ids =
-        reinterpret_cast<const int64_t*>(buf.data() + 9);
-    bool ok = t != nullptr && 9 + ids_bytes <= len;
+    // body.data() comes from operator new (16-aligned); ids sit at
+    // offset 0 and rows at 8*n — both naturally aligned
+    const int64_t* ids = reinterpret_cast<const int64_t*>(body.data());
+    bool ok = t != nullptr && ids_bytes <= body_len;
+    size_t row_bytes = 0;
     if (ok) {
       // the WHOLE request — shape reads, bounds validation, scatter,
       // gather — runs under the table mutex: an in-place re-register
       // may change value/nrows/dim between any two of those steps
       std::lock_guard<std::mutex> g(t->mu);
-      size_t row_bytes = static_cast<size_t>(n) * t->dim * 4;
+      row_bytes = static_cast<size_t>(n) * t->dim * 4;
       const float* rows =
-          reinterpret_cast<const float*>(buf.data() + 9 + ids_bytes);
+          reinterpret_cast<const float*>(body.data() + ids_bytes);
       if (op == kPush || op == kPushPull)
-        ok = 9 + ids_bytes + row_bytes == len;
+        ok = ids_bytes + row_bytes == body_len;
       else
-        ok = 9 + ids_bytes == len;
+        ok = ids_bytes == body_len;
+      // a pull response must itself fit the u32-length frame protocol:
+      // reject oversized gathers up front (n is client-controlled and a
+      // pull frame carries only ids, so row_bytes is unbounded by len)
+      if (ok && (op == kPull || op == kPushPull))
+        ok = row_bytes <= kFrameCap;
       if (ok) {
         for (uint32_t i = 0; i < n; ++i)
           if (ids[i] < 0 || ids[i] >= t->nrows) { ok = false; break; }
@@ -137,22 +231,10 @@ void serve_conn(Van* van, int fd) {
       out[4] = ok ? 1 : 0;
       if (ok) {
         if (op == kPush || op == kPushPull) {
-          const int64_t dim = t->dim;
-          for (uint32_t i = 0; i < n; ++i) {
-            float* dst = t->value + ids[i] * dim;
-            const float* src = rows + static_cast<int64_t>(i) * dim;
-            const float lr = t->lr;
-            for (int64_t d = 0; d < dim; ++d) dst[d] -= lr * src[d];
-          }
-          if (t->versions != nullptr) {
-            // one bump per UNIQUE id, matching the python tier's
-            // ps_bump_versions dedup — HET staleness counters must not
-            // diverge by tier
-            std::unordered_set<int64_t> seen;
-            seen.reserve(n);
-            for (uint32_t i = 0; i < n; ++i)
-              if (seen.insert(ids[i]).second) ++t->versions[ids[i]];
-          }
+          apply_push(t, ids, rows, n);
+          if (t->versions != nullptr)
+            hetu_ps::bump_versions(t->versions, ids,
+                                   static_cast<int64_t>(n));
         }
         if (op == kPull || op == kPushPull) {
           const int64_t dim = t->dim;
@@ -163,10 +245,8 @@ void serve_conn(Van* van, int fd) {
         }
       }
     } else {
-      out.resize(5);
-      uint32_t out_len = 1;
-      std::memcpy(out.data(), &out_len, 4);
-      out[4] = 0;
+      if (!send_reject()) break;
+      continue;
     }
     if (!write_all(fd, out.data(), out.size())) break;
   }
@@ -192,8 +272,10 @@ extern "C" {
 
 void* van_create() { return new Van(); }
 
-// 0 on failure; the bound port otherwise (pass port=0 for ephemeral)
-int van_listen(void* h, int port) {
+// 0 on failure; the bound port otherwise (pass port=0 for ephemeral).
+// bind_all=0 binds loopback (same-host workers); 1 binds INADDR_ANY so
+// remote heturun workers can reach the fast tier directly.
+int van_listen(void* h, int port, int bind_all) {
   Van* van = static_cast<Van*>(h);
   van->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (van->listen_fd < 0) return 0;
@@ -202,7 +284,7 @@ int van_listen(void* h, int port) {
                sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_addr.s_addr = htonl(bind_all ? INADDR_ANY : INADDR_LOOPBACK);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(van->listen_fd, reinterpret_cast<sockaddr*>(&addr),
              sizeof(addr)) != 0)
@@ -217,9 +299,32 @@ int van_listen(void* h, int port) {
   return van->port;
 }
 
-void van_register_sgd_table(void* h, uint32_t key, float* value,
-                            int64_t nrows, int64_t dim, float lr,
-                            int64_t* versions) {
+// Register (or in-place re-register) a table with its server optimizer.
+// opt_kind: 0=SGD 1=Momentum(+nesterov flag) 2=AdaGrad 3=Adam.
+// s1/s2/step are the optimizer slot buffers (may be null per kind);
+// they alias the Python tier's state arrays.
+void van_register_table(void* h, uint32_t key, float* value,
+                        int64_t nrows, int64_t dim, int opt_kind,
+                        float lr, float hp1, float hp2, float eps,
+                        int nesterov, float* s1, float* s2,
+                        int64_t* step, int64_t* versions) {
+  // one field-filler for both branches: a hyperparameter added to
+  // Table can't silently go stale on the re-register path
+  auto fill = [&](Table* t) {
+    t->value = value;
+    t->nrows = nrows;
+    t->dim = dim;
+    t->opt = opt_kind;
+    t->lr = lr;
+    t->hp1 = hp1;
+    t->hp2 = hp2;
+    t->eps = eps;
+    t->nesterov = nesterov;
+    t->s1 = s1;
+    t->s2 = s2;
+    t->step = step;
+    t->versions = versions;
+  };
   Van* van = static_cast<Van*>(h);
   Table* existing = nullptr;
   {
@@ -227,11 +332,7 @@ void van_register_sgd_table(void* h, uint32_t key, float* value,
     auto it = van->tables.find(key);
     if (it == van->tables.end()) {
       Table* t = new Table();
-      t->value = value;
-      t->nrows = nrows;
-      t->dim = dim;
-      t->lr = lr;
-      t->versions = versions;
+      fill(t);
       van->tables[key] = t;
       return;
     }
@@ -242,11 +343,16 @@ void van_register_sgd_table(void* h, uint32_t key, float* value,
   // against van_table_unlock (holds t->mu, then looks up via
   // tables_mu).  Tables are never deleted, so `existing` stays valid.
   std::lock_guard<std::mutex> tg(existing->mu);
-  existing->value = value;
-  existing->nrows = nrows;
-  existing->dim = dim;
-  existing->lr = lr;
-  existing->versions = versions;
+  fill(existing);
+}
+
+// Back-compat shim: the original SGD-only registration entry point.
+void van_register_sgd_table(void* h, uint32_t key, float* value,
+                            int64_t nrows, int64_t dim, float lr,
+                            int64_t* versions) {
+  van_register_table(h, key, value, nrows, dim, kOptSGD, lr, 0.0f,
+                     0.0f, 0.0f, 0, nullptr, nullptr, nullptr,
+                     versions);
 }
 
 // Python paths touching a registered table's buffer coordinate here

@@ -147,6 +147,13 @@ class PSClient:
         self._pool = ThreadPoolExecutor(max_workers=4,
                                         thread_name_prefix="ps-client")
         self._hb_stop = None
+        # native-van fast tier: per-thread discovery + socket (the van
+        # protocol is one blocking socket, not thread-safe).  All
+        # sockets ever opened are also tracked process-wide so
+        # finalize() can close the ones pool threads created.
+        self._van_local = threading.local()
+        self._van_clients = []
+        self._van_clients_mu = threading.Lock()
 
     def start_heartbeat(self, interval=5.0, role="worker", node_id=None):
         """Beat the scheduler's liveness map (HETU_SCHEDULER_ADDR) every
@@ -232,6 +239,16 @@ class PSClient:
 
     def finalize(self):
         self._pool.shutdown(wait=True)
+        # close EVERY van socket ever opened, including the ones pool
+        # threads created in their own thread-local state (each holds a
+        # serve_conn thread on the server until closed)
+        with self._van_clients_mu:
+            clients, self._van_clients = self._van_clients, []
+        for cli in clients:
+            cli.close()
+        st = getattr(self._van_local, "state", None)
+        if st is not None:
+            st["cli"] = None
         self.t.close()
         PSClient._instance = None
 
@@ -265,25 +282,157 @@ class PSClient:
             return self._pool.submit(self.t.call, "dd_pushpull", key, grad)
         return self.t.call("dd_pushpull", key, grad)
 
+    # The three sparse verbs route through the server's native C++ van
+    # when it serves the key (reference: workers speak to the zmq_van
+    # tier directly; the Executor's hybrid phases A/B inherit this).
+    # Discovery is one van_info RPC; connection-level van failures fall
+    # back to the python tier permanently for this thread.
+
+    _VAN_REFRESH_S = 5.0      # re-ask van_info for missing keys at most
+    _VAN_MAX_CONNECT_TRIES = 3   # this often; give up connecting after
+
+    def _van_route(self, key):
+        """(VanClient, van_key_id) when the server's native van serves
+        ``key``; None otherwise.  Discovery failures and unseen keys
+        are re-checked at most every ``_VAN_REFRESH_S`` seconds, so a
+        serve_van() issued after traffic started still gets picked up;
+        repeated connect failures retire the fast tier per-thread."""
+        if os.environ.get("HETU_PS_USE_VAN", "1") == "0":
+            return None
+        st = getattr(self._van_local, "state", None)
+        if st is None:
+            st = {"port": None, "keys": {}, "cli": None,
+                  "checked_at": 0.0, "connect_fails": 0, "dead": False}
+            self._van_local.state = st
+        if st["dead"]:
+            return None
+        if key not in st["keys"]:
+            now = time.monotonic()
+            if now - st["checked_at"] < self._VAN_REFRESH_S:
+                return None
+            st["checked_at"] = now
+            try:
+                port, keys = self.t.call("van_info")
+            except Exception:
+                return None       # transient: retry after the window
+            st["port"], st["keys"] = port, dict(keys)
+            if key not in st["keys"]:
+                return None
+        if st["port"] is None:
+            return None
+        if st["cli"] is None:
+            from .van import VanClient
+            host = getattr(self.t, "host", "127.0.0.1")
+            try:
+                st["cli"] = VanClient(
+                    host, st["port"],
+                    timeout=float(os.environ.get("HETU_PS_TIMEOUT",
+                                                 "60")))
+            except OSError:
+                st["connect_fails"] += 1
+                if st["connect_fails"] >= self._VAN_MAX_CONNECT_TRIES:
+                    st["dead"] = True
+                return None
+            with self._van_clients_mu:
+                self._van_clients.append(st["cli"])
+        return st["cli"], st["keys"][key]
+
+    def _van_drop(self):
+        st = self._van_local.state
+        if st["cli"] is not None:
+            st["cli"].close()
+        st["cli"] = None
+        st["dead"] = True
+
+    def _van_push_failed(self, key, err):
+        """A van push failed at the socket level.  The van applies a
+        request only after reading its complete frame, so a SEND-side
+        failure is safe to retry through the python tier; a failure
+        awaiting the response means the update may already be in the
+        shared buffers — re-applying it there would double the step, so
+        that surfaces as PSConnectionError instead (the resender-style
+        dedup the python wire has does not exist on the van protocol)."""
+        self._van_drop()
+        if err.maybe_applied:
+            raise PSConnectionError(
+                f"van push for {key!r} failed awaiting the response; "
+                f"the update may already be applied, so it is NOT "
+                f"retried through the python tier (double-apply). "
+                f"Last error: {err}") from err
+
     def sparse_pull(self, key, ids, async_=False):
         ids = np.asarray(ids, np.int64)
         if async_:
-            return self._pool.submit(self.t.call, "sparse_pull", key, ids)
+            return self._pool.submit(self._sparse_pull_sync, key, ids)
+        return self._sparse_pull_sync(key, ids)
+
+    def _sparse_pull_sync(self, key, ids):
+        route = self._van_route(key) if ids.size else None
+        if route is not None:
+            cli, kid = route
+            try:
+                return cli.pull(kid, ids)
+            except (OSError, ConnectionError):
+                self._van_drop()    # reads are idempotent: fall back
+            except RuntimeError:
+                # van rejected (e.g. a pull too large for its 1 GiB
+                # frame): nothing was applied and the connection is
+                # healthy — the python tier is the authority
+                pass
         return self.t.call("sparse_pull", key, ids)
 
     def sparse_push(self, key, ids, rows, async_=False):
         ids = np.asarray(ids, np.int64)
         rows = np.asarray(rows, np.float32)
         if async_:
-            return self._pool.submit(self.t.call, "sparse_push", key, ids, rows)
+            return self._pool.submit(self._sparse_push_sync, key, ids,
+                                     rows)
+        return self._sparse_push_sync(key, ids, rows)
+
+    def _sparse_push_sync(self, key, ids, rows):
+        from .van import VanTransportError
+        route = self._van_route(key) if ids.size else None
+        if route is not None:
+            cli, kid = route
+            try:
+                return cli.push(kid, ids, rows)
+            except VanTransportError as e:
+                self._van_push_failed(key, e)   # raises if maybe-applied
+            except RuntimeError:
+                pass   # van rejected the frame: NOT applied, safe retry
         return self.t.call("sparse_push", key, ids, rows)
 
     def sd_pushpull(self, key, ids, rows, pull_ids=None, async_=False):
         ids = np.asarray(ids, np.int64)
         rows = np.asarray(rows, np.float32)
         if async_:
-            return self._pool.submit(self.t.call, "sd_pushpull", key, ids,
+            return self._pool.submit(self._sd_pushpull_sync, key, ids,
                                      rows, pull_ids)
+        return self._sd_pushpull_sync(key, ids, rows, pull_ids)
+
+    def _sd_pushpull_sync(self, key, ids, rows, pull_ids):
+        from .van import VanTransportError
+        # pull-only shards (sharded CTR hot path) still route: the van
+        # accepts a zero-id push, and the python tier's sd_pushpull
+        # always pushes — a shared Adam table's step counter must
+        # advance the same way on both tiers
+        want = bool(ids.size) or pull_ids is not None
+        route = self._van_route(key) if want else None
+        if route is not None:
+            cli, kid = route
+            try:
+                if pull_ids is None:
+                    return cli.sd_pushpull(kid, ids, rows)
+                cli.push(kid, ids, rows)
+            except VanTransportError as e:
+                self._van_push_failed(key, e)   # raises if maybe-applied
+            except RuntimeError:
+                pass   # van rejected the frame: NOT applied, safe retry
+            else:
+                # the push landed; the (idempotent) pull half completes
+                # through the pull route, which has its own fallback
+                return self._sparse_pull_sync(
+                    key, np.asarray(pull_ids, np.int64))
         return self.t.call("sd_pushpull", key, ids, rows, pull_ids)
 
     def ss_pushpull(self, key, ids, rows, pull_ids, async_=False):

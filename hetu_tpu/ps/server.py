@@ -42,7 +42,8 @@ from . import wire
 def _load_native():
     from ..native import build_and_load
 
-    lib = build_and_load("ps_core.cpp", "libps_core.so")
+    lib = build_and_load("ps_core.cpp", "libps_core.so",
+                         deps=("ps_kernels.h",))
     if lib is None:
         return None
     f32p = ctypes.POINTER(ctypes.c_float)
@@ -361,10 +362,20 @@ class PSServer:
         """Attach the native C++ van (ps/van.py, reference ps-lite
         zmq_van tier): the selected tables' sparse push/pull/push-pull
         are served zero-copy by C++ threads ON THE SAME BUFFERS the
-        python PSFunc surface uses.  Only 2-D float32 tables with a
-        server-side SGD optimizer qualify (the van applies SGD
-        in-kernel); their python lock becomes a composite lock shared
-        with the van's per-table mutex, so both tiers serialize.
+        python PSFunc surface uses.  2-D float32 tables with any
+        server-side optimizer from the SGD family qualify (the van
+        applies SGD/Momentum/Nesterov/AdaGrad/Adam in-kernel, sharing
+        the python tier's slot state — reference server/optimizer.h);
+        their python lock becomes a composite lock shared with the
+        van's per-table mutex, so both tiers serialize.
+
+        Registration is race-free when it happens before workers start
+        pushing to the table (the ``enable_van_autoserve`` path
+        registers at creation).  A table already receiving traffic is
+        swapped under its param lock, so in-flight python ops drain
+        first; an op that read the OLD lock object but had not yet
+        acquired it can still overlap the van's first requests for one
+        op — prefer autoserve for live tables.
 
         Returns (port, {key: van_key_id}) — VanClient speaks van ids.
         """
@@ -373,9 +384,11 @@ class PSServer:
 
     @staticmethod
     def _van_qualifies(p):
-        """The van applies SGD in-kernel on a 2-D float32 buffer."""
-        return (isinstance(p.optimizer, ServerSGD) and p.value.ndim == 2
-                and p.value.dtype == np.float32)
+        """The van serves 2-D float32 buffers whose server optimizer it
+        can apply in-kernel (the whole SERVER_OPTIMIZERS family)."""
+        return (isinstance(p.optimizer, (ServerSGD, ServerMomentum,
+                                         ServerAdaGrad, ServerAdam))
+                and p.value.ndim == 2 and p.value.dtype == np.float32)
 
     def _serve_van_locked(self, keys=None, port=0):
         """serve_van body; caller holds self.lock (param_init's
@@ -383,7 +396,14 @@ class PSServer:
         from .van import NativeVan, VanSharedLock
         if getattr(self, "_van", None) is None:
             self._van = NativeVan()
-            self._van_port = self._van.listen(port)
+            # HETU_PS_VAN_BIND_ALL=1 exposes the (authentication-free)
+            # fast tier beyond loopback for true multi-host heturun
+            # deployments; "", "0" and "false" all mean loopback-only
+            self._van_port = self._van.listen(
+                port,
+                bind_all=os.environ.get(
+                    "HETU_PS_VAN_BIND_ALL", "0").lower()
+                not in ("", "0", "false"))
             self._van_keys = {}
         if keys is _AUTOSERVE:
             # every FUTURE qualifying table registers on creation
@@ -400,16 +420,22 @@ class PSServer:
             p = self.params[k]
             if not self._van_qualifies(p):
                 raise ValueError(
-                    f"van can only serve 2-D float32 SGD tables; "
-                    f"{k!r} is {p.value.dtype}/{p.value.ndim}-D with "
+                    f"van can only serve 2-D float32 tables with a "
+                    f"server optimizer from the SGD family; {k!r} is "
+                    f"{p.value.dtype}/{p.value.ndim}-D with "
                     f"{type(p.optimizer).__name__}")
             kid = len(self._van_keys)
-            # the registered (contiguous) array IS the served buffer;
-            # the param points at exactly it and shares the van's
-            # per-table mutex
-            p.value = self._van.register_sgd_table(
-                kid, p.value, lr=p.optimizer.lr, versions=p.versions)
-            p.lock = VanSharedLock(p.lock, self._van, kid)
+            # the registered (contiguous) arrays ARE the served
+            # buffers; the param points at exactly them and shares the
+            # van's per-table mutex.  Register + lock swap run under
+            # the param's EXISTING lock so any python op already inside
+            # the table drains before the van can serve it (lock order
+            # self.lock -> p.lock matches every PSFunc site).
+            with p.lock:
+                p.value = self._van.register_table(
+                    kid, p.value, p.optimizer, p.state,
+                    versions=p.versions)
+                p.lock = VanSharedLock(p.lock, self._van, kid)
             self._van_keys[k] = kid
         return self._van_port, dict(self._van_keys)
 
@@ -503,11 +529,30 @@ class PSServer:
         if opt is not None:
             optimizer = SERVER_OPTIMIZERS[opt](**(opt_args or {}))
         with self.lock:
-            if key in getattr(self, "_van_keys", {}):
-                raise ValueError(
-                    f"{key!r} is served by the native van; replacing its "
-                    f"buffer would detach the C++ tier — use "
-                    f"param_assign (in-place) instead")
+            vkeys = getattr(self, "_van_keys", {})
+            if key in vkeys:
+                # a van-served key is RE-REGISTERED in place (the C++
+                # tier swaps its pointers under the table mutex) rather
+                # than refused — the executor bridge re-sets tables on
+                # load_dict.  A respec the van cannot serve would
+                # silently detach the fast tier, so that stays loud.
+                from .van import VanSharedLock
+                new_p = _Param(value, optimizer)
+                if not self._van_qualifies(new_p):
+                    raise ValueError(
+                        f"{key!r} is served by the native van and the "
+                        f"new spec ({value.dtype}/{value.ndim}-D, "
+                        f"{type(optimizer).__name__}) does not qualify "
+                        f"— the van cannot be detached from a key")
+                kid = vkeys[key]
+                pylock = self.params[key].lock.pylock
+                with pylock:       # drain python ops; the register
+                    new_p.value = self._van.register_table(   # itself
+                        kid, new_p.value, new_p.optimizer,    # fences
+                        new_p.state, versions=new_p.versions)  # van
+                    new_p.lock = VanSharedLock(pylock, self._van, kid)
+                    self.params[key] = new_p
+                return True
             self.params[key] = _Param(value, optimizer)
             self._van_autoserve_locked(key)
             return True

@@ -3,12 +3,16 @@
 
 The Python ``PSServer`` remains the full-feature surface (PSFunc API,
 optimizers, SSP/BSP, HET sync); ``NativeVan`` serves ONE pattern —
-sparse push / pull / push-pull with server-side SGD on a registered
-embedding table — entirely from C++ threads over a binary protocol, so
-no Python executes per request.  The registered table IS the server's
-numpy buffer (zero copy between the tiers); Python paths touching a
-registered table coordinate through the van's per-table mutex
-(``table_lock``/``table_unlock``).
+sparse push / pull / push-pull with a server-side optimizer on a
+registered embedding table — entirely from C++ threads over a binary
+protocol, so no Python executes per request.  The whole server
+optimizer family is applied in-kernel (SGD/Momentum/Nesterov/AdaGrad/
+Adam — reference ps-lite/include/ps/server/optimizer.h:36-275).  The
+registered table IS the server's numpy buffer, and the optimizer slot
+state (velocity / accumulator / m,v / Adam step) aliases the Python
+tier's state arrays (zero copy between the tiers); Python paths
+touching a registered table coordinate through the van's per-table
+mutex (``table_lock``/``table_unlock``).
 
     van = NativeVan()
     port = van.listen()
@@ -38,16 +42,23 @@ def _load():
     global _LIB
     if _LIB is None:
         lib = build_and_load("ps_van.cpp", "libps_van.so",
-                             extra_flags=("-pthread",))
+                             extra_flags=("-pthread",),
+                             deps=("ps_kernels.h",))
         if lib is not None:
             lib.van_create.restype = ctypes.c_void_p
             lib.van_listen.restype = ctypes.c_int
-            lib.van_listen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.van_listen.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int]
             f32p = ctypes.POINTER(ctypes.c_float)
             i64p = ctypes.POINTER(ctypes.c_int64)
             lib.van_register_sgd_table.argtypes = [
                 ctypes.c_void_p, ctypes.c_uint32, f32p, ctypes.c_int64,
                 ctypes.c_int64, ctypes.c_float, i64p]
+            lib.van_register_table.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, f32p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int, f32p, f32p, i64p, i64p]
             for name in ("van_table_lock", "van_table_unlock",
                          "van_stop", "van_destroy"):
                 getattr(lib, name).argtypes = [ctypes.c_void_p] \
@@ -73,8 +84,8 @@ class NativeVan:
         self._tables = {}            # key -> value array (keepalive)
         self.port = None
 
-    def listen(self, port=0):
-        got = self._l.van_listen(self._h, int(port))
+    def listen(self, port=0, bind_all=False):
+        got = self._l.van_listen(self._h, int(port), int(bool(bind_all)))
         if not got:
             raise OSError(f"van failed to bind port {port}")
         self.port = got
@@ -97,6 +108,74 @@ class NativeVan:
             value.shape[0], value.shape[1], float(lr), vp)
         # keep BOTH buffers alive for the van's lifetime
         self._tables[int(key)] = (value, versions)
+        return value
+
+    def register_table(self, key, value, optimizer, state,
+                       versions=None):
+        """Register a table with its full server optimizer (reference
+        zmq_van + server/optimizer.h: the C++ tier applies the SAME
+        optimizer family the python tier does).
+
+        ``optimizer``: a ``Server{SGD,Momentum,Nesterov,AdaGrad,Adam}``
+        from ps/server.py.  ``state``: that param's slot-state dict —
+        its arrays are (re)made contiguous, REPLACED IN PLACE in the
+        dict, and registered, so both tiers advance ONE set of slots.
+        Returns the (possibly re-allocated contiguous) value array the
+        param must now point at.
+        """
+        from .server import (ServerAdaGrad, ServerAdam, ServerMomentum,
+                             ServerSGD)
+        value = np.ascontiguousarray(value, np.float32)
+        assert value.ndim == 2
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+
+        def _slot(name):
+            arr = np.ascontiguousarray(state[name], np.float32)
+            assert arr.shape == value.shape
+            state[name] = arr          # the python tier must see the
+            return arr                 # SAME memory the van mutates
+
+        kind, hp1, hp2, eps, nesterov = 0, 0.0, 0.0, 0.0, 0
+        s1 = s2 = step = None
+        if type(optimizer) is ServerSGD:
+            kind = 0
+        elif isinstance(optimizer, ServerMomentum):   # incl. Nesterov
+            kind, hp1 = 1, optimizer.momentum
+            nesterov = int(optimizer.nesterov)
+            s1 = _slot("v")
+        elif isinstance(optimizer, ServerAdaGrad):
+            kind, eps = 2, optimizer.eps
+            s1 = _slot("acc")
+        elif isinstance(optimizer, ServerAdam):
+            kind = 3
+            hp1, hp2, eps = optimizer.beta1, optimizer.beta2, optimizer.eps
+            s1, s2 = _slot("m"), _slot("v")
+            # the 0-d step counter is shared as-is (ascontiguousarray
+            # would promote it to 1-d and break the python tier's
+            # ``int(state["t"])``)
+            if state["t"].dtype != np.int64:
+                state["t"] = state["t"].astype(np.int64)
+            step = state["t"]
+        else:
+            raise ValueError(
+                f"van cannot serve {type(optimizer).__name__}")
+        vp = None
+        if versions is not None:
+            versions = np.ascontiguousarray(versions, np.int64)
+            assert len(versions) == value.shape[0]
+            vp = versions.ctypes.data_as(i64p)
+        self._l.van_register_table(
+            self._h, int(key), value.ctypes.data_as(f32p),
+            value.shape[0], value.shape[1], kind,
+            float(optimizer.lr), float(hp1), float(hp2), float(eps),
+            nesterov,
+            s1.ctypes.data_as(f32p) if s1 is not None else None,
+            s2.ctypes.data_as(f32p) if s2 is not None else None,
+            step.ctypes.data_as(i64p) if step is not None else None,
+            vp)
+        # keep every registered buffer alive for the van's lifetime
+        self._tables[int(key)] = (value, versions, s1, s2, step)
         return value
 
     def table_lock(self, key):
@@ -142,36 +221,76 @@ class VanSharedLock:
         return False
 
 
-class VanClient:
-    """Blocking binary-protocol client for one van."""
+class VanTransportError(ConnectionError):
+    """A van round-trip failed at the socket level.  ``maybe_applied``
+    says whether the server may already have APPLIED the request: the
+    van applies only after reading a complete frame, so a failure while
+    SENDING means not-applied (safe to retry elsewhere), while a
+    failure while awaiting the response means the push may have landed
+    — callers must not re-apply it through another tier."""
 
-    def __init__(self, host, port, dim, timeout=30.0):
-        self.dim = int(dim)
+    def __init__(self, msg, maybe_applied):
+        super().__init__(msg)
+        self.maybe_applied = maybe_applied
+
+
+class VanClient:
+    """Blocking binary-protocol client for one van.
+
+    ``dim`` is optional: pushes carry it in the row payload and pull
+    responses reveal it in the frame length, so a dim-less client can
+    serve tables of any width (the PSClient fast-tier route uses this).
+    """
+
+    def __init__(self, host, port, dim=None, timeout=30.0):
+        self.dim = None if dim is None else int(dim)
         self._sock = socket.create_connection((host, port),
                                               timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _send_frame(self, parts):
+        """sendmsg + drain: sendmsg may queue only part of a multi-MB
+        payload (python docs: the caller must finish delivery)."""
+        total = sum(len(p) for p in parts)
+        sent = self._sock.sendmsg(parts)
+        if sent < total:
+            rest = b"".join(bytes(p) for p in parts)   # rare path
+            self._sock.sendall(rest[sent:])
 
     def _roundtrip(self, op, key, ids, rows, want_rows):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         n = len(ids)
         parts = [_HDR.pack(op, key, n), memoryview(ids).cast("B")]
-        if rows is not None:
-            rows = np.ascontiguousarray(rows, np.float32).reshape(
-                n, self.dim)
+        # a zero-id push carries no row payload (and reshape(0, -1) is
+        # a numpy error) — the server accepts the 0-byte row section
+        if rows is not None and n > 0:
+            rows = np.ascontiguousarray(rows, np.float32)
+            rows = rows.reshape(n, -1 if self.dim is None else self.dim)
             parts.append(memoryview(rows).cast("B"))
         total = sum(len(p) for p in parts)
-        # scatter-gather send: no join copy of the multi-MB row payload
-        self._sock.sendmsg([_LEN.pack(total)] + parts)
-        out_len = self._recv_exact(4)
-        (m,) = _LEN.unpack(out_len)
-        payload = self._recv_exact(m)
+        sent_all = False
+        try:
+            # scatter-gather send: no join copy of the multi-MB payload
+            self._send_frame([_LEN.pack(total)] + parts)
+            sent_all = True
+            out_len = self._recv_exact(4)
+            (m,) = _LEN.unpack(out_len)
+            payload = self._recv_exact(m)
+        except (OSError, ConnectionError) as e:
+            raise VanTransportError(
+                f"van round-trip failed while "
+                f"{'awaiting the response' if sent_all else 'sending'}"
+                f": {type(e).__name__}: {e}",
+                maybe_applied=sent_all) from e
         if payload[0] != 1:
             raise RuntimeError(
                 "van rejected the request (unknown key, id out of "
                 "range, or malformed frame)")
         if want_rows:
+            if n == 0:       # reshape(0, -1) is a numpy error; width
+                return np.zeros((0, self.dim or 0), np.float32)
             arr = np.frombuffer(payload, np.float32, offset=1)
-            return arr.reshape(n, self.dim).copy()
+            return arr.reshape(n, -1).copy()
         return None
 
     def _recv_exact(self, n):

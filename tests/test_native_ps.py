@@ -263,9 +263,32 @@ class TestVanServerIntegration:
             srv.shutdown()
             PSServer._instance = None
 
-    def test_non_sgd_table_rejected(self):
+    def test_unservable_table_rejected(self):
+        """Optimizer-less (raw accumulate) tables stay python-tier; the
+        van serves only the server-optimizer family it can apply."""
         from hetu_tpu.ps.server import PSServer
         from hetu_tpu.ps.van import van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        PSServer._instance = None
+        srv = PSServer.get()
+        srv.param_init("raw", (8, 2), "constant", 0.0, opt=None)
+        try:
+            with pytest.raises(ValueError):
+                srv.serve_van(["raw"])
+            # auto-selection simply skips non-qualifying tables
+            port, keymap = srv.serve_van()
+            assert "raw" not in keymap
+        finally:
+            srv.shutdown()
+            PSServer._instance = None
+
+    def test_adam_table_served_with_shared_step(self):
+        """r5: the van applies the FULL server-optimizer family
+        (reference server/optimizer.h via zmq_van); an adam table's
+        slot state and step counter are SHARED with the python tier."""
+        from hetu_tpu.ps.server import PSServer
+        from hetu_tpu.ps.van import VanClient, van_available
         if not van_available():
             pytest.skip("no C++ toolchain")
         PSServer._instance = None
@@ -273,14 +296,63 @@ class TestVanServerIntegration:
         srv.param_init("ad", (8, 2), "constant", 0.0, opt="adam",
                        opt_args={"learning_rate": 0.1})
         try:
-            with pytest.raises(ValueError):
-                srv.serve_van(["ad"])
-            # auto-selection simply skips non-qualifying tables
-            port, keymap = srv.serve_van()
-            assert "ad" not in keymap
+            port, keymap = srv.serve_van(["ad"])
+            assert "ad" in keymap
+            cli = VanClient("127.0.0.1", port, dim=2)
+            ids = np.array([1, 3], np.int64)
+            cli.push(keymap["ad"], ids, np.ones((2, 2), np.float32))
+            p = srv.params["ad"]
+            assert int(p.state["t"]) == 1          # van bumped the
+            assert float(p.state["m"][1, 0]) != 0  # python-side state
+            # python tier continues the SAME trajectory (t -> 2)
+            srv.sparse_push("ad", ids, np.ones((2, 2), np.float32))
+            assert int(p.state["t"]) == 2
+            cli.close()
         finally:
             srv.shutdown()
             PSServer._instance = None
+
+
+@pytest.mark.parametrize("optname,kw", [
+    ("momentum", {"learning_rate": 0.2, "momentum": 0.9}),
+    ("nesterov", {"learning_rate": 0.2, "momentum": 0.8}),
+    ("adagrad", {"learning_rate": 0.3}),
+    ("adam", {"learning_rate": 0.05}),
+])
+def test_van_optimizer_matches_python_tier(optname, kw):
+    """Van-served pushes (dup ids included) must land EXACTLY where the
+    python tier's apply_sparse would: same value trajectory, same slot
+    state, advanced in the registered (shared) buffers."""
+    from hetu_tpu.ps.server import SERVER_OPTIMIZERS
+    from hetu_tpu.ps.van import NativeVan, VanClient, van_available
+    if not van_available():
+        pytest.skip("no C++ toolchain")
+    rng = np.random.RandomState(7)
+    opt_py = SERVER_OPTIMIZERS[optname](**kw)
+    opt_van = SERVER_OPTIMIZERS[optname](**kw)
+    value_py = rng.randn(32, 4).astype(np.float32)
+    state_py = opt_py.init_state(value_py.shape)
+    value_van = value_py.copy()
+    state_van = opt_van.init_state(value_van.shape)
+    van = NativeVan()
+    port = van.listen()
+    served = van.register_table(3, value_van, opt_van, state_van)
+    cli = VanClient("127.0.0.1", port, dim=4)
+    try:
+        for _ in range(3):
+            ids = np.array([5, 9, 5, 20], np.int64)   # duplicate id
+            rows = rng.randn(4, 4).astype(np.float32)
+            opt_py.apply_sparse(value_py, ids, rows, state_py)
+            cli.push(3, ids, rows)
+        np.testing.assert_allclose(served, value_py, rtol=2e-6,
+                                   atol=1e-6)
+        for k in state_py:          # slot state advanced identically,
+            np.testing.assert_allclose(                 # in the shared
+                np.asarray(state_van[k]), np.asarray(state_py[k]),
+                rtol=2e-6, atol=1e-6)                   # registered arrays
+    finally:
+        cli.close()
+        van.stop()
 
 
 def test_van_served_keys_refuse_buffer_replacement():
@@ -294,6 +366,16 @@ def test_van_served_keys_refuse_buffer_replacement():
                    opt_args={"learning_rate": 0.1})
     srv.serve_van(["k"])
     try:
+        # r5: a qualifying re-set RE-REGISTERS the van table in place
+        # (the executor bridge param_sets on load_dict); the served
+        # buffer follows the new value
+        srv.param_set("k", np.full((8, 2), 7.0, np.float32), opt="sgd",
+                      opt_args={"learning_rate": 0.1})
+        np.testing.assert_allclose(
+            srv.sparse_pull("k", np.arange(8)), 7.0)
+        assert "k" in srv._van_keys
+        # a respec the van cannot serve (no optimizer) stays refused —
+        # it would silently detach the fast tier
         with pytest.raises(ValueError):
             srv.param_set("k", np.ones((8, 2), np.float32))
         with pytest.raises(ValueError):
@@ -376,12 +458,15 @@ def test_van_autoserve_and_discovery_over_tcp():
         # created AFTER autoserve was enabled -> auto-registered
         c.parameter_init("auto", (16, 4), "constant", 0.0, opt="sgd",
                          opt_args={"learning_rate": 1.0})
-        # a non-qualifying table stays python-tier without error
+        # r5: the full optimizer family autoserves; only tables the van
+        # cannot apply (no optimizer) stay python-tier without error
         c.parameter_init("adam_t", (8, 2), "constant", 0.0, opt="adam",
                          opt_args={"learning_rate": 0.1})
+        c.parameter_init("raw_t", (8, 2), "constant", 0.0, opt=None)
         got_port, keymap = c.t.call("van_info")
         assert got_port == vport
-        assert "auto" in keymap and "adam_t" not in keymap
+        assert "auto" in keymap and "adam_t" in keymap
+        assert "raw_t" not in keymap
         vc = VanClient("127.0.0.1", got_port, dim=4)
         ids = np.arange(8)
         vc.push(keymap["auto"], ids, np.ones((8, 4), np.float32))
