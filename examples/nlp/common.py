@@ -1,8 +1,31 @@
 """Shared helpers for the NLP example scripts."""
 
 import itertools
+import os
+import tempfile
 
 import numpy as np
+
+
+def hermetic_tokenizer(text_lines, vocab_path=None):
+    """A wordpiece tokenizer from --vocab-path, or built hermetically
+    from the dataset's own text (temp corpus + derived vocab cleaned
+    up).  Shared by the GLUE and SQuAD fine-tune examples."""
+    from hetu_tpu.pretraining_data import load_or_build_tokenizer
+    if vocab_path:
+        return load_or_build_tokenizer(None, vocab_path)
+    fd, corpus = tempfile.mkstemp(suffix=".txt")
+    try:
+        with os.fdopen(fd, "w") as f:
+            for line in text_lines:
+                f.write(line + "\n")
+        return load_or_build_tokenizer(corpus)
+    finally:
+        for path in (corpus, corpus + ".vocab.txt"):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
 
 def synthetic_mlm_batch(rng, cfg, mask_prob=0.15):

@@ -19,8 +19,9 @@ devices ('dp' mesh axis; XLA inserts the gradient psum).
 import os
 import sys
 
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, '..', '..'))
+sys.path.insert(0, _HERE)   # for the shared `common` helpers
 
 import argparse
 import logging
@@ -32,6 +33,7 @@ import hetu_tpu as ht
 from hetu_tpu.glue import (PROCESSORS, compute_metrics,
                            convert_examples_to_arrays)
 from hetu_tpu.models import BertConfig, BertForSequenceClassification
+from common import hermetic_tokenizer
 
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
 logger = logging.getLogger("glue")
@@ -58,8 +60,6 @@ def load_glue_task(task, data_dir, vocab_path, seq_len):
     (reference glue_processor/glue.py).  Returns (train arrays, dev
     arrays, num_labels, vocab_size); each arrays tuple is
     (input_ids, attention_mask, token_type_ids, labels)."""
-    import tempfile
-    from hetu_tpu.pretraining_data import load_or_build_tokenizer
     proc = PROCESSORS[task.lower()]()
     train_ex = proc.get_train_examples(data_dir)
     dev_ex = proc.get_dev_examples(data_dir)
@@ -67,26 +67,13 @@ def load_glue_task(task, data_dir, vocab_path, seq_len):
         cand = os.path.join(data_dir, "vocab.txt")
         if os.path.exists(cand):
             vocab_path = cand
-    if vocab_path:
-        tok = load_or_build_tokenizer(None, vocab_path)
-    else:
-        # hermetic fallback: a vocab from the task's own text, via the
-        # shared bootstrap (temp corpus cleaned up along with the
-        # derived vocab)
-        fd, corpus = tempfile.mkstemp(suffix=".txt")
-        try:
-            with os.fdopen(fd, "w") as f:
-                for ex in train_ex + dev_ex:
-                    f.write(ex.text_a + "\n")
-                    if ex.text_b:
-                        f.write(ex.text_b + "\n")
-            tok = load_or_build_tokenizer(corpus)
-        finally:
-            for path in (corpus, corpus + ".vocab.txt"):
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+
+    def lines():
+        for ex in train_ex + dev_ex:
+            yield ex.text_a
+            if ex.text_b:
+                yield ex.text_b
+    tok = hermetic_tokenizer(lines(), vocab_path)
     lab = proc.get_labels()
     return (convert_examples_to_arrays(train_ex, lab, seq_len, tok),
             convert_examples_to_arrays(dev_ex, lab, seq_len, tok),

@@ -36,7 +36,8 @@ from .ops_conv import (
 from .ops_shape import (
     broadcast_reduce_op, broadcastto_op, broadcast_shape_op, reduce_sum_op,
     reduce_mean_op, reducesumaxiszero_op, reduce_min_op, reduce_norm1_op,
-    reduce_norm2_op, norm_op, array_reshape_op, transpose_op, slice_op,
+    reduce_norm2_op, norm_op, array_reshape_op, transpose_op, squeeze_op,
+    slice_op,
     slice_assign_op, slice_assign_matrix_op, slice_by_matrix_op, split_op,
     concat_op, concatenate_op, pad_op, flatten_op, tile_op, repeat_op,
     roll_op, interpolate_op, gather_op, scatter_op, scatter1d_op,

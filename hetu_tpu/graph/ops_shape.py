@@ -147,6 +147,14 @@ def transpose_op(a, perm=None, ctx=None):
     return _simple("Transpose", lambda x: jnp.transpose(x, perm), a, ctx=ctx)
 
 
+def squeeze_op(a, axis, ctx=None):
+    """Drop a size-1 axis without needing the other dims statically
+    (array_reshape_op would; the QA span head squeezes [N,S,1]->[N,S])."""
+    axis = int(axis)
+    return _simple("Squeeze", lambda x: jnp.squeeze(x, axis=axis), a,
+                   ctx=ctx)
+
+
 def slice_op(a, begin, size, ctx=None):
     """size entries of -1 mean "to the end" (reference gpu_ops/Slice.py)."""
     begin = tuple(int(b) for b in begin)

@@ -22,6 +22,7 @@ from .cnn import (
 from .bert import (
     BertConfig, BertModel, BertForPreTraining,
     BertForSequenceClassification, BertForMaskedLM,
+    BertForQuestionAnswering,
 )
 from .bert_moe import (
     BertMoEConfig, BertMoEModel, BertMoEForPreTraining,

@@ -20,7 +20,7 @@ from ..graph import (
     embedding_lookup_op, array_reshape_op, broadcast_shape_op, dropout_op,
     matmul_op, broadcastto_op, relu_op, gelu_op, tanh_op, slice_op,
     softmaxcrossentropy_sparse_op, tied_lm_head_xent_op,
-    reduce_mean_op, reduce_sum_op,
+    reduce_mean_op, reduce_sum_op, squeeze_op,
     addbyconst_op, mul_byconst_op, opposite_op, div_op, bool_op,
     full_like_op,
 )
@@ -349,3 +349,46 @@ class BertForSequenceClassification:
             return logits
         loss = softmaxcrossentropy_sparse_op(logits, labels)
         return reduce_mean_op(loss, [0]), logits
+
+
+class BertForQuestionAnswering:
+    """SQuAD span-prediction head: per-token start/end logits.
+
+    The reference's BERT example suite stages SQuAD
+    (examples/nlp/bert/data/SquadDownloader.py:1, data/bertPrep.py:1);
+    ``hetu_tpu.squad`` builds the window features this head consumes.
+    Loss is the mean of start and end sparse cross-entropies over the
+    S token positions, positions clamped to [CLS]=0 by the feature
+    builder when the answer falls outside a window.
+    """
+
+    def __init__(self, config: BertConfig, name="bert"):
+        c = config
+        self.config = c
+        self.bert = BertModel(config, name=name)
+        self.qa_outputs = layers.Linear(c.hidden_size, 2,
+                                        name=name + "_qa_outputs")
+
+    def __call__(self, input_ids, token_type_ids=None,
+                 attention_mask=None, start_positions=None,
+                 end_positions=None, kv_lens=None):
+        c = self.config
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask,
+                           kv_lens=kv_lens)
+        logits = self.qa_outputs(seq)                      # (B*S, 2)
+        logits = array_reshape_op(logits,
+                                  [c.batch_size, c.seq_len, 2])
+        start_logits = squeeze_op(
+            slice_op(logits, (0, 0, 0), (c.batch_size, c.seq_len, 1)), 2)
+        end_logits = squeeze_op(
+            slice_op(logits, (0, 0, 1), (c.batch_size, c.seq_len, 1)), 2)
+        if start_positions is None:
+            return start_logits, end_logits
+        start_loss = reduce_mean_op(
+            softmaxcrossentropy_sparse_op(start_logits, start_positions),
+            [0])
+        end_loss = reduce_mean_op(
+            softmaxcrossentropy_sparse_op(end_logits, end_positions),
+            [0])
+        loss = mul_byconst_op(start_loss + end_loss, 0.5)
+        return loss, start_logits, end_logits
