@@ -17,8 +17,9 @@ edges), labels = community — learnable from structure alone, no egress.
 import os
 import sys
 
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), '..', '..'))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, '..', '..'))
+sys.path.insert(0, _HERE)   # for the shared `common` helpers
 
 import argparse
 import logging
@@ -26,24 +27,10 @@ import logging
 import numpy as np
 
 import hetu_tpu as ht
+from common import parse_mesh, sbm_graph
 
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
 logger = logging.getLogger("gcn")
-
-
-def sbm_graph(n, n_classes, p_in, p_out, feat_dim, seed=0):
-    """Stochastic block model + noisy one-hot-ish features."""
-    rng = np.random.RandomState(seed)
-    labels = rng.randint(0, n_classes, n)
-    same = labels[:, None] == labels[None, :]
-    adj = (rng.rand(n, n) < np.where(same, p_in, p_out)).astype(np.float32)
-    adj = np.maximum(adj, adj.T)
-    np.fill_diagonal(adj, 1.0)              # self loops
-    deg = adj.sum(1, keepdims=True)
-    adj = adj / deg                          # row-normalized
-    feat = rng.randn(n, feat_dim).astype(np.float32) * 0.5
-    feat[np.arange(n), labels % feat_dim] += 1.0
-    return adj.astype(np.float32), feat, labels.astype(np.int32)
 
 
 def main():
@@ -58,16 +45,7 @@ def main():
                    help="e.g. dp4xtp2 — 1.5-D partition axes")
     args = p.parse_args()
 
-    mesh = None
-    if args.mesh:
-        from hetu_tpu.parallel.mesh import make_mesh
-        axes = {}
-        for part in args.mesh.split("x"):
-            name = part.rstrip("0123456789")
-            axes[name] = int(part[len(name):])
-        mesh = make_mesh(axes)
-        logger.info("mesh %s", axes)
-
+    mesh = parse_mesh(args.mesh, logger)
     adj, feat, labels = sbm_graph(args.nodes, args.classes, 0.2, 0.01,
                                   args.feat_dim)
     train_mask = np.zeros(args.nodes, bool)

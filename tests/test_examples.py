@@ -44,6 +44,25 @@ def test_gcn_example_generalizes_through_graph():
     assert acc > 0.9         # held-out nodes classified via propagation
 
 
+def test_gcn_hybrid_example_learns_embeddings_on_ps():
+    """run_dist_hybrid.py role: PS-served node embeddings + 1.5-D mesh
+    compute; structure is the only signal, so held-out accuracy above
+    chance proves the hybrid table actually learned."""
+    from hetu_tpu.ps.server import PSServer
+    import hetu_tpu.ps.client as psc
+    PSServer._instance = None
+    psc.PSClient._instance = None
+    try:
+        mod = _load("gnn/train_gcn_hybrid.py", "ex_gcn_hybrid")
+        acc = _run_main(mod, ["--nodes", "128", "--epochs", "150",
+                              "--learning-rate", "0.4",
+                              "--mesh", "dp2xtp2"])
+        assert acc > 0.6     # well above the 0.25 chance level
+    finally:
+        PSServer._instance = None
+        psc.PSClient._instance = None
+
+
 def test_plan_bert_example_runs():
     mod = _load("nlp/plan_bert.py", "ex_plan")
     _run_main(mod, ["--hidden", "32", "--layers", "2", "--heads", "2",
