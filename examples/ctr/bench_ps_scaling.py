@@ -224,10 +224,14 @@ def main():
                            "stability of the aggregate under 8x "
                            "concurrency (no collapse), not parallel "
                            "speedup — that needs cores. van_Kw rows: the "
-                           "C++ serving loop (ps/van.py); in-process "
-                           "single-stream it measures ~16M rows/s — "
-                           "multi-process numbers here are bounded by "
-                           "the PYTHON CLIENTS sharing the same core"},
+                           "C++ serving loop (ps/van.py) over TCP; "
+                           "van_inprocess_single_stream is its service "
+                           "rate with no competing client process — the "
+                           "ONE measured van headline figure (earlier "
+                           "prose claimed ~16M from a different window; "
+                           "the results block is authoritative). "
+                           "Multi-process van rows are bounded by the "
+                           "PYTHON CLIENTS sharing the same core"},
         "results": results,
         "scaling_vs_base": {k: round(r["aggregate_rows_per_sec"] / base, 2)
                             for k, r in results.items()},
