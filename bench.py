@@ -562,11 +562,13 @@ def _ctr_hybrid_once(platform, reduced, *, batch=1024, iters=20,
     import hetu_tpu.ps.client as psc
     PSServer._instance = None      # each tier gets a fresh server so
     psc.PSClient._instance = None  # neither inherits the other's state
-    if tier == "van" and not os.environ.get("HETU_PS_ADDR"):
-        # enable BEFORE the init window: a cold g++ build of the van
-        # .so must not be charged to table_init_s.  With HETU_PS_ADDR
-        # the executor talks to a REMOTE server a local van can't
-        # serve — the row then honestly records van_served=False.
+    if not os.environ.get("HETU_PS_ADDR"):
+        # BOTH tiers get the C++ van (the cache tier's sync_embedding/
+        # push_embedding verbs are van ops too — r5); enable BEFORE the
+        # init window so a cold g++ build of the .so is not charged to
+        # table_init_s.  With HETU_PS_ADDR the executor talks to a
+        # REMOTE server a local van can't serve — the row then honestly
+        # records van_served=False.
         try:
             PSServer.get().enable_van_autoserve()
         except (RuntimeError, OSError):   # no toolchain / bind denied:
@@ -588,11 +590,9 @@ def _ctr_hybrid_once(platform, reduced, *, batch=1024, iters=20,
         perf = ex.ps_perf_summary()
         hit_rate = round(float(np.mean(
             [p["hit_rate"] for p in perf.values()])), 4)
-    van_served = False
-    if tier == "van":
-        srv = PSServer._instance
-        van_served = bool(srv is not None
-                          and getattr(srv, "_van_keys", {}))
+    srv = PSServer._instance
+    van_served = bool(srv is not None
+                      and getattr(srv, "_van_keys", {}))
     # real teardown, not just singleton clearing: finalize() closes the
     # client pool + van sockets, shutdown() stops the C++ serve thread
     # and restores the python locks — later bench configs must not
@@ -637,7 +637,8 @@ def bench_ctr_hybrid(platform, reduced):
         t: {k: r[k] for k in ("value", "step_time_ms", "host_fraction",
                               "cache_hit_rate")}
         for t, r in (("cache", r_cache), ("van", r_van))}
-    out["tiers"]["van"]["van_served"] = r_van["config"]["van_served"]
+    for t, r in (("cache", r_cache), ("van", r_van)):
+        out["tiers"][t]["van_served"] = r["config"]["van_served"]
     return out
 
 

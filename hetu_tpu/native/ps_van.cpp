@@ -62,7 +62,8 @@ enum OptKind : int {
   kOptMomentum = 1,   // nesterov is a flag on momentum
   kOptAdaGrad = 2,
   kOptAdam = 3,
-};
+  kOptAccum = 4,      // optimizer-less table: push ACCUMULATES
+};                    // (value[ids] += rows — the HET cache tables)
 
 struct Table {
   float* value = nullptr;
@@ -118,7 +119,7 @@ bool write_all(int fd, const void* buf, size_t n) {
   return true;
 }
 
-enum Op : uint8_t { kPush = 1, kPull = 2, kPushPull = 3 };
+enum Op : uint8_t { kPush = 1, kPull = 2, kPushPull = 3, kSyncEmb = 4 };
 
 // Apply the table's server-side optimizer to a pushed batch.  Caller
 // holds t->mu.  The row kernels are the SAME code the python tier's
@@ -145,6 +146,17 @@ void apply_push(Table* t, const int64_t* ids, const float* rows,
       hetu_ps::sparse_adam(t->value, t->s1, t->s2, ids, rows, k, t->dim,
                            t->lr, t->hp1, t->hp2, t->eps, ++(*t->step));
       break;
+    case kOptAccum: {
+      // optimizer-less accumulate (PSServer.sparse_push's np.add.at
+      // branch): the HET cache write-back path, workers pre-scale
+      const int64_t dim = t->dim;
+      for (int64_t i = 0; i < k; ++i) {
+        float* dst = t->value + ids[i] * dim;
+        const float* src = rows + i * dim;
+        for (int64_t d = 0; d < dim; ++d) dst[d] += src[d];
+      }
+      break;
+    }
   }
 }
 
@@ -197,10 +209,69 @@ void serve_conn(Van* van, int fd) {
     }
     size_t ids_bytes = static_cast<size_t>(n) * 8;
     // body.data() comes from operator new (16-aligned); ids sit at
-    // offset 0 and rows at 8*n — both naturally aligned
+    // offset 0 and rows (f32) or stored_versions (i64, at 8n) stay
+    // naturally aligned
     const int64_t* ids = reinterpret_cast<const int64_t*>(body.data());
     bool ok = t != nullptr && ids_bytes <= body_len;
     size_t row_bytes = 0;
+    if (ok && op == kSyncEmb) {
+      // HET cache sync (PSServer.sync_embedding): body = ids[n] i64 |
+      // stored_versions[n] i64 | bound i64.  Response: u32 m |
+      // stale_ids m*8 | rows m*dim*4 | server_versions m*8 — only rows
+      // whose server version exceeds the stored one by more than bound.
+      // The response is BUILT under the table mutex but WRITTEN after
+      // releasing it (matching push/pull): a slow client reader must
+      // not stall every other connection on the table.
+      {
+        std::lock_guard<std::mutex> g(t->mu);
+        const int64_t* stored =
+            reinterpret_cast<const int64_t*>(body.data() + ids_bytes);
+        int64_t bound = 0;
+        ok = t->versions != nullptr && body_len == 2 * ids_bytes + 8;
+        if (ok) {
+          std::memcpy(&bound, body.data() + 2 * ids_bytes, 8);
+          // worst-case response must fit the u32-framed 1 GiB cap
+          ok = 4 + static_cast<size_t>(n) * (16 + t->dim * 4)
+               <= kFrameCap;
+        }
+        if (ok) {
+          for (uint32_t i = 0; i < n; ++i)
+            if (ids[i] < 0 || ids[i] >= t->nrows) { ok = false; break; }
+        }
+        if (ok) {
+          std::vector<uint32_t> stale;
+          stale.reserve(n);
+          for (uint32_t i = 0; i < n; ++i)
+            if (t->versions[ids[i]] - stored[i] > bound)
+              stale.push_back(i);
+          const uint32_t m = static_cast<uint32_t>(stale.size());
+          const int64_t dim = t->dim;
+          size_t payload = 4 + static_cast<size_t>(m) * (16 + dim * 4);
+          out.resize(4 + 1 + payload);
+          uint32_t out_len = static_cast<uint32_t>(1 + payload);
+          std::memcpy(out.data(), &out_len, 4);
+          out[4] = 1;
+          char* p = out.data() + 5;
+          std::memcpy(p, &m, 4);
+          p += 4;
+          for (uint32_t j = 0; j < m; ++j)
+            std::memcpy(p + j * 8, &ids[stale[j]], 8);
+          p += static_cast<size_t>(m) * 8;
+          for (uint32_t j = 0; j < m; ++j)
+            std::memcpy(p + static_cast<int64_t>(j) * dim * 4,
+                        t->value + ids[stale[j]] * dim, dim * 4);
+          p += static_cast<size_t>(m) * dim * 4;
+          for (uint32_t j = 0; j < m; ++j)
+            std::memcpy(p + j * 8, &t->versions[ids[stale[j]]], 8);
+        }
+      }
+      if (!ok) {
+        if (!send_reject()) break;
+        continue;
+      }
+      if (!write_all(fd, out.data(), out.size())) break;
+      continue;
+    }
     if (ok) {
       // the WHOLE request — shape reads, bounds validation, scatter,
       // gather — runs under the table mutex: an in-place re-register
@@ -211,8 +282,10 @@ void serve_conn(Van* van, int fd) {
           reinterpret_cast<const float*>(body.data() + ids_bytes);
       if (op == kPush || op == kPushPull)
         ok = ids_bytes + row_bytes == body_len;
-      else
+      else if (op == kPull)
         ok = ids_bytes == body_len;
+      else
+        ok = false;        // unknown op: reject, don't silently ack
       // a pull response must itself fit the u32-length frame protocol:
       // reject oversized gathers up front (n is client-controlled and a
       // pull frame carries only ids, so row_bytes is unbounded by len)

@@ -469,15 +469,37 @@ class PSClient:
                            max_worker, wait_time)
 
     # ---------------- cache sync ---------------- #
+    # The HET verbs ride the van too (r5): sync_embedding is op 4 on
+    # the C++ tier; push_embedding is a push on an accumulate-mode
+    # table.  push_sync_embedding decomposes into the two frames — the
+    # python server also takes the param lock once per half, so the
+    # interleaving semantics are identical.
 
     def sync_embedding(self, key, ids, stored_versions, bound):
-        return self.t.call("sync_embedding", key, ids, stored_versions, bound)
+        route = self._van_route(key)
+        if route is not None:
+            cli, kid = route
+            try:
+                return cli.sync_embedding(kid, ids, stored_versions,
+                                          bound)
+            except (OSError, ConnectionError):
+                self._van_drop()    # pure read: safe fallback
+            except RuntimeError:
+                pass                # rejected (e.g. no versions)
+        return self.t.call("sync_embedding", key, ids, stored_versions,
+                           bound)
 
     def push_embedding(self, key, ids, rows):
-        return self.t.call("push_embedding", key, ids, rows)
+        # server-side push_embedding IS sparse_push (accumulate on an
+        # optimizer-less table); reuse its van route + fallback contract
+        return self.sparse_push(key, ids, rows)
 
     def push_sync_embedding(self, key, ids, rows, sync_ids, stored_versions,
                             bound):
+        if self._van_route(key) is not None:
+            self.push_embedding(key, ids, rows)
+            return self.sync_embedding(key, sync_ids, stored_versions,
+                                       bound)
         return self.t.call("push_sync_embedding", key, ids, rows, sync_ids,
                            stored_versions, bound)
 

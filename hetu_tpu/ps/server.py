@@ -385,9 +385,12 @@ class PSServer:
     @staticmethod
     def _van_qualifies(p):
         """The van serves 2-D float32 buffers whose server optimizer it
-        can apply in-kernel (the whole SERVER_OPTIMIZERS family)."""
-        return (isinstance(p.optimizer, (ServerSGD, ServerMomentum,
-                                         ServerAdaGrad, ServerAdam))
+        can apply in-kernel: the whole SERVER_OPTIMIZERS family, plus
+        optimizer-less tables (accumulate mode — the HET cache
+        write-back path, which also gets the sync_embedding verb)."""
+        return ((p.optimizer is None
+                 or isinstance(p.optimizer, (ServerSGD, ServerMomentum,
+                                             ServerAdaGrad, ServerAdam)))
                 and p.value.ndim == 2 and p.value.dtype == np.float32)
 
     def _serve_van_locked(self, keys=None, port=0):
@@ -420,9 +423,9 @@ class PSServer:
             p = self.params[k]
             if not self._van_qualifies(p):
                 raise ValueError(
-                    f"van can only serve 2-D float32 tables with a "
-                    f"server optimizer from the SGD family; {k!r} is "
-                    f"{p.value.dtype}/{p.value.ndim}-D with "
+                    f"van can only serve 2-D float32 tables (optimizer "
+                    f"from the SGD family, or none = accumulate); "
+                    f"{k!r} is {p.value.dtype}/{p.value.ndim}-D with "
                     f"{type(p.optimizer).__name__}")
             kid = len(self._van_keys)
             # the registered (contiguous) arrays ARE the served

@@ -31,7 +31,7 @@ import numpy as np
 
 from ..native import build_and_load
 
-_OP_PUSH, _OP_PULL, _OP_PUSHPULL = 1, 2, 3
+_OP_PUSH, _OP_PULL, _OP_PUSHPULL, _OP_SYNCEMB = 1, 2, 3, 4
 _HDR = struct.Struct("<BII")          # op, key, n  (little-endian)
 _LEN = struct.Struct("<I")
 
@@ -138,7 +138,9 @@ class NativeVan:
 
         kind, hp1, hp2, eps, nesterov = 0, 0.0, 0.0, 0.0, 0
         s1 = s2 = step = None
-        if type(optimizer) is ServerSGD:
+        if optimizer is None:
+            kind = 4        # accumulate (the HET cache write-back mode)
+        elif type(optimizer) is ServerSGD:
             kind = 0
         elif isinstance(optimizer, ServerMomentum):   # incl. Nesterov
             kind, hp1 = 1, optimizer.momentum
@@ -168,8 +170,8 @@ class NativeVan:
         self._l.van_register_table(
             self._h, int(key), value.ctypes.data_as(f32p),
             value.shape[0], value.shape[1], kind,
-            float(optimizer.lr), float(hp1), float(hp2), float(eps),
-            nesterov,
+            float(optimizer.lr) if optimizer is not None else 0.0,
+            float(hp1), float(hp2), float(eps), nesterov,
             s1.ctypes.data_as(f32p) if s1 is not None else None,
             s2.ctypes.data_as(f32p) if s2 is not None else None,
             step.ctypes.data_as(i64p) if step is not None else None,
@@ -257,6 +259,30 @@ class VanClient:
             rest = b"".join(bytes(p) for p in parts)   # rare path
             self._sock.sendall(rest[sent:])
 
+    def _exchange(self, parts, maybe_applied_on_recv, reject_msg):
+        """One frame out, one frame back.  Socket failures surface as
+        VanTransportError; ``maybe_applied_on_recv`` says whether a
+        failure while awaiting the response can mean the server already
+        applied the request (pushes) or not (pure reads).  Returns the
+        response payload past the ok byte."""
+        total = sum(len(p) for p in parts)
+        sent_all = False
+        try:
+            # scatter-gather send: no join copy of the multi-MB payload
+            self._send_frame([_LEN.pack(total)] + parts)
+            sent_all = True
+            (m,) = _LEN.unpack(self._recv_exact(4))
+            payload = self._recv_exact(m)
+        except (OSError, ConnectionError) as e:
+            raise VanTransportError(
+                f"van round-trip failed while "
+                f"{'awaiting the response' if sent_all else 'sending'}"
+                f": {type(e).__name__}: {e}",
+                maybe_applied=sent_all and maybe_applied_on_recv) from e
+        if payload[0] != 1:
+            raise RuntimeError(reject_msg)
+        return payload
+
     def _roundtrip(self, op, key, ids, rows, want_rows):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         n = len(ids)
@@ -267,25 +293,10 @@ class VanClient:
             rows = np.ascontiguousarray(rows, np.float32)
             rows = rows.reshape(n, -1 if self.dim is None else self.dim)
             parts.append(memoryview(rows).cast("B"))
-        total = sum(len(p) for p in parts)
-        sent_all = False
-        try:
-            # scatter-gather send: no join copy of the multi-MB payload
-            self._send_frame([_LEN.pack(total)] + parts)
-            sent_all = True
-            out_len = self._recv_exact(4)
-            (m,) = _LEN.unpack(out_len)
-            payload = self._recv_exact(m)
-        except (OSError, ConnectionError) as e:
-            raise VanTransportError(
-                f"van round-trip failed while "
-                f"{'awaiting the response' if sent_all else 'sending'}"
-                f": {type(e).__name__}: {e}",
-                maybe_applied=sent_all) from e
-        if payload[0] != 1:
-            raise RuntimeError(
-                "van rejected the request (unknown key, id out of "
-                "range, or malformed frame)")
+        payload = self._exchange(
+            parts, maybe_applied_on_recv=op != _OP_PULL,
+            reject_msg="van rejected the request (unknown key, id out "
+                       "of range, or malformed frame)")
         if want_rows:
             if n == 0:       # reshape(0, -1) is a numpy error; width
                 return np.zeros((0, self.dim or 0), np.float32)
@@ -303,6 +314,37 @@ class VanClient:
                 raise ConnectionError("van closed the connection")
             got += r
         return bytes(buf)
+
+    def sync_embedding(self, key, ids, stored_versions, bound):
+        """HET cache sync (server sync_embedding semantics): returns
+        (stale_ids, rows, server_versions) for rows whose server
+        version exceeds the stored one by more than ``bound``."""
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        stored = np.ascontiguousarray(stored_versions,
+                                      np.int64).reshape(-1)
+        assert len(stored) == len(ids)
+        n = len(ids)
+        parts = [_HDR.pack(_OP_SYNCEMB, key, n),
+                 memoryview(ids).cast("B"), memoryview(stored).cast("B"),
+                 struct.pack("<q", int(bound))]
+        payload = self._exchange(
+            parts, maybe_applied_on_recv=False,   # sync is a pure read
+            reject_msg="van rejected sync_embedding (unknown key, no "
+                       "version counters, id out of range, or "
+                       "oversize response)")
+        (m,) = _LEN.unpack(payload[1:5])
+        off = 5
+        stale_ids = np.frombuffer(payload, np.int64, count=m,
+                                  offset=off).copy()
+        off += m * 8
+        row_bytes = len(payload) - off - m * 8
+        dim = row_bytes // (4 * m) if m else (self.dim or 0)
+        rows = np.frombuffer(payload, np.float32, count=m * dim,
+                             offset=off).reshape(m, dim).copy()
+        off += m * dim * 4
+        versions = np.frombuffer(payload, np.int64, count=m,
+                                 offset=off).copy()
+        return stale_ids, rows, versions
 
     def push(self, key, ids, grads):
         self._roundtrip(_OP_PUSH, key, ids, grads, want_rows=False)

@@ -264,21 +264,23 @@ class TestVanServerIntegration:
             PSServer._instance = None
 
     def test_unservable_table_rejected(self):
-        """Optimizer-less (raw accumulate) tables stay python-tier; the
-        van serves only the server-optimizer family it can apply."""
+        """Tables the van cannot serve (non-2-D) stay python-tier;
+        r5 widened the family to include optimizer-less (accumulate)
+        2-D tables, so the non-qualifying example is a 1-D vector."""
         from hetu_tpu.ps.server import PSServer
         from hetu_tpu.ps.van import van_available
         if not van_available():
             pytest.skip("no C++ toolchain")
         PSServer._instance = None
         srv = PSServer.get()
-        srv.param_init("raw", (8, 2), "constant", 0.0, opt=None)
+        srv.param_init("vec", (8,), "constant", 0.0, opt="sgd",
+                       opt_args={"learning_rate": 0.1})
         try:
             with pytest.raises(ValueError):
-                srv.serve_van(["raw"])
+                srv.serve_van(["vec"])
             # auto-selection simply skips non-qualifying tables
             port, keymap = srv.serve_van()
-            assert "raw" not in keymap
+            assert "vec" not in keymap
         finally:
             srv.shutdown()
             PSServer._instance = None
@@ -374,10 +376,10 @@ def test_van_served_keys_refuse_buffer_replacement():
         np.testing.assert_allclose(
             srv.sparse_pull("k", np.arange(8)), 7.0)
         assert "k" in srv._van_keys
-        # a respec the van cannot serve (no optimizer) stays refused —
-        # it would silently detach the fast tier
+        # a respec the van cannot serve (1-D) stays refused — it would
+        # silently detach the fast tier
         with pytest.raises(ValueError):
-            srv.param_set("k", np.ones((8, 2), np.float32))
+            srv.param_set("k", np.ones(8, np.float32))
         with pytest.raises(ValueError):
             srv.param_clear("k")
         # the in-place path stays open (checkpoint restore)
@@ -458,15 +460,16 @@ def test_van_autoserve_and_discovery_over_tcp():
         # created AFTER autoserve was enabled -> auto-registered
         c.parameter_init("auto", (16, 4), "constant", 0.0, opt="sgd",
                          opt_args={"learning_rate": 1.0})
-        # r5: the full optimizer family autoserves; only tables the van
-        # cannot apply (no optimizer) stay python-tier without error
+        # r5: the full optimizer family + accumulate tables autoserve;
+        # only shapes the van cannot serve (1-D) stay python-tier
         c.parameter_init("adam_t", (8, 2), "constant", 0.0, opt="adam",
                          opt_args={"learning_rate": 0.1})
-        c.parameter_init("raw_t", (8, 2), "constant", 0.0, opt=None)
+        c.parameter_init("vec_t", (8,), "constant", 0.0, opt="sgd",
+                         opt_args={"learning_rate": 0.1})
         got_port, keymap = c.t.call("van_info")
         assert got_port == vport
         assert "auto" in keymap and "adam_t" in keymap
-        assert "raw_t" not in keymap
+        assert "vec_t" not in keymap
         vc = VanClient("127.0.0.1", got_port, dim=4)
         ids = np.arange(8)
         vc.push(keymap["auto"], ids, np.ones((8, 4), np.float32))
@@ -477,3 +480,138 @@ def test_van_autoserve_and_discovery_over_tcp():
         srv.shutdown()
         PSServer._instance = None
         PSClient._instance = None
+
+
+class TestVanCacheSync:
+    """r5: the HET cache verbs ride the C++ tier — sync_embedding is
+    van op 4, push_embedding is a push on an accumulate-mode table
+    (reference: the hetu_cache protocol served by the C++ PS)."""
+
+    def _server(self):
+        from hetu_tpu.ps.server import PSServer
+        PSServer._instance = None
+        srv = PSServer.get()
+        srv.param_init("ct", (16, 4), "constant", 1.0, opt=None)
+        return srv
+
+    def test_sync_embedding_parity_with_python_tier(self):
+        from hetu_tpu.ps.van import VanClient, van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        srv = self._server()
+        try:
+            port, keymap = srv.serve_van(["ct"])
+            cli = VanClient("127.0.0.1", port)
+            # advance versions on rows 2 and 5 through the van
+            cli.push(keymap["ct"], np.array([2, 5, 5]),
+                     np.full((3, 4), 0.5, np.float32))
+            ids = np.arange(8)
+            stored = np.zeros(8, np.int64)
+            want = srv.sync_embedding("ct", ids, stored, 0)
+            got = cli.sync_embedding(keymap["ct"], ids, stored, 0)
+            for w, g in zip(want, got):
+                np.testing.assert_array_equal(np.asarray(g),
+                                              np.asarray(w))
+            # accumulate semantics: duplicate push rows SUMMED onto 1.0
+            np.testing.assert_allclose(got[1][got[0] == 5], 2.0)
+            np.testing.assert_allclose(got[1][got[0] == 2], 1.5)
+            # bound filters rows within staleness tolerance: versions
+            # bump once per unique id per REQUEST, so a second push
+            # takes row 5 to version 2 while row 2 stays at 1
+            cli.push(keymap["ct"], np.array([5]),
+                     np.full((1, 4), 0.5, np.float32))
+            s_ids, _, _ = cli.sync_embedding(keymap["ct"], ids, stored,
+                                             bound=1)
+            assert list(s_ids) == [5]
+            cli.close()
+        finally:
+            srv.shutdown()
+            from hetu_tpu.ps.server import PSServer
+            PSServer._instance = None
+
+    def test_client_routes_cache_verbs_through_van(self):
+        """PSClient.sync_embedding/push_embedding reach the C++ tier
+        when the table is van-served (cstable's hot verbs)."""
+        from hetu_tpu.ps.server import PSServer
+        from hetu_tpu.ps.van import van_available
+        import hetu_tpu.ps.client as psc
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        srv = self._server()
+        psc.PSClient._instance = None
+        try:
+            srv.serve_van(["ct"])
+            c = psc.PSClient()
+            c.push_embedding("ct", np.array([3, 3]),
+                             np.ones((2, 4), np.float32))
+            st = c._van_local.state
+            assert st["cli"] is not None    # the fast tier was used
+            s_ids, rows, vers = c.sync_embedding(
+                "ct", np.arange(16), np.zeros(16, np.int64), 0)
+            assert list(s_ids) == [3]
+            np.testing.assert_allclose(rows, 3.0)   # 1 + 2x1 summed
+            assert list(vers) == [1]        # one bump per unique push
+            c.finalize()
+        finally:
+            srv.shutdown()
+            PSServer._instance = None
+            psc.PSClient._instance = None
+
+    def test_cstable_training_over_van_matches_dense(self):
+        """Full hybrid+cache training with the table van-autoserved:
+        the cstable sync protocol rides the C++ tier and the trajectory
+        still equals the dense run."""
+        import hetu_tpu as ht
+        from hetu_tpu.ps.server import PSServer
+        from hetu_tpu.ps.van import van_available
+        import hetu_tpu.ps.client as psc
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+
+        def build():
+            ids = ht.placeholder_op("ids")
+            y = ht.placeholder_op("y")
+            emb = ht.init.random_normal((50, 8), stddev=0.1,
+                                        name="emb_vc")
+            emb.is_embed = True
+            e = ht.array_reshape_op(ht.embedding_lookup_op(emb, ids),
+                                    [-1, 16])
+            w = ht.init.xavier_uniform((16, 2), name="w_vc")
+            loss = ht.reduce_mean_op(ht.softmaxcrossentropy_op(
+                ht.matmul_op(e, w), y), axes=0)
+            train = ht.optim.SGDOptimizer(
+                learning_rate=0.1).minimize(loss)
+            return ids, y, loss, train
+
+        rng = np.random.RandomState(0)
+        batches = [(rng.randint(0, 50, (16, 2)).astype(np.int32),
+                    np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)])
+                   for _ in range(8)]
+
+        PSServer._instance = None
+        psc.PSClient._instance = None
+        ids, y, loss, train = build()
+        ex1 = ht.Executor({"train": [loss, train]})
+        w0 = ex1.return_tensor_values()
+        base = [float(np.asarray(ex1.run(
+            "train", feed_dict={ids: a, y: c})[0])) for a, c in batches]
+
+        PSServer._instance = None
+        psc.PSClient._instance = None
+        srv = PSServer.get()
+        srv.enable_van_autoserve()
+        try:
+            ids, y, loss, train = build()
+            ex2 = ht.Executor({"train": [loss, train]},
+                              comm_mode="Hybrid", cstable_policy="LRU",
+                              cache_bound=8)
+            ex2.load_dict(w0)
+            tr = [float(np.asarray(ex2.run(
+                "train", feed_dict={ids: a, y: c})[0]))
+                for a, c in batches]
+            np.testing.assert_allclose(tr, base, atol=1e-5)
+            assert "emb_vc" in srv._van_keys
+        finally:
+            srv.shutdown()
+            PSServer._instance = None
+            psc.PSClient._instance = None
