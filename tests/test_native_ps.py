@@ -615,3 +615,99 @@ class TestVanCacheSync:
             srv.shutdown()
             PSServer._instance = None
             psc.PSClient._instance = None
+
+
+class TestVanFallbackContract:
+    """The client's van fallback rules: reads retry anywhere, pushes
+    retry ONLY when the frame never fully left (double-apply safety),
+    and late serve_van is discovered within the refresh window."""
+
+    def _pair(self):
+        from hetu_tpu.ps.server import PSServer
+        import hetu_tpu.ps.client as psc
+        self._reset()
+        srv = PSServer.get()
+        srv.param_init("fb", (8, 2), "constant", 0.0, opt="sgd",
+                       opt_args={"learning_rate": 1.0})
+        return srv, psc.PSClient()
+
+    def test_send_side_failure_falls_back_without_double_apply(self):
+        from hetu_tpu.ps.van import VanTransportError, van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        srv, c = self._pair()
+        try:
+            srv.serve_van(["fb"])
+            ids = np.array([1], np.int64)
+            c.sparse_push("fb", ids, np.ones((1, 2), np.float32))
+            st = c._van_local.state
+            assert st["cli"] is not None
+
+            # send-side failure: NOT applied -> python tier retries,
+            # so the table advances exactly one more step, and the
+            # broken van socket is dropped for this thread
+            def boom(*a, **kw):
+                raise VanTransportError("sim send fail",
+                                        maybe_applied=False)
+            st["cli"].push = boom
+            c.sparse_push("fb", ids, np.ones((1, 2), np.float32))
+            np.testing.assert_allclose(
+                srv.params["fb"].value[1], -2.0)   # exactly 2 steps
+            assert st["cli"] is None and st["dead"]
+        finally:
+            c.finalize()
+            srv.shutdown()
+            self._reset()
+
+    def test_response_side_failure_raises_instead_of_double_apply(self):
+        from hetu_tpu.ps.van import VanTransportError, van_available
+        from hetu_tpu.ps.client import PSConnectionError
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        srv, c = self._pair()
+        try:
+            srv.serve_van(["fb"])
+            ids = np.array([2], np.int64)
+            c.sparse_push("fb", ids, np.ones((1, 2), np.float32))
+            st = c._van_local.state
+            def boom(*a, **kw):
+                raise VanTransportError("sim recv fail",
+                                        maybe_applied=True)
+            st["cli"].push = boom
+            with pytest.raises(PSConnectionError):
+                c.sparse_push("fb", ids, np.ones((1, 2), np.float32))
+            # the update was NOT silently re-applied python-side
+            np.testing.assert_allclose(srv.params["fb"].value[2], -1.0)
+        finally:
+            c.finalize()
+            srv.shutdown()
+            self._reset()
+
+    def test_late_serve_van_discovered_after_refresh_window(self):
+        """Traffic starts python-tier; serve_van afterwards is picked
+        up once the per-thread refresh window elapses."""
+        from hetu_tpu.ps.van import van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        srv, c = self._pair()
+        try:
+            ids = np.array([0], np.int64)
+            c.sparse_push("fb", ids, np.ones((1, 2), np.float32))
+            st = c._van_local.state
+            assert st["cli"] is None          # python tier so far
+            srv.serve_van(["fb"])
+            st["checked_at"] = 0.0            # window elapsed
+            c.sparse_push("fb", ids, np.ones((1, 2), np.float32))
+            assert st["cli"] is not None      # fast tier picked up
+            np.testing.assert_allclose(srv.params["fb"].value[0], -2.0)
+        finally:
+            c.finalize()
+            srv.shutdown()
+            self._reset()
+
+    @staticmethod
+    def _reset():
+        from hetu_tpu.ps.server import PSServer
+        import hetu_tpu.ps.client as psc
+        PSServer._instance = None
+        psc.PSClient._instance = None
