@@ -104,8 +104,13 @@ class TestHeturnTrainEndToEnd:
     TRAINS against the shared PS with a BSP barrier per step; both
     workers' embedding updates land in the one table."""
 
-    @pytest.mark.parametrize("bsp", [0, 1], ids=["bsp", "ssp1"])
-    def test_cluster_yaml_hybrid_training(self, bsp):
+    @pytest.mark.parametrize("bsp,van", [(0, False), (1, False),
+                                         (0, True)],
+                             ids=["bsp", "ssp1", "bsp-van"])
+    def test_cluster_yaml_hybrid_training(self, bsp, van):
+        from hetu_tpu.ps.van import van_available
+        if van and not van_available():
+            pytest.skip("no C++ toolchain")
         from hetu_tpu.launcher import _free_port
         d = tempfile.mkdtemp()
         yml = os.path.join(d, "cluster.yml")
@@ -159,6 +164,14 @@ for _ in range(STEPS):
     out = ex.run("train", feed_dict={ids_node: idb, y: yb})
     losses.append(float(np.asarray(out[0])))
 assert all(np.isfinite(l) for l in losses), losses
+if os.environ.get("HETU_PS_VAN"):
+    # the deployment-shaped proof: the server advertised its C++ van
+    # and this worker's sparse traffic actually opened fast-tier
+    # sockets (phase A/B may run in pool threads; the process-wide
+    # registry sees every one)
+    vport, vkeys = c.t.call("van_info")
+    assert vport and "e2e_table_table" in vkeys, (vport, vkeys)
+    assert len(c._van_clients) > 0
 c.BarrierWorker("trained")
 
 table = np.asarray(c.pull("e2e_table_table"))
@@ -176,7 +189,14 @@ open(os.path.join(OUT, f"trained{rank}"), "w").write(
 """ % (d, bsp))
         port = _free_port()
         env_old = os.environ.get("HETU_PS_PORT")
+        van_old = os.environ.get("HETU_PS_VAN")
         os.environ["HETU_PS_PORT"] = str(port)
+        if van:
+            os.environ["HETU_PS_VAN"] = "1"
+        else:
+            # an ambient HETU_PS_VAN must not leak into the non-van
+            # variants (the launcher copies os.environ into children)
+            os.environ.pop("HETU_PS_VAN", None)
         try:
             code = main(["-c", yml, sys.executable, script])
         finally:
@@ -184,6 +204,10 @@ open(os.path.join(OUT, f"trained{rank}"), "w").write(
                 os.environ.pop("HETU_PS_PORT", None)
             else:
                 os.environ["HETU_PS_PORT"] = env_old
+            if van_old is None:
+                os.environ.pop("HETU_PS_VAN", None)
+            else:
+                os.environ["HETU_PS_VAN"] = van_old
         assert code == 0
         assert os.path.exists(os.path.join(d, "trained0"))
         assert os.path.exists(os.path.join(d, "trained1"))
