@@ -654,29 +654,11 @@ print("PROBE_RESULT " + json.dumps(r))
 
 
 def _persist_artifact(path, art, reduced, has_data):
-    """Shared artifact-persistence policy for the sweep modes: a
+    """Shared artifact-persistence policy (hetu_tpu/artifact.py): a
     reduced/CPU run never overwrites a full-scale TPU record, and an
-    all-error run never overwrites a record that has data.  Sets
-    art['not_written'] when skipped; returns whether it wrote."""
-    existing = None
-    try:
-        with open(path) as f:
-            existing = json.load(f)
-    except (OSError, ValueError):
-        pass
-    if existing is not None:
-        if (not existing.get("reduced_scale")
-                and existing.get("platform") == "tpu" and reduced):
-            art["not_written"] = ("full-scale TPU record already "
-                                  "present; reduced run not persisted")
-            return False
-        if not has_data:
-            art["not_written"] = ("run produced no measured data; "
-                                  "keeping the existing record")
-            return False
-    with open(path, "w") as f:
-        json.dump(art, f, indent=1)
-    return True
+    all-error run never overwrites a record that has data."""
+    from hetu_tpu.artifact import persist_artifact
+    return persist_artifact(path, art, reduced, has_data=has_data)
 
 
 def sweep_ctr_rows(platform, reduced):

@@ -19,7 +19,7 @@ import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(_HERE, '..', '..'))
-sys.path.insert(0, _HERE)   # for the shared `common` helpers
+sys.path.insert(0, _HERE)   # for the shared `gnn_common` helpers
 
 import argparse
 import logging
@@ -27,7 +27,7 @@ import logging
 import numpy as np
 
 import hetu_tpu as ht
-from common import parse_mesh, sbm_graph
+from gnn_common import parse_mesh, sbm_graph
 
 logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
 logger = logging.getLogger("gcn")

@@ -1,0 +1,46 @@
+"""Shared persistence discipline for measurement artifacts.
+
+One rule, applied by bench.py's sweep modes AND the planner's chip
+calibration: a degraded run (reduced scale, or not on real TPU) never
+overwrites a full-scale TPU record, and a run that produced no data
+never overwrites a record that has some.  Centralized here so the two
+consumers cannot drift (review r5: chip_calibration's hand copy had
+already lost the reduced-scale half).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def persist_artifact(path, art, reduced, has_data=True):
+    """Write ``art`` (a JSON-able dict) to ``path`` unless doing so
+    would degrade the record:
+
+    * ``reduced`` runs (small shapes, or a non-TPU backend) never
+      replace an existing full-scale TPU record;
+    * an all-error run (``has_data=False``) never replaces a record
+      that has data.
+
+    When skipped, sets ``art['not_written']`` with the reason and
+    returns False; otherwise writes and returns True.
+    """
+    existing = None
+    try:
+        with open(path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if isinstance(existing, dict):
+        if (not existing.get("reduced_scale")
+                and existing.get("platform") == "tpu" and reduced):
+            art["not_written"] = ("full-scale TPU record already "
+                                  "present; reduced run not persisted")
+            return False
+        if not has_data:
+            art["not_written"] = ("run produced no measured data; "
+                                  "keeping the existing record")
+            return False
+    with open(path, "w") as f:
+        json.dump(art, f, indent=1)
+    return True

@@ -429,9 +429,35 @@ def load_calibration(path=CALIBRATION_FILE, n_devices=None):
 
 
 def main():
-    art = calibrate_chip(small=bool(os.environ.get("HETU_CALIB_SMALL")))
-    with open(CALIBRATION_FILE, "w") as f:
-        json.dump(art, f, indent=1)
+    from ..artifact import persist_artifact
+    small = bool(os.environ.get("HETU_CALIB_SMALL"))
+    # cheap pre-check: a degraded run (small probes, or not on real
+    # TPU) that would be refused anyway must not burn minutes of
+    # matmul sweeps first
+    reduced_now = small or jax.default_backend() != "tpu"
+    try:
+        with open(CALIBRATION_FILE) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        existing = None
+    if (isinstance(existing, dict) and reduced_now
+            and not existing.get("reduced_scale")
+            and existing.get("platform") == "tpu"):
+        print(json.dumps({
+            "platform": jax.default_backend(), "small": small,
+            "not_written": "full-scale TPU calibration record already "
+                           "present; degraded run skipped"}))
+        return
+    art = calibrate_chip(small=small)
+    # degraded = small probes or a non-TPU backend; either must never
+    # clobber a full-scale TPU calibration record (shared discipline
+    # with bench.py's sweep artifacts)
+    art["reduced_scale"] = small or art.get("platform") != "tpu"
+    if not persist_artifact(CALIBRATION_FILE, art,
+                            reduced=art["reduced_scale"]):
+        print(json.dumps({"platform": art["platform"],
+                          "not_written": art["not_written"]}))
+        return
     print(json.dumps({"platform": art["platform"],
                       "device_kind": art["device_kind"],
                       "peak_tflops": round(
