@@ -1063,8 +1063,26 @@ def sweep_bert(platform, reduced, batches=(16, 32, 48, 64)):
     return art
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: the on-chip suite invokes
+    bench.py ~10 times with overlapping configs, and each TPU compile
+    costs 20-40s through the tunnel — sharing compiled programs across
+    invocations shrinks the recovery-window cost substantially.
+    HETU_BENCH_NO_COMPILE_CACHE=1 opts out."""
+    if os.environ.get("HETU_BENCH_NO_COMPILE_CACHE"):
+        return
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("HETU_COMPILE_CACHE_DIR",
+                                         "/tmp/hetu_xla_cache"))
+    except Exception:
+        pass          # older jax without the knob: run uncached
+
+
 def main():
     platform, bringup_err = _bring_up_backend()
+    _enable_compile_cache()
     reduced = bool(os.environ.get("HETU_BENCH_SMALL")) or \
         platform in ("cpu", "cpu-fallback")
 
