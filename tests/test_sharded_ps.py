@@ -168,3 +168,49 @@ class TestReviewRegressions:
             [1, 5, 0], np.int64))
         # pushes applied (sgd lr=1: -=1), pulls see post-push values
         np.testing.assert_allclose(out, [[0, 0], [-1, -1], [-1, -1]])
+
+
+class TestShardedVan:
+    """r5: van routing composes with row sharding — each home PSClient
+    discovers ITS server's van and routes that shard's traffic through
+    it; results must equal the python-tier sharded run."""
+
+    def test_sharded_group_with_vans_matches_python_tier(self):
+        from hetu_tpu.ps.van import van_available
+        if not van_available():
+            pytest.skip("no C++ toolchain")
+        rng = np.random.RandomState(3)
+        table = rng.randn(12, 4).astype(np.float32)
+        ids = np.array([2, 7, 7, 0, 5, 11], np.int64)
+        rows = rng.randn(6, 4).astype(np.float32)
+
+        # python-tier reference result
+        servers_py, c_py = _group(2)
+        c_py.param_set("t", table, opt="sgd",
+                       opt_args={"learning_rate": 0.5})
+        want = c_py.sd_pushpull("t", ids, rows)
+
+        # van-enabled group: every shard's table autoserves (inside
+        # the try: a failing second enable must still shut down the
+        # first server's bound van)
+        servers_v, c_v = _group(2)
+        try:
+            for s in servers_v:
+                s.enable_van_autoserve()
+            c_v.param_set("t", table, opt="sgd",
+                          opt_args={"learning_rate": 0.5})
+            got = c_v.sd_pushpull("t", ids, rows)
+            np.testing.assert_allclose(got, np.asarray(want),
+                                       rtol=1e-6, atol=1e-6)
+            # both shards really serve their half from the van, and
+            # EVERY home client opened a fast-tier socket (the ids
+            # route traffic to both shards — a single silent python-
+            # tier fallback is exactly the regression under test)
+            assert all(s._van_keys for s in servers_v)
+            assert all(cl._van_clients for cl in c_v.clients)
+            np.testing.assert_allclose(c_v.pull("t"),
+                                       np.asarray(c_py.pull("t")),
+                                       rtol=1e-6, atol=1e-6)
+        finally:
+            for s in servers_v:
+                s.shutdown()
