@@ -27,8 +27,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["convert_bert", "convert_bert_pretraining_heads",
-           "convert_bert_classifier", "convert_gpt2",
-           "export_bert", "export_gpt2"]
+           "convert_bert_classifier", "convert_bert_qa",
+           "convert_gpt2", "export_bert", "export_gpt2"]
 
 
 def _np(t):
@@ -117,6 +117,17 @@ def convert_bert_classifier(state_dict, name="bert"):
     w, b = _lin(state_dict, "classifier")
     out[f"{name}_classifier_weight"] = w
     out[f"{name}_classifier_bias"] = b
+    return out
+
+
+def convert_bert_qa(state_dict, name="bert"):
+    """HF ``BertForQuestionAnswering`` -> backbone + qa_outputs params
+    (the import path for fine-tuning an HF-pretrained BERT through the
+    SQuAD pipeline — hetu_tpu.squad + BertForQuestionAnswering)."""
+    out = convert_bert(state_dict, name=name, prefix="bert.")
+    w, b = _lin(state_dict, "qa_outputs")
+    out[f"{name}_qa_outputs_weight"] = w
+    out[f"{name}_qa_outputs_bias"] = b
     return out
 
 
