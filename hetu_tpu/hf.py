@@ -28,7 +28,8 @@ import numpy as np
 
 __all__ = ["convert_bert", "convert_bert_pretraining_heads",
            "convert_bert_classifier", "convert_bert_qa",
-           "convert_gpt2", "export_bert", "export_gpt2"]
+           "convert_gpt2", "export_bert", "export_bert_classifier",
+           "export_bert_qa", "export_gpt2"]
 
 
 def _np(t):
@@ -226,6 +227,31 @@ def export_bert(params, name="bert", prefix=""):
             transpose=True)
         put("pooler.dense.bias", p["pooler_dense_bias"])
     return out
+
+
+def _export_bert_with_head(params, name, head_param, hf_head):
+    """Backbone under ``bert.`` + one Linear head — the shared shape of
+    the classifier/QA exporters (exact inverses of their importers)."""
+    out = export_bert(params, name=name, prefix="bert.")
+    w = np.asarray(params[f"{name}_{head_param}_weight"])
+    b = np.asarray(params[f"{name}_{head_param}_bias"])
+    out[f"{hf_head}.weight"] = _t(w.T)
+    out[f"{hf_head}.bias"] = _t(b)
+    return out
+
+
+def export_bert_classifier(params, name="bert"):
+    """Our fine-tuned classifier -> HF ``BertForSequenceClassification``
+    state_dict (serve a GLUE model from transformers)."""
+    return _export_bert_with_head(params, name, "classifier",
+                                  "classifier")
+
+
+def export_bert_qa(params, name="bert"):
+    """Our fine-tuned span head -> HF ``BertForQuestionAnswering``
+    state_dict (serve a SQuAD model from transformers)."""
+    return _export_bert_with_head(params, name, "qa_outputs",
+                                  "qa_outputs")
 
 
 def export_gpt2(params, name="gpt", prefix=""):

@@ -89,7 +89,8 @@ class BertMoELayer:
             gate=self.gate, experts=experts, num_tokens=tokens,
             embed_dim=c.hidden_size, hierarchical=c.hierarchical_a2a,
             top=c.top_k, name="MoELayer")
-        self.out_ln = layers.LayerNorm(c.hidden_size, name=name + "_out_ln")
+        self.out_ln = layers.LayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                                       name=name + "_out_ln")
 
     def __call__(self, hidden, attention_mask=None, kv_lens=None):
         c = self.config

@@ -221,6 +221,26 @@ class TestBertClassifierImport:
         assert np.mean(losses[-10:]) < np.mean(losses[:10]), (
             losses[:5], losses[-5:])
 
+        # r5: the fine-tuned classifier exports back — re-import is
+        # bit-exact and torch serves the trained model
+        ours = ex.return_tensor_values()
+        sd = ht.hf.export_bert_classifier(ours, name="hfc")
+        back_params = ht.hf.convert_bert_classifier(sd, name="hfc")
+        for k, v in ours.items():
+            np.testing.assert_array_equal(np.asarray(back_params[k]),
+                                          np.asarray(v), err_msg=k)
+        missing, unexpected = hf.load_state_dict(sd, strict=False)
+        assert not unexpected, unexpected
+        with torch.no_grad():
+            back = hf(input_ids=torch.tensor(iv),
+                      token_type_ids=torch.tensor(
+                          tv.astype(np.int64))).logits.numpy()
+        ours_logits = ex.run("eval", feed_dict={
+            ids: iv.astype(np.int32), tt: tv.astype(np.int32),
+            mask: np.ones((4, 16), np.float32)},
+            convert_to_numpy_ret_vals=True)[0]
+        np.testing.assert_allclose(back, ours_logits, atol=2e-2)
+
 
 class TestExportToHF:
     """The reverse trip: OUR parameters load into transformers and
@@ -324,3 +344,26 @@ class TestQAImport:
                 sp: spans_s, ep: spans_e})
             losses.append(float(np.asarray(out[0])))
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        # ...and the TRAINED span head exports back.  The export is an
+        # exact inverse (re-importing reproduces our arrays bit-for-
+        # bit); the forward comparison is looser because the tiny
+        # gelu_new/LN implementation deltas (3e-6 at init) are
+        # amplified by 60 Adam steps' weight growth.
+        ours = ex.return_tensor_values()
+        sd = ht.hf.export_bert_qa(ours, name="hfq")
+        back_params = ht.hf.convert_bert_qa(sd, name="hfq")
+        for k, v in ours.items():
+            np.testing.assert_array_equal(np.asarray(back_params[k]),
+                                          np.asarray(v), err_msg=k)
+        missing, unexpected = hf.load_state_dict(sd, strict=False)
+        assert not unexpected, unexpected
+        with torch.no_grad():
+            back = hf(input_ids=torch.tensor(iv),
+                      token_type_ids=torch.tensor(tv.astype(np.int64)))
+        ours_s, ours_e = ex.run("eval", feed_dict=feed,
+                                convert_to_numpy_ret_vals=True)
+        np.testing.assert_allclose(back.start_logits.numpy(), ours_s,
+                                   atol=2e-2)
+        np.testing.assert_allclose(back.end_logits.numpy(), ours_e,
+                                   atol=2e-2)
