@@ -939,12 +939,13 @@ def bench_decode(platform, reduced):
     rng = np.random.RandomState(0)
     prompts = rng.randint(0, vocab, (batch, 16)).astype(np.int32)
 
+    from hetu_tpu.models.gpt_decode import _prep_param
+    import jax.numpy as jnp
+
     def run(dtype):
         # params are cast/placed ONCE outside the timed window (the
         # bf16 variant must not pay the ~500MB f32->bf16 cast inside
         # its measurement; per-call prep is then a no-op)
-        from hetu_tpu.models.gpt_decode import _prep_param
-        import jax.numpy as jnp
         dt_ = jnp.float32 if dtype is None else dtype
         prepped = {k: _prep_param(v, dt_)
                    for k, v in ex.var_values.items()}
@@ -960,7 +961,6 @@ def bench_decode(platform, reduced):
     tps_f32, dt_f32 = run(None)
     # bf16 variant: half the weights AND the KV cache, MXU fast path
     # (the serving configuration of record on TPU)
-    import jax.numpy as jnp
     tps_bf16, dt_bf16 = run(jnp.bfloat16)
     best = max(tps_f32, tps_bf16)
     art = {

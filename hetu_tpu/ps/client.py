@@ -26,6 +26,7 @@ import numpy as np
 from . import wire
 
 from .server import PSServer, _send_msg, _recv_msg
+from .van import VanClient, VanTransportError
 
 
 class PSConnectionError(ConnectionError):
@@ -321,7 +322,6 @@ class PSClient:
         if st["port"] is None:
             return None
         if st["cli"] is None:
-            from .van import VanClient
             host = getattr(self.t, "host", "127.0.0.1")
             try:
                 st["cli"] = VanClient(
@@ -390,7 +390,6 @@ class PSClient:
         return self._sparse_push_sync(key, ids, rows)
 
     def _sparse_push_sync(self, key, ids, rows):
-        from .van import VanTransportError
         route = self._van_route(key) if ids.size else None
         if route is not None:
             cli, kid = route
@@ -411,7 +410,6 @@ class PSClient:
         return self._sd_pushpull_sync(key, ids, rows, pull_ids)
 
     def _sd_pushpull_sync(self, key, ids, rows, pull_ids):
-        from .van import VanTransportError
         # pull-only shards (sharded CTR hot path) still route: the van
         # accepts a zero-id push, and the python tier's sd_pushpull
         # always pushes — a shared Adam table's step counter must
