@@ -1201,16 +1201,18 @@ def main():
             results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
         matrix["configs"] = results
         try:
-            with open(_MATRIX_FILE, "w") as f:
-                json.dump(matrix, f, indent=1)
+            # atomic: a stage timeout mid-dump must not truncate the
+            # matrix of record (later runs would discard + overwrite)
+            from hetu_tpu.artifact import atomic_json_dump
+            atomic_json_dump(_MATRIX_FILE, matrix)
         except OSError:
             pass
     matrix["configs"] = results
 
     if platform == "tpu" and not reduced:
         try:
-            with open(_TPU_LAST_FILE, "w") as f:
-                json.dump(matrix, f, indent=1)
+            from hetu_tpu.artifact import atomic_json_dump
+            atomic_json_dump(_TPU_LAST_FILE, matrix)
         except OSError:
             pass
 

@@ -11,10 +11,11 @@ LOG=${1:-/tmp/onchip_$(date -u +%H%M)}
 mkdir -p "$LOG"
 echo "logging to $LOG"
 
-run() {  # name, env..., -- handled by eval of the remainder
-  local name=$1; shift
-  echo "=== $name: $* ==="
-  (time "$@") >"$LOG/$name.log" 2>&1
+run() {  # name, timeout_s, cmd... — a re-wedged tunnel mid-stage must
+  local name=$1; shift       # cost ONE stage, not the whole recovery
+  local budget=$1; shift     # window (every stage is rerunnable)
+  echo "=== $name (<=${budget}s): $* ==="
+  (time timeout -k 60 "$budget" "$@") >"$LOG/$name.log" 2>&1
   local rc=$?
   tail -2 "$LOG/$name.log"
   echo "=== $name rc=$rc ==="
@@ -22,30 +23,30 @@ run() {  # name, env..., -- handled by eval of the remainder
 
 # 1. full matrix under honest accounting (bert_base probes pick the
 #    batch; pin with HETU_BENCH_BERT_BATCH=32 if probes misbehave)
-run matrix python bench.py
+run matrix 7200 python bench.py
 
 # 2. the (batch x attention x head) ablation sweep + planner validation
-HETU_BENCH_SWEEP=1 run sweep python bench.py
+HETU_BENCH_SWEEP=1 run sweep 5400 python bench.py
 
 # 3. max embedding rows per chip (1M..256M ladder)
-HETU_BENCH_CTR_ROWS=1 run ctr_rows python bench.py
+HETU_BENCH_CTR_ROWS=1 run ctr_rows 5400 python bench.py
 
 # 4. refresh the chip calibration artifact (raw + clamped curves)
-run calibration python -m hetu_tpu.planner.chip_calibration
+run calibration 3600 python -m hetu_tpu.planner.chip_calibration
 
 # 4b. KV-cached serving throughput (BENCH_DECODE.json)
-HETU_BENCH_DECODE=1 run decode python bench.py
+HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 
 # 5. long-context tile tuning: A/B a couple of block shapes at 32k
 for blocks in "512,1024" "1024,1024" "1024,2048" "512,2048"; do
   HETU_BENCH_LC_BLOCKS=$blocks HETU_BENCH_CONFIGS=long_context \
-    run "lc_${blocks/,/x}" python bench.py
+    run "lc_${blocks/,/x}" 2700 python bench.py
 done
 
 # 6. MoE chip-fill A/B (the recorded config underfilled the chip)
 for tok in 1024 2048 4096; do
   HETU_BENCH_MOE_TOKENS=$tok HETU_BENCH_CONFIGS=moe \
-    run "moe_t${tok}" python bench.py
+    run "moe_t${tok}" 2700 python bench.py
 done
 
 # NOTE: stages 5/6 leave the LAST A/B variant in BENCH_MATRIX.json —

@@ -11,6 +11,27 @@ already lost the reduced-scale half).
 from __future__ import annotations
 
 import json
+import os
+import tempfile
+
+
+def atomic_json_dump(path, obj, indent=1):
+    """Write JSON via a same-directory temp file + os.replace: a
+    process killed mid-write (the suite's per-stage timeouts SIGTERM
+    bench.py wherever it is) must never leave a truncated record that
+    a later run silently discards and overwrites."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, indent=indent)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def persist_artifact(path, art, reduced, has_data=True):
@@ -41,6 +62,5 @@ def persist_artifact(path, art, reduced, has_data=True):
             art["not_written"] = ("run produced no measured data; "
                                   "keeping the existing record")
             return False
-    with open(path, "w") as f:
-        json.dump(art, f, indent=1)
+    atomic_json_dump(path, art)
     return True

@@ -186,8 +186,8 @@ def main():
                 for kv in args.axes.split(",")}
     art = profile_env(axes, size_mb=args.size_mb,
                       compute_dim=args.compute_dim)
-    with open(args.out, "w") as f:
-        json.dump(art, f, indent=1)
+    from ..artifact import atomic_json_dump
+    atomic_json_dump(args.out, art)
     print(json.dumps({
         "platform": art["platform"],
         "matmul_tflops_bf16": art["matmul_tflops_bf16"],
