@@ -505,7 +505,11 @@ def bench_resnet18(platform, reduced):
                             lambda out: float(np.asarray(out[0])))
     return {
         "value": round(batch / dt_dev / n_chips, 2),
-        "unit": "samples/sec/chip",
+        # the unit names the path: this row's value is ~12x the old
+        # end-to-end record on the tunnel-fed host link, and a bare
+        # "samples/sec/chip" would read as a measurement jump rather
+        # than a metric change (the fed-path number is loader_value)
+        "unit": "samples/sec/chip (device-resident input)",
         "input_path": "device-resident (chip capability; see loader_*)",
         "step_time_ms": round(dt_dev * 1e3, 3),
         "loader_value": round(batch / dt_loader / n_chips, 2),
@@ -1199,6 +1203,11 @@ def main():
             results[name] = _CONFIGS[name](platform, reduced)
         except Exception as e:
             results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        # per-row stamp: merge keeps rows from older runs/platforms, so
+        # the top-level measured_at says nothing about THIS row (the
+        # tpu_watchdog's fresh-capture check keys on bert_base's own)
+        results[name]["measured_at"] = time.strftime(
+            "%Y-%m-%d %H:%M UTC", time.gmtime())
         matrix["configs"] = results
         try:
             # atomic: a stage timeout mid-dump must not truncate the

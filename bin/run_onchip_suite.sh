@@ -7,6 +7,15 @@
 #   bash bin/run_onchip_suite.sh [logdir]
 set -u
 cd "$(dirname "$0")/.."
+# one suite at a time: manual runs and the watchdog (bin/tpu_watchdog.sh)
+# share this lock — two concurrent batteries would interleave matrix
+# writes and contend for the single chip
+exec 9>.tpu_watchdog.lock
+if ! flock -n 9; then
+  echo "another on-chip suite holds .tpu_watchdog.lock — refusing to" \
+       "run concurrently" >&2
+  exit 1
+fi
 LOG=${1:-/tmp/onchip_$(date -u +%H%M)}
 mkdir -p "$LOG"
 echo "logging to $LOG"

@@ -1,0 +1,98 @@
+#!/bin/bash
+# TPU-recovery watchdog: probe the backend periodically and fire the
+# on-chip measurement battery (bin/run_onchip_suite.sh) unattended on
+# the first successful probe.  Exists because two rounds of tunnel
+# outage were missed for want of someone watching (VERDICT r4 item 1):
+# a recovery window mid-outage must trigger capture automatically.
+#
+#   nohup bash bin/tpu_watchdog.sh [interval_s] [logdir] &
+#
+# Idempotent/safe: run_onchip_suite.sh itself holds a flock on
+# .tpu_watchdog.lock, so watchdog-fired and manual suite runs are
+# serialized at the one place that matters; a completed capture writes
+# .tpu_watchdog.done and the watchdog exits.  Remove the .done file to
+# arm it again.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${1:-600}
+LOGDIR=${2:-/tmp/onchip_watchdog}
+# each firing is a multi-hour battery on the one chip: if validation
+# keeps failing (e.g. the bert_base stage errors on-chip), stop after a
+# few attempts instead of monopolizing the chip forever
+MAX_FIRES=${MAX_FIRES:-3}
+LOCK=.tpu_watchdog.lock
+DONE=.tpu_watchdog.done
+mkdir -p "$LOGDIR"
+fires=0
+
+probe() {
+  # a wedged tunnel HANGS rather than erroring — bound the probe hard.
+  # The device_kind read forces a real backend round-trip, not just
+  # plugin discovery.
+  timeout -k 10 120 python - <<'EOF' >/dev/null 2>&1
+import jax
+d = jax.devices()[0]
+assert d.platform == "tpu", d.platform
+_ = d.device_kind
+EOF
+}
+
+echo "watchdog: probing every ${INTERVAL}s (logs: $LOGDIR)"
+START_TS=$(date +%s)
+while true; do
+  if [ -f "$DONE" ]; then
+    echo "watchdog: capture already recorded ($DONE) — exiting"
+    exit 0
+  fi
+  if probe; then
+    echo "watchdog: backend up at $(date -u +%FT%TZ) — firing suite"
+    # the suite itself holds the one flock ($LOCK): a manual run in
+    # progress makes it refuse (rc=1) and we just re-probe later
+    bash bin/run_onchip_suite.sh "$LOGDIR/suite_$(date -u +%m%d_%H%M)"
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      # only count it as a capture if the FULL-MATRIX stage really
+      # measured on-chip after we started: run() swallows stage rcs and
+      # the suite's trailing A/B stages rewrite the matrix last, so
+      # platform/mtime alone would also bless a run whose matrix stage
+      # died at its timeout while a later single-config stage touched
+      # the chip (that false .done would disarm the watchdog forever,
+      # re-creating the missed-window failure this script prevents)
+      if [ "$(stat -c %Y BENCH_MATRIX.json 2>/dev/null || echo 0)" \
+           -gt "$START_TS" ] && START_TS="$START_TS" python - <<'EOF'
+import json, os, sys
+from datetime import datetime, timezone
+m = json.load(open("BENCH_MATRIX.json"))
+bert = m.get("configs", {}).get("bert_base", {})
+# judge the bert ROW only — its own stamp, device_kind, and scale.
+# bench.py merge-preserves rows from older runs, and trailing subset
+# stages rewrite top-level platform last-writer-wins, so neither the
+# top-level measured_at nor platform says anything about this row
+measured = datetime.strptime(
+    bert.get("measured_at", "1970-01-01 00:00 UTC"), "%Y-%m-%d %H:%M %Z"
+).replace(tzinfo=timezone.utc).timestamp()
+ok = ("error" not in bert and bert.get("value")
+      and bert.get("device_kind", "").startswith("TPU")
+      and not bert.get("reduced_scale")
+      and measured >= float(os.environ["START_TS"]) - 60)
+sys.exit(0 if ok else 1)
+EOF
+      then
+        date -u +%FT%TZ > "$DONE"
+        echo "watchdog: tpu matrix captured — done"
+        exit 0
+      fi
+      echo "watchdog: suite ran but matrix lacks a fresh on-chip" \
+           "bert_base row; re-arming"
+    fi
+    if [ "$rc" -ne 1 ]; then   # rc=1 = lock refusal, not an attempt
+      fires=$((fires + 1))
+      if [ "$fires" -ge "$MAX_FIRES" ]; then
+        echo "watchdog: $fires suite firings without a validated" \
+             "capture — giving up (read $LOGDIR, fix, restart)" >&2
+        exit 2
+      fi
+    fi
+  fi
+  sleep "$INTERVAL"
+done
