@@ -1196,6 +1196,17 @@ def main():
     matrix["platform"] = platform
     matrix["measured_at"] = time.strftime("%Y-%m-%d %H:%M UTC",
                                           time.gmtime())
+    # this note DESCRIBES the current accounting; it must not be
+    # merge-carried from an older file whose rows it was written about
+    # (per-row measured_at is the provenance for any one entry)
+    matrix["accounting_note"] = (
+        "MFU = 6*P*T/peak over matmul-participating weights only "
+        "(12*H^2/layer + the H*V tied head counted once) plus the "
+        "attention score/context matmuls; embedding gathers, LayerNorm, "
+        "biases and softmax-xent are excluded from the numerator. Rows "
+        "carry their own measured_at: subset runs (HETU_BENCH_CONFIGS) "
+        "merge-preserve other rows, so entries may predate the "
+        "top-level measured_at.")
     if bringup_err:
         matrix["bringup_retried"] = bringup_err
     for name in names:

@@ -30,6 +30,13 @@ run() {  # name, timeout_s, cmd... — a re-wedged tunnel mid-stage must
   echo "=== $name rc=$rc ==="
 }
 
+# 0. the rows a mid-capture wedge has previously cost us: the Aug-2
+#    recovery window measured bert_base/bert4l/gpt/resnet18 fresh, then
+#    the tunnel wedged INSIDE ctr_hybrid — so a fresh window banks the
+#    still-stale rows first, before the long full-matrix pass
+run matrix_gap 3600 env HETU_BENCH_CONFIGS=ctr_hybrid,moe,long_context \
+    python bench.py
+
 # 1. full matrix under honest accounting (bert_base probes pick the
 #    batch; pin with HETU_BENCH_BERT_BATCH=32 if probes misbehave)
 run matrix 7200 python bench.py
@@ -58,7 +65,19 @@ for tok in 1024 2048 4096; do
     run "moe_t${tok}" 2700 python bench.py
 done
 
-# NOTE: stages 5/6 leave the LAST A/B variant in BENCH_MATRIX.json —
+# 7. bert4l attention A/B: the Aug-2 fresh row (630/s, flash OFF via
+#    the seq>=1024 crossover) is 3x below the Jul-30 record (1987/s,
+#    flash ON at seq 128) — decide whether the crossover heuristic is
+#    wrong for short sequences.  The winner's flash setting should be
+#    folded back into _bench_lm's use_flash rule.  The hypothesized
+#    winner (flash) runs LAST so an unattended pass leaves the
+#    likely-best row in the matrix, not the suspected loser.
+HETU_BENCH_FORCE_FLASH=0 HETU_BENCH_CONFIGS=bert4l \
+  run bert4l_noflash 2700 python bench.py
+HETU_BENCH_FORCE_FLASH=1 HETU_BENCH_CONFIGS=bert4l \
+  run bert4l_flash 2700 python bench.py
+
+# NOTE: stages 5/6/7 leave the LAST A/B variant in BENCH_MATRIX.json —
 # read the logs, then re-run the winning setting (its env + the config
 # name) so the matrix records the best measured configuration.
 

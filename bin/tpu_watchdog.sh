@@ -37,37 +37,22 @@ _ = d.device_kind
 EOF
 }
 
-echo "watchdog: probing every ${INTERVAL}s (logs: $LOGDIR)"
-START_TS=$(date +%s)
-while true; do
-  if [ -f "$DONE" ]; then
-    echo "watchdog: capture already recorded ($DONE) — exiting"
-    exit 0
-  fi
-  if probe; then
-    echo "watchdog: backend up at $(date -u +%FT%TZ) — firing suite"
-    # the suite itself holds the one flock ($LOCK): a manual run in
-    # progress makes it refuse (rc=1) and we just re-probe later
-    bash bin/run_onchip_suite.sh "$LOGDIR/suite_$(date -u +%m%d_%H%M)"
-    rc=$?
-    if [ "$rc" -eq 0 ]; then
-      # only count it as a capture if the FULL-MATRIX stage really
-      # measured on-chip after we started: run() swallows stage rcs and
-      # the suite's trailing A/B stages rewrite the matrix last, so
-      # platform/mtime alone would also bless a run whose matrix stage
-      # died at its timeout while a later single-config stage touched
-      # the chip (that false .done would disarm the watchdog forever,
-      # re-creating the missed-window failure this script prevents)
-      if [ "$(stat -c %Y BENCH_MATRIX.json 2>/dev/null || echo 0)" \
-           -gt "$START_TS" ] && START_TS="$START_TS" python - <<'EOF'
+# A validated capture = the bert_base ROW was freshly measured on-chip
+# at full scale since this watchdog started.  Judge the row only — its
+# own stamp, device_kind, and scale: bench.py merge-preserves rows from
+# older runs, and trailing subset stages rewrite top-level platform and
+# measured_at last-writer-wins, so the top-level fields say nothing
+# about this row.  Checked BEFORE firing too, so a manual suite run
+# that already banked a fresh capture disarms the watchdog instead of
+# triggering a redundant multi-hour battery.
+validated() {
+  [ "$(stat -c %Y BENCH_MATRIX.json 2>/dev/null || echo 0)" \
+    -gt "$START_TS" ] || return 1
+  START_TS="$START_TS" python - <<'EOF'
 import json, os, sys
 from datetime import datetime, timezone
 m = json.load(open("BENCH_MATRIX.json"))
 bert = m.get("configs", {}).get("bert_base", {})
-# judge the bert ROW only — its own stamp, device_kind, and scale.
-# bench.py merge-preserves rows from older runs, and trailing subset
-# stages rewrite top-level platform last-writer-wins, so neither the
-# top-level measured_at nor platform says anything about this row
 measured = datetime.strptime(
     bert.get("measured_at", "1970-01-01 00:00 UTC"), "%Y-%m-%d %H:%M %Z"
 ).replace(tzinfo=timezone.utc).timestamp()
@@ -77,7 +62,32 @@ ok = ("error" not in bert and bert.get("value")
       and measured >= float(os.environ["START_TS"]) - 60)
 sys.exit(0 if ok else 1)
 EOF
-      then
+}
+
+echo "watchdog: probing every ${INTERVAL}s (logs: $LOGDIR)"
+START_TS=$(date +%s)
+while true; do
+  if [ -f "$DONE" ]; then
+    echo "watchdog: capture already recorded ($DONE) — exiting"
+    exit 0
+  fi
+  if validated; then
+    date -u +%FT%TZ > "$DONE"
+    echo "watchdog: fresh on-chip capture already in the matrix — done"
+    exit 0
+  fi
+  if probe; then
+    echo "watchdog: backend up at $(date -u +%FT%TZ) — firing suite"
+    # the suite itself holds the one flock ($LOCK): a manual run in
+    # progress makes it refuse (rc=1) and we just re-probe later
+    bash bin/run_onchip_suite.sh "$LOGDIR/suite_$(date -u +%m%d_%H%M)"
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      # run() swallows stage rcs, so suite rc=0 means only "the script
+      # finished" — validated() decides whether the capture is real (a
+      # false .done would disarm the watchdog forever, re-creating the
+      # missed-window failure this script prevents)
+      if validated; then
         date -u +%FT%TZ > "$DONE"
         echo "watchdog: tpu matrix captured — done"
         exit 0
