@@ -332,6 +332,26 @@ def bench_bert_base(platform, reduced):
         probes[b] = float(got) if isinstance(got, (int, float)) else got
     numeric = {b: v for b, v in probes.items()
                if isinstance(v, (int, float))}
+    # re-probe implausible outliers once: a tunnel hiccup inside a
+    # 3-iter probe yields a reading several-fold low (observed Aug 2:
+    # batch 48 at 64.6 samples/s against 216/223 neighbors), which
+    # would silently veto that batch.  Uses the same shared deadline,
+    # so a spent budget skips the retry.
+    if len(numeric) >= 2:
+        top = max(numeric.values())
+        for b, v in sorted(numeric.items()):
+            if v < 0.5 * top:
+                got = _run_probe(
+                    _PROBE_LM_SRC.format(platform=platform, b=b),
+                    deadline)
+                if isinstance(got, (int, float)):
+                    # keep the better reading; record the first so the
+                    # artifact shows the retry happened.  A skipped or
+                    # failed retry records nothing — the key's presence
+                    # means "a second probe ran".
+                    if got > v:
+                        probes[b] = numeric[b] = float(got)
+                    probes[f"{b}_first_reading"] = v
     if platform == "tpu" and not numeric:
         # every probe failed — likely the tunnel is wedged (or another
         # config initialized the TPU in-process first; main() orders
