@@ -14,7 +14,10 @@ exec 9>.tpu_watchdog.lock
 if ! flock -n 9; then
   echo "another on-chip suite holds .tpu_watchdog.lock — refusing to" \
        "run concurrently" >&2
-  exit 1
+  # distinctive code (EX_TEMPFAIL): the watchdog must distinguish "lock
+  # held, not an attempt" from a genuine early failure (exit 1), which
+  # MUST count toward its MAX_FIRES retry cap
+  exit 75
 fi
 LOG=${1:-/tmp/onchip_$(date -u +%H%M)}
 mkdir -p "$LOG"

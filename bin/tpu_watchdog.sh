@@ -21,7 +21,10 @@ LOGDIR=${2:-/tmp/onchip_watchdog}
 # few attempts instead of monopolizing the chip forever
 MAX_FIRES=${MAX_FIRES:-3}
 LOCK=.tpu_watchdog.lock
-DONE=.tpu_watchdog.done
+# DONE_FILE / SUITE_CMD / PROBE_CMD are overridable for the scripted
+# self-test (tests/test_bin_tools.py) — production runs use defaults
+DONE=${DONE_FILE:-.tpu_watchdog.done}
+SUITE=${SUITE_CMD:-bash bin/run_onchip_suite.sh}
 mkdir -p "$LOGDIR"
 fires=0
 
@@ -29,6 +32,7 @@ probe() {
   # a wedged tunnel HANGS rather than erroring — bound the probe hard.
   # The device_kind read forces a real backend round-trip, not just
   # plugin discovery.
+  if [ -n "${PROBE_CMD:-}" ]; then eval "$PROBE_CMD"; return $?; fi
   timeout -k 10 120 python - <<'EOF' >/dev/null 2>&1
 import jax
 d = jax.devices()[0]
@@ -79,8 +83,8 @@ while true; do
   if probe; then
     echo "watchdog: backend up at $(date -u +%FT%TZ) — firing suite"
     # the suite itself holds the one flock ($LOCK): a manual run in
-    # progress makes it refuse (rc=1) and we just re-probe later
-    bash bin/run_onchip_suite.sh "$LOGDIR/suite_$(date -u +%m%d_%H%M)"
+    # progress makes it refuse (rc=75) and we just re-probe later
+    $SUITE "$LOGDIR/suite_$(date -u +%m%d_%H%M)"
     rc=$?
     if [ "$rc" -eq 0 ]; then
       # run() swallows stage rcs, so suite rc=0 means only "the script
@@ -95,7 +99,11 @@ while true; do
       echo "watchdog: suite ran but matrix lacks a fresh on-chip" \
            "bert_base row; re-arming"
     fi
-    if [ "$rc" -ne 1 ]; then   # rc=1 = lock refusal, not an attempt
+    # ONLY the suite's distinctive flock-refusal code (75) is "not an
+    # attempt"; any other nonzero (including a genuine early exit-1,
+    # e.g. a set -u abort) must count toward MAX_FIRES or the watchdog
+    # would re-fire the multi-hour battery forever
+    if [ "$rc" -ne 75 ]; then
       fires=$((fires + 1))
       if [ "$fires" -ge "$MAX_FIRES" ]; then
         echo "watchdog: $fires suite firings without a validated" \

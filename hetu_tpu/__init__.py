@@ -23,6 +23,10 @@ if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
     import jax as _jax
     _jax.config.update("jax_platforms", "cpu")
 
+from ._compat import ensure_jax_compat as _ensure_jax_compat
+
+_ensure_jax_compat()
+
 from .context import (
     DLContext, DeviceGroup, DistConfig, context, get_current_context,
     cpu, gpu, tpu, rcpu, rgpu, rtpu, is_gpu_ctx, check_worker,

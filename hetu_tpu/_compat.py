@@ -1,0 +1,87 @@
+"""Version-compat shims for the jax API surface this package targets.
+
+The codebase is written against jax >= 0.5/0.6 where:
+
+- ``shard_map`` is a top-level export (``from jax import shard_map``)
+  taking ``axis_names=`` (the manual axes) and ``check_vma=``;
+- ``jax.lax.pcast(x, axes, to="varying")`` marks replicated values as
+  device-varying under the vma tracker;
+- ``jax.lax.axis_size(name)`` reads a mapped axis' static size.
+
+Older runtimes (this image ships 0.4.x) carry the same machinery under
+pre-promotion names: ``jax.experimental.shard_map.shard_map`` with
+``auto=`` (the complement of ``axis_names``) and ``check_rep=``, no vma
+tracking at all (so the ``to="varying"`` cast is the identity), and the
+static axis size via ``jax.core.axis_frame``.  Publishing the new names
+once keeps every call site (package, tests, examples) working on both
+sides of the promotion without per-site guards.
+
+Idempotent and import-order safe: call it before any module that does
+``from jax import shard_map`` executes (hetu_tpu/__init__.py and
+tests/conftest.py both do).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+
+def _adapt_shard_map(sm):
+    """Old-signature shard_map -> new-API kwargs (axis_names/check_vma)."""
+    params = inspect.signature(sm).parameters
+    if "axis_names" in params:        # already the new API
+        return sm
+
+    @functools.wraps(sm)
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, check_rep=None,
+                  auto=None, **kw):
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        if auto is None and axis_names is not None:
+            # new API names the MANUAL axes; old API names the AUTO ones
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto is not None:
+            kw["auto"] = auto
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_rep, **kw)
+    return shard_map
+
+
+def ensure_jax_compat():
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map
+        jax.shard_map = _adapt_shard_map(shard_map)
+
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axes, *, to=None):
+            # pre-vma runtimes track no varying-ness: the cast is purely
+            # a type-system annotation there, so identity is exact
+            del axes, to
+            return x
+        jax.lax.pcast = pcast
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(name):
+            import jax.core as core
+            size = core.axis_frame(name)
+            return getattr(size, "size", size)   # int on 0.4.x
+        jax.lax.axis_size = axis_size
+
+    return jax
+
+
+def enable_cpu_collectives():
+    """Multi-process CPU meshes: newer jax routes cross-process CPU
+    collectives automatically; 0.4.x needs the gloo implementation
+    selected before ``jax.distributed.initialize``.  No-op where the
+    option no longer exists."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, KeyError, ValueError):
+        pass

@@ -311,6 +311,22 @@ class PythonCache:
                 "evictions": self.evictions}
 
 
+def merge_sparse(ids_a, rows_a, ids_b, rows_b):
+    """Merge two (ids, rows) sparse delta sets, summing duplicate ids
+    (scatter-add semantics — write-back deltas commute, so an outage
+    replay buffer can keep merging new pushes into itself without
+    growing per step).  Returns sorted unique ids + merged float32 rows.
+    Used by CacheSparseTable's PS-outage push backlog."""
+    ids = np.concatenate([np.asarray(ids_a, np.int64).reshape(-1),
+                          np.asarray(ids_b, np.int64).reshape(-1)])
+    rows = np.concatenate([np.asarray(rows_a, np.float32),
+                           np.asarray(rows_b, np.float32)])
+    uniq, inv = np.unique(ids, return_inverse=True)
+    merged = np.zeros((len(uniq), rows.shape[1]), np.float32)
+    np.add.at(merged, inv, rows)
+    return uniq, merged
+
+
 def EmbeddingCache(limit, width, policy="LRU", prefer_native=True):
     """Factory: native C++ cache when buildable, Python mirror otherwise."""
     if prefer_native and NativeCache.load_lib() is not None:

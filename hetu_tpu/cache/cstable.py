@@ -19,16 +19,27 @@ Protocol here (same semantics, TPU-shaped):
 
 Async variants return concurrent.futures so the next batch's lookup can
 overlap the current step (reference prefetch + CSEvent, stream.py:90-105).
+
+Graceful degradation during a PS outage (the comm raising
+ConnectionError / PSConnectionError): cached lines keep being served
+within a bounded staleness window, rows that cannot be fetched are
+served as zero vectors (the standard missing-embedding fallback — NOT
+inserted, so they re-fetch after recovery), and pushes accumulate into
+a bounded replay backlog that drains on the next successful PS contact.
+The bounds: HETU_CACHE_MAX_STALE consecutive failed RPCs (default 100)
+or HETU_CACHE_BACKLOG_ROWS buffered rows (default 100000), after which
+the outage surfaces to the caller instead of degrading further.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from .cache import EmbeddingCache
+from .cache import EmbeddingCache, merge_sparse
 
 
 class CacheSparseTable:
@@ -55,6 +66,71 @@ class CacheSparseTable:
         self.num_pulled_rows = 0
         self.num_pushed_rows = 0
         self.num_synced_rows = 0
+        # outage degradation state (module docstring)
+        self.max_stale = int(os.environ.get("HETU_CACHE_MAX_STALE",
+                                            "100"))
+        self.max_backlog_rows = int(os.environ.get(
+            "HETU_CACHE_BACKLOG_ROWS", "100000"))
+        self._outage = 0            # consecutive failed PS RPCs
+        self._backlog = (np.zeros(0, np.int64),
+                         np.zeros((0, self.width), np.float32))
+        self.num_ps_failures = 0
+        self.num_stale_served = 0
+        self.num_zero_served = 0
+        self.num_replayed_rows = 0
+
+    # ---------------- outage machinery ---------------- #
+
+    def _outage_tick(self, err):
+        """Count one failed PS RPC; degrade silently within the budget,
+        surface the outage once past it."""
+        self._outage += 1
+        self.num_ps_failures += 1
+        if self._outage > self.max_stale:
+            raise ConnectionError(
+                f"PS outage for table {self.key!r} exceeded the "
+                f"staleness budget (HETU_CACHE_MAX_STALE="
+                f"{self.max_stale} consecutive failed RPCs; "
+                f"{len(self._backlog[0])} rows buffered); last error: "
+                f"{err}") from err
+
+    def _replay(self):
+        """Drain the push backlog on (re-)contact; no-op while empty."""
+        bids, bgrads = self._backlog
+        if bids.size == 0 or self.comm is None:
+            return
+        try:
+            self.comm.push_embedding(self.key, bids, bgrads)
+        except ConnectionError as e:
+            self._outage_tick(e)
+            return
+        self._backlog = (np.zeros(0, np.int64),
+                         np.zeros((0, self.width), np.float32))
+        self.num_replayed_rows += len(bids)
+        self.num_pushed_rows += len(bids)
+        self._outage = 0
+
+    def _push_or_buffer(self, ids, grads):
+        """push_embedding with outage buffering: deltas that cannot
+        reach the PS merge into the bounded backlog for replay."""
+        if len(ids) == 0:
+            return
+        self._replay()
+        if self._backlog[0].size == 0:
+            try:
+                self.comm.push_embedding(self.key, ids, grads)
+                self.num_pushed_rows += len(ids)
+                self._outage = 0
+                return
+            except ConnectionError as e:
+                self._outage_tick(e)
+        bids, bgrads = merge_sparse(*self._backlog, ids, grads)
+        if len(bids) > self.max_backlog_rows:
+            raise ConnectionError(
+                f"PS outage push backlog for table {self.key!r} "
+                f"exceeded HETU_CACHE_BACKLOG_ROWS="
+                f"{self.max_backlog_rows} ({len(bids)} rows)")
+        self._backlog = (bids, bgrads)
 
     # ------------------------------------------------------------------ #
 
@@ -77,13 +153,23 @@ class CacheSparseTable:
         # unpushed updates (read-your-writes); they re-sync right after
         # their flush (reference orders this with push_sync_embedding).
         if hit.any() and self.comm is not None:
+            self._replay()
             hit_ids = uniq[hit]
             clean = ~self.cache.dirty(hit_ids)
             sync_ids = hit_ids[clean]
             if len(sync_ids):
                 stored_v = self.cache.versions(sync_ids)
-                s_ids, s_rows, s_vers = self.comm.sync_embedding(
-                    self.key, sync_ids, stored_v, self.pull_bound)
+                try:
+                    s_ids, s_rows, s_vers = self.comm.sync_embedding(
+                        self.key, sync_ids, stored_v, self.pull_bound)
+                except ConnectionError as e:
+                    # outage: the cached copies ARE the answer (stale
+                    # within the budget)
+                    self._outage_tick(e)
+                    self.num_stale_served += len(sync_ids)
+                    s_ids = ()
+                else:
+                    self._outage = 0
                 if len(s_ids):
                     self.cache.refresh(s_ids, s_rows, s_vers)
                     self.num_synced_rows += len(s_ids)
@@ -95,13 +181,20 @@ class CacheSparseTable:
         miss_ids = uniq[~hit]
         if len(miss_ids):
             assert self.comm is not None, "cache miss with no PS attached"
-            pulled, vers = self._fetch_rows(miss_ids)
-            ev_ids, ev_grads = self.cache.insert(miss_ids, pulled, vers)
-            if len(ev_ids):
-                self.comm.push_embedding(self.key, ev_ids, ev_grads)
-                self.num_pushed_rows += len(ev_ids)
-            self.num_pulled_rows += len(miss_ids)
-            rows[~hit] = pulled
+            try:
+                pulled, vers = self._fetch_rows(miss_ids)
+            except ConnectionError as e:
+                # outage: serve zero vectors (missing-embedding
+                # fallback), do NOT insert — they re-fetch on recovery
+                self._outage_tick(e)
+                self.num_zero_served += len(miss_ids)
+            else:
+                self._outage = 0
+                ev_ids, ev_grads = self.cache.insert(miss_ids, pulled,
+                                                     vers)
+                self._push_or_buffer(ev_ids, ev_grads)
+                self.num_pulled_rows += len(miss_ids)
+                rows[~hit] = pulled
 
         return rows[inv].reshape(*shape, self.width)
 
@@ -126,11 +219,9 @@ class CacheSparseTable:
         missed = self.cache.update(uniq, merged)
         if missed and self.comm is not None:
             # uncached ids (version query leaves policy state untouched):
-            # push straight through to the PS
+            # push straight through to the PS (buffered during outage)
             cold_mask = self.cache.versions(uniq) == -1
-            self.comm.push_embedding(self.key, uniq[cold_mask],
-                                     merged[cold_mask])
-            self.num_pushed_rows += int(cold_mask.sum())
+            self._push_or_buffer(uniq[cold_mask], merged[cold_mask])
         if self.comm is not None and \
                 self.cache.max_updates() > self.push_bound:
             self.flush()
@@ -143,14 +234,16 @@ class CacheSparseTable:
 
     def flush(self):
         """Push all dirty lines to the PS.  No-op without a PS (draining
-        the accumulators with nowhere to send them would lose updates)."""
+        the accumulators with nowhere to send them would lose updates).
+        During an outage the collected deltas land in the replay
+        backlog instead of being lost."""
         if self.comm is None:
             return
         with self._lock:
+            self._replay()
             ids, grads = self.cache.collect_dirty()
             if len(ids):
-                self.comm.push_embedding(self.key, ids, grads)
-                self.num_pushed_rows += len(ids)
+                self._push_or_buffer(ids, grads)
 
     # async variants (reference wait_t futures, python_api.cc:76);
     # safe to overlap with the sync methods — everything serializes on
@@ -195,4 +288,10 @@ class CacheSparseTable:
             "synced_rows": self.num_synced_rows,
             "evictions": c["evictions"],
             "cache_size": self.cache.size(),
+            # outage degradation counters
+            "ps_failures": self.num_ps_failures,
+            "stale_served_rows": self.num_stale_served,
+            "zero_served_rows": self.num_zero_served,
+            "replayed_rows": self.num_replayed_rows,
+            "backlog_rows": len(self._backlog[0]),
         }

@@ -594,7 +594,7 @@ class SubExecutor:
                 uniq = ps_ids[var_name]
                 ex.ps_update(var_name, uniq, g[:len(uniq)])
             else:
-                ex.ps_comm.push(var_name, g)
+                ex._ps_push_guarded("dense", var_name, None, g)
                 ex.ps_dense_dirty[var_name] = True
         ex.ps_step_sync()
 
@@ -687,6 +687,10 @@ class Executor:
         self._ps_opt_specs = {}
         self._ssp_inited = False
         self._ps_push_future = None   # pending async phase B (one step)
+        # outage handling for the direct (cache-less) hybrid path:
+        # pushes that cannot reach the PS buffer here and replay on the
+        # next successful contact, bounded by HETU_PS_BACKLOG_STEPS
+        self._ps_push_backlog = []
         if self.config.comm_mode in ("PS", "Hybrid"):
             self._setup_ps(all_nodes)
 
@@ -837,6 +841,14 @@ class Executor:
         ct = self.cstables.get(name)
         if ct is not None:
             return ct.embedding_lookup(ids)
+        if self._ps_push_backlog:
+            # recovery-ordering: buffered pushes must land before the
+            # next read observes the table (replay failure just means
+            # the PS is still down — the read below reports that)
+            try:
+                self._ps_replay_backlog()
+            except ConnectionError:
+                pass
         if self.config.use_sparse_pull:
             flat = ids.reshape(-1).astype(np.int64)
             uniq, inv = np.unique(flat, return_inverse=True)
@@ -874,7 +886,38 @@ class Executor:
             # cache's host re-dedup pass
             ct.embedding_update(flat, -lr * rows, assume_unique=True)
         else:
-            self.ps_comm.sparse_push(name, flat, rows)
+            self._ps_push_guarded("sparse", name, flat, rows)
+
+    def _ps_replay_backlog(self):
+        """Drain pushes buffered during a PS outage (FIFO)."""
+        while self._ps_push_backlog:
+            kind, name, ids, rows = self._ps_push_backlog[0]
+            if kind == "sparse":
+                self.ps_comm.sparse_push(name, ids, rows)
+            else:
+                self.ps_comm.push(name, rows)
+            self._ps_push_backlog.pop(0)
+
+    def _ps_push_guarded(self, kind, name, ids, rows):
+        """Direct-path push with outage buffering: a PS that cannot be
+        reached costs a bounded backlog entry, not the training run.
+        The (client_id, seq) wire dedup makes the eventual replay safe
+        against the retries that preceded the buffering."""
+        from .ps.client import PSConnectionError
+        try:
+            self._ps_replay_backlog()
+            if kind == "sparse":
+                self.ps_comm.sparse_push(name, ids, rows)
+            else:
+                self.ps_comm.push(name, rows)
+        except ConnectionError as e:
+            limit = int(os.environ.get("HETU_PS_BACKLOG_STEPS", "32"))
+            self._ps_push_backlog.append((kind, name, ids, rows))
+            if len(self._ps_push_backlog) > limit:
+                raise PSConnectionError(
+                    f"PS outage: push backlog exceeded "
+                    f"HETU_PS_BACKLOG_STEPS={limit} buffered steps "
+                    f"(last failure: {e})") from e
 
     def ps_step_sync(self):
         """BSP/SSP pacing after each training step (config.bsp)."""
@@ -1262,6 +1305,9 @@ class Executor:
             # StepMetadata -> TreeMetadata -> nested {key: ArrayMetadata}
             tree = getattr(getattr(meta, "item_metadata", meta),
                            "tree", None)
+            if tree is None and isinstance(meta, dict):
+                # older orbax returns the nested metadata tree directly
+                tree = meta
             if tree is None:
                 return None
             tree = dict(tree)
@@ -1289,12 +1335,37 @@ class Executor:
                     lambda m: jax.ShapeDtypeStruct(
                         tuple(m.shape), np.dtype(m.dtype)),
                     tree["dataloaders"])
-            with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-                return ckptr.restore(path, args=ocp.args.PyTreeRestore(
-                    item=t2,
-                    restore_args=ocp.checkpoint_utils
-                    .construct_restore_args(t2),
-                    partial_restore=True))
+            try:
+                with ocp.Checkpointer(
+                        ocp.PyTreeCheckpointHandler()) as ckptr:
+                    return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+                        item=t2,
+                        restore_args=ocp.checkpoint_utils
+                        .construct_restore_args(t2),
+                        partial_restore=True))
+            except Exception:
+                # older orbax has no working partial restore (the
+                # restore_args must cover every on-disk key): widen the
+                # target to the FULL on-disk tree — extras are read and
+                # materialized (the cost partial restore avoids), then
+                # discarded by the callers' key filtering
+                def merge(t, m):
+                    if isinstance(m, dict):
+                        t = t if isinstance(t, dict) else {}
+                        return {k: merge(t.get(k), mv)
+                                for k, mv in m.items()}
+                    if t is not None:
+                        return t
+                    return jax.ShapeDtypeStruct(tuple(m.shape),
+                                                np.dtype(m.dtype))
+
+                t3 = merge(t2, tree)
+                with ocp.Checkpointer(
+                        ocp.PyTreeCheckpointHandler()) as ckptr:
+                    return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+                        item=t3,
+                        restore_args=ocp.checkpoint_utils
+                        .construct_restore_args(t3)))
         except Exception:
             return None
 

@@ -21,6 +21,7 @@ same way hetu_cache_test.py:11-34 uses it).
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import socket
@@ -33,6 +34,11 @@ from .context import DistConfig
 
 _procs: list = []
 DEFAULT_PS_PORT = 23455
+
+# the most recent run_cluster's structured failure/restart event log
+# (worker_exit / worker_restart / ps_server_exit / ps_restart /
+# ps_resynced ... records); also appended as JSONL to $HETU_FAILURE_LOG
+last_failure_events: list = []
 
 
 def _free_port():
@@ -117,6 +123,13 @@ def distributed_init():
     nrank = int(os.environ.get("HETU_NUM_PROCESSES", "1"))
     if nrank <= 1:
         return
+    # pre-0.5 jax needs the gloo CPU-collectives implementation selected
+    # explicitly or multi-process CPU meshes abort with "Multiprocess
+    # computations aren't implemented".  Unconditional: the option only
+    # affects the CPU backend, and probing the backend here would
+    # initialize jax before distributed.initialize (which it forbids).
+    from ._compat import enable_cpu_collectives
+    enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
         num_processes=nrank,
@@ -132,15 +145,67 @@ def _sigint(sig, frame):
     sys.exit(0)
 
 
-def run_cluster(config: DistConfig, command, coordinator_port=6655):
+def _proc_poll(p):
+    """Exit code or None, across subprocess.Popen and mp.Process."""
+    if hasattr(p, "poll"):
+        return p.poll()
+    return None if p.is_alive() else p.exitcode
+
+
+def run_cluster(config: DistConfig, command, coordinator_port=6655,
+                supervise=None):
     """heturun main path: PS process(es) + worker subprocesses running
-    `command` (argv list).  Returns worker exit codes.
+    `command` (argv list), SUPERVISED.  Returns worker exit codes.
 
     Multiple servers get sequential ports (our PS server is one process
     per port, unlike ps-lite's key-sharded server group); workers see the
-    first as HETU_PS_ADDR and the full list as HETU_PS_ADDRS."""
+    first as HETU_PS_ADDR and the full list as HETU_PS_ADDRS.
+
+    The supervisor (default on; ``supervise=False`` or HETU_SUPERVISE=0
+    restores fire-and-wait) watches child exit codes and respawns:
+
+    - a dead PS server is restarted on its port and, when the group is
+      replicated (HETU_PS_REPLICATE=1, >1 server), re-seeded from its
+      ring replica via ``ps.sharded.resync_primary`` before workers
+      route traffic back to it;
+    - a worker exiting nonzero is restarted (the worker script resumes
+      from its latest checkpoint — Executor.save/load persists params,
+      optimizer slots, step, rng and dataloader positions; the child
+      sees HETU_RESTART_COUNT);
+    - each slot has an exponential-backoff restart budget:
+      HETU_RESTART_LIMIT (default 3) restarts, HETU_RESTART_BACKOFF
+      (default 0.5) * 2^attempt seconds apart;
+    - every failure/restart appends a structured record to
+      ``launcher.last_failure_events`` and (JSONL) to
+      $HETU_FAILURE_LOG.
+
+    With HETU_LIVENESS_STALE=<seconds> > 0 the supervisor also polls the
+    rendezvous scheduler's heartbeat map and kills a *wedged* server
+    (process alive, heartbeats stale) so the restart path above takes
+    over — the mid-run wedge class of failure, not just clean exits."""
     signal.signal(signal.SIGINT, _sigint)
     _procs.clear()
+    global last_failure_events
+    events = last_failure_events = []
+    log_path = os.environ.get("HETU_FAILURE_LOG")
+
+    def _event(kind, **fields):
+        rec = {"t": round(time.time(), 3), "event": kind, **fields}
+        events.append(rec)
+        if log_path:
+            try:
+                with open(log_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass
+        print(f"[heturun] {kind}: {fields}", flush=True)
+
+    if supervise is None:
+        supervise = os.environ.get("HETU_SUPERVISE", "1") != "0"
+    restart_limit = int(os.environ.get("HETU_RESTART_LIMIT", "3"))
+    backoff0 = float(os.environ.get("HETU_RESTART_BACKOFF", "0.5"))
+    liveness_stale = float(os.environ.get("HETU_LIVENESS_STALE", "0"))
+
     ps_port = None
     local_names = ("localhost", "127.0.0.1", socket.gethostname())
     # PS lives on the first host that configures servers (NOT necessarily
@@ -148,6 +213,8 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655):
     ps_host = next(iter(config.servers), config.chief or "localhost")
     ps_addrs = []
     sched_addr = None
+    sched_port = None
+    server_slots = []
     if config.enable_PS:
         base_port = int(os.environ.get("HETU_PS_PORT", DEFAULT_PS_PORT))
         # scheduler rendezvous (ps-lite Postoffice role): servers
@@ -167,24 +234,38 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655):
                              f"localhost:{sched_port}"
                              if host in local_names else sched_addr,
                              "HETU_PS_INDEX": str(idx),
-                             "HETU_PS_ADVERTISE": f"{host}:{port}"}
-                idx += 1
+                             "HETU_PS_ADVERTISE": f"{host}:{port}",
+                             "HETU_CHAOS_ROLE": f"server:{idx}"}
                 if host in local_names:
-                    _start_ps_process(port, env_extra)
+                    def spawn(port=port, env_extra=env_extra, restarts=0):
+                        return _start_ps_process(port, dict(
+                            env_extra, HETU_RESTART_COUNT=str(restarts)))
                 else:
-                    _ssh_spawn(host, [
-                        sys.executable, "-m", "hetu_tpu.launcher",
-                        "--serve-ps", str(port)], env=env_extra)
+                    def spawn(host=host, port=port, env_extra=env_extra,
+                              restarts=0):
+                        return _ssh_spawn(host, [
+                            sys.executable, "-m", "hetu_tpu.launcher",
+                            "--serve-ps", str(port)], env=dict(
+                                env_extra,
+                                HETU_RESTART_COUNT=str(restarts)))
+                server_slots.append({
+                    "index": idx, "host": host, "port": port,
+                    "spawn": spawn, "proc": spawn(), "restarts": 0,
+                    "next_at": None})
+                idx += 1
                 ps_addrs.append(f"{host}:{port}")
         ps_host, ps_port = ps_addrs[0].rsplit(":", 1)
         ps_port = int(ps_port)
-        _wait_ps("localhost" if ps_host in local_names else ps_host,
-                 ps_port)
+        for slot in server_slots:
+            _wait_ps("localhost" if slot["host"] in local_names
+                     else slot["host"], slot["port"])
+    replicated = len(ps_addrs) > 1 and os.environ.get(
+        "HETU_PS_REPLICATE", "0").lower() not in ("", "0", "false")
 
     nrank = config.num_workers
     chief = config.chief or "localhost"
     coordinator = f"{chief}:{coordinator_port}" if nrank > 1 else None
-    workers = []
+    worker_slots = []
     rank = 0
     for host, n in config.workers.items():
         for _ in range(n):
@@ -195,16 +276,127 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655):
                 env["HETU_PS_NSERVERS"] = str(len(ps_addrs))
             if sched_addr:
                 env["HETU_SCHEDULER_ADDR"] = sched_addr
-            if host in local_names:
-                p = subprocess.Popen(command, env=env)
-                _procs.append(p)
-            else:
-                p = _ssh_spawn(host, command, env={
+            env["HETU_CHAOS_ROLE"] = f"worker:{rank}"
+
+            def spawn(host=host, env=env, restarts=0):
+                env = dict(env, HETU_RESTART_COUNT=str(restarts))
+                if host in local_names:
+                    p = subprocess.Popen(command, env=env)
+                    _procs.append(p)
+                    return p
+                return _ssh_spawn(host, command, env={
                     k: v for k, v in env.items()
                     if k.startswith(("HETU_", "JAX_"))})
-            workers.append(p)
+            worker_slots.append({
+                "rank": rank, "spawn": spawn, "proc": spawn(),
+                "restarts": 0, "next_at": None, "code": None})
             rank += 1
-    codes = [p.wait() for p in workers]
+
+    def _respawn_server(slot):
+        slot["proc"] = slot["spawn"](restarts=slot["restarts"])
+        try:
+            _wait_ps("localhost" if slot["host"] in local_names
+                     else slot["host"], slot["port"])
+        except TimeoutError as e:
+            _event("ps_restart_failed", index=slot["index"],
+                   error=str(e))
+            return
+        _event("ps_restart", index=slot["index"], port=slot["port"],
+               attempt=slot["restarts"])
+        if replicated:
+            try:
+                from .ps.sharded import resync_primary
+                keys = resync_primary(ps_addrs, slot["index"])
+                _event("ps_resynced", index=slot["index"],
+                       keys=len(keys))
+            except Exception as e:  # noqa: BLE001 — degraded, not fatal
+                _event("ps_resync_failed", index=slot["index"],
+                       error=f"{type(e).__name__}: {e}"[:200])
+
+    def _check_liveness(now, state={"last": 0.0}):
+        """Kill wedged-but-running servers flagged dead by the
+        scheduler's heartbeat map (HETU_LIVENESS_STALE seconds)."""
+        if liveness_stale <= 0 or sched_port is None or \
+                now - state["last"] < max(liveness_stale / 2, 1.0):
+            return
+        state["last"] = now
+        try:
+            from .ps.client import _TCPTransport
+            t = _TCPTransport("localhost", sched_port, timeout=2.0,
+                              connect_timeout=2.0, retries=1)
+            health = t.call("health", liveness_stale)
+            t.close()
+        except Exception:
+            return
+        for slot in server_slots:
+            node = f"server:{slot['index']}"
+            if health.get(node, {}).get("alive", True):
+                continue
+            if _proc_poll(slot["proc"]) is None:
+                _event("ps_wedged_kill", index=slot["index"],
+                       age_s=health[node]["age_s"])
+                try:
+                    (slot["proc"].kill if hasattr(slot["proc"], "kill")
+                     else slot["proc"].terminate)()
+                except Exception:
+                    pass
+
+    if not supervise:
+        codes = [w["proc"].wait() for w in worker_slots]
+    else:
+        while any(w["code"] is None for w in worker_slots):
+            now = time.monotonic()
+            for w in worker_slots:
+                if w["code"] is not None:
+                    continue
+                if w["proc"] is None:          # backoff window
+                    if now >= w["next_at"]:
+                        w["proc"] = w["spawn"](restarts=w["restarts"])
+                        _event("worker_restart", rank=w["rank"],
+                               attempt=w["restarts"])
+                    continue
+                rc = _proc_poll(w["proc"])
+                if rc is None:
+                    continue
+                if rc == 0:
+                    w["code"] = 0
+                    continue
+                _event("worker_exit", rank=w["rank"], rc=rc,
+                       restarts=w["restarts"])
+                if w["restarts"] < restart_limit:
+                    w["restarts"] += 1
+                    backoff = backoff0 * 2 ** (w["restarts"] - 1)
+                    w["proc"], w["next_at"] = None, now + backoff
+                    _event("worker_restart_scheduled", rank=w["rank"],
+                           attempt=w["restarts"],
+                           backoff_s=round(backoff, 3))
+                else:
+                    w["code"] = rc
+                    _event("worker_failed", rank=w["rank"], rc=rc,
+                           restarts=w["restarts"])
+            for slot in server_slots:
+                if slot["proc"] is None:       # backoff window
+                    if now >= slot["next_at"]:
+                        slot["next_at"] = None
+                        _respawn_server(slot)
+                    continue
+                rc = _proc_poll(slot["proc"])
+                if rc is None:
+                    continue
+                _event("ps_server_exit", index=slot["index"], rc=rc,
+                       restarts=slot["restarts"])
+                if slot["restarts"] < restart_limit:
+                    slot["restarts"] += 1
+                    backoff = backoff0 * 2 ** (slot["restarts"] - 1)
+                    slot["proc"], slot["next_at"] = None, now + backoff
+                else:
+                    # terminal: budget spent — workers keep running on
+                    # the replica (or fail with PSConnectionError)
+                    _event("ps_server_dead", index=slot["index"], rc=rc)
+                    slot["proc"], slot["next_at"] = None, float("inf")
+            _check_liveness(now)
+            time.sleep(0.2)
+        codes = [w["code"] for w in worker_slots]
     for p in _procs:
         if hasattr(p, "poll") and p.poll() is None:
             p.terminate()

@@ -34,6 +34,8 @@ _COLLECTIVE_PRIMS = {
     "psum", "psum_invariant", "pmax", "pmin", "pmean", "all_gather",
     "all_gather_invariant", "all_to_all", "ppermute", "reduce_scatter",
     "psum_scatter", "pbroadcast",
+    # pre-0.5 jax spells the shard_map-rewritten psum "psum2"
+    "psum2",
 }
 
 

@@ -99,9 +99,14 @@ class HetuProfiler:
         if compiled is None:
             return None
         try:
-            return compiled.cost_analysis()
+            cost = compiled.cost_analysis()
         except Exception:
             return None
+        # pre-0.5 jax returns a one-element list of per-device dicts;
+        # newer jax returns the dict directly
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        return cost
 
     def memory_analysis(self, name="train"):
         """HBM footprint of the compiled step — the role of the

@@ -6,7 +6,9 @@ stages: in-process server (this round) -> multi-process ZMQ-free TCP server
 """
 
 from .server import PSServer, Scheduler
-from .client import PSClient
+from .client import PSClient, PSConnectionError
 from .sharded import ShardedPSClient
+from .faults import FaultPlan
 
-__all__ = ["PSServer", "Scheduler", "PSClient", "ShardedPSClient"]
+__all__ = ["PSServer", "Scheduler", "PSClient", "PSConnectionError",
+           "ShardedPSClient", "FaultPlan"]

@@ -23,7 +23,7 @@ from concurrent.futures import ThreadPoolExecutor, Future
 
 import numpy as np
 
-from . import wire
+from . import faults, wire
 
 from .server import PSServer, _send_msg, _recv_msg
 from .van import VanClient, VanTransportError
@@ -83,9 +83,24 @@ class _TCPTransport:
         st.seq += 1
         payload = wire.dumps(
             ("__req2__", st.client_id, st.seq, method, args, kwargs))
+        chaos = faults.plan_from_env()
         last_err = None
         for attempt in range(self.retries):
+            # chaos seam (HETU_CHAOS): one decision per ATTEMPT, so an
+            # injected loss exercises exactly the reconnect/resend path
+            # a real one would (the seq is fixed per call — a post-apply
+            # loss makes the server see a true duplicate)
+            fault = chaos.draw(method) if chaos is not None else None
             try:
+                if fault is not None:
+                    if fault.kind == "delay":
+                        time.sleep(fault.seconds)
+                    elif fault.kind == "drop":
+                        raise faults.InjectedFault(
+                            "chaos: request dropped before send")
+                    elif fault.kind == "reset":
+                        raise faults.InjectedFault(
+                            "chaos: connection reset")
                 if st.sock is None:
                     st.sock = self._connect()
                 _send_msg(st.sock, payload)
@@ -96,6 +111,15 @@ class _TCPTransport:
                 if not ok:
                     raise RuntimeError(
                         f"PS server error in {method}: {result}")
+                if fault is not None and fault.kind == "dup":
+                    # the server applied and answered, but the response
+                    # is "lost": the retry resends the SAME seq and the
+                    # server's replay cache must answer without
+                    # re-applying (resender.h parity under test)
+                    raise faults.InjectedFault(
+                        "chaos: response dropped after apply")
+                if fault is not None and fault.kind == "slow":
+                    time.sleep(fault.seconds)
                 return result
             except (OSError, ConnectionError, socket.timeout, EOFError,
                     wire.WireError) as e:
@@ -106,7 +130,10 @@ class _TCPTransport:
                     except OSError:
                         pass
                     st.sock = None
-                if attempt < self.retries - 1:
+                if attempt < self.retries - 1 and \
+                        not isinstance(e, faults.InjectedFault):
+                    # no backoff for synthetic losses: chaos runs model
+                    # packet loss, not congestion
                     time.sleep(min(2.0, 0.2 * (attempt + 1)))
         raise PSConnectionError(
             f"PS request {method!r} to {self.host}:{self.port} failed "
@@ -120,12 +147,37 @@ class _TCPTransport:
             self._local.sock = None
 
 
+def _local_chaos_call(server, method, args, kwargs):
+    """In-process chaos seam shared by every local transport (here and
+    sharded._LocalServerTransport).  There is no socket to resend over,
+    so losses retry immediately; ``dup`` cannot double-apply in-process
+    (a returned response cannot be lost) and degrades to a no-op
+    decision; ``kill`` and the latency kinds behave as on the wire."""
+    chaos = faults.plan_from_env()
+    if chaos is None:
+        return getattr(server, method)(*args, **kwargs)
+    last = None
+    for _ in range(3):
+        fault = chaos.draw(method)
+        if fault.kind == "delay":
+            time.sleep(fault.seconds)
+        elif fault.kind in ("drop", "reset"):
+            last = faults.InjectedFault(f"chaos: {fault.kind} (local)")
+            continue
+        result = getattr(server, method)(*args, **kwargs)
+        if fault.kind == "slow":
+            time.sleep(fault.seconds)
+        return result
+    raise PSConnectionError(
+        f"local PS call {method!r} dropped by chaos 3 times") from last
+
+
 class _LocalTransport:
     def __init__(self):
         self.server = PSServer.get()
 
     def call(self, method, *args, **kwargs):
-        return getattr(self.server, method)(*args, **kwargs)
+        return _local_chaos_call(self.server, method, args, kwargs)
 
     def close(self):
         pass

@@ -30,7 +30,7 @@ import time
 
 import numpy as np
 
-from . import wire
+from . import faults, wire
 
 
 # ----------------------------------------------------------------- #
@@ -297,10 +297,14 @@ class _Param:
     """One stored tensor + optimizer slot state + per-row versions for the
     cache-sync protocol (reference server/param.h Param2D/CacheTable)."""
 
-    def __init__(self, value, optimizer):
+    def __init__(self, value, optimizer, opt_spec=(None, None)):
         self.value = value
         self.optimizer = optimizer
         self.state = optimizer.init_state(value.shape) if optimizer else {}
+        # the (opt_name, opt_args) this param was created with — the
+        # replica-resync path re-creates the table on a restarted
+        # primary from this spec (ps/sharded.py resync_shard)
+        self.opt_spec = opt_spec
         # per-row version counters (only meaningful for 2D tables)
         self.versions = np.zeros(value.shape[0], np.int64) \
             if value.ndim == 2 else None
@@ -513,7 +517,7 @@ class PSServer:
             optimizer = None
             if opt is not None:
                 optimizer = SERVER_OPTIMIZERS[opt](**(opt_args or {}))
-            self.params[key] = _Param(value, optimizer)
+            self.params[key] = _Param(value, optimizer, (opt, opt_args))
             self._van_autoserve_locked(key)
             return True
 
@@ -540,7 +544,7 @@ class PSServer:
                 # load_dict.  A respec the van cannot serve would
                 # silently detach the fast tier, so that stays loud.
                 from .van import VanSharedLock
-                new_p = _Param(value, optimizer)
+                new_p = _Param(value, optimizer, (opt, opt_args))
                 if not self._van_qualifies(new_p):
                     raise ValueError(
                         f"{key!r} is served by the native van and the "
@@ -556,9 +560,16 @@ class PSServer:
                     new_p.lock = VanSharedLock(pylock, self._van, kid)
                     self.params[key] = new_p
                 return True
-            self.params[key] = _Param(value, optimizer)
+            self.params[key] = _Param(value, optimizer, (opt, opt_args))
             self._van_autoserve_locked(key)
             return True
+
+    def param_spec(self, key):
+        """(shape, opt_name, opt_args) a param was created with — lets a
+        failover client or the supervisor rebuild the table elsewhere
+        (replica resync) with identical server-side update semantics."""
+        p = self.params[key]
+        return tuple(p.value.shape), p.opt_spec[0], p.opt_spec[1]
 
     def param_assign(self, key, value):
         """In-place value overwrite that PRESERVES the server-side
@@ -847,6 +858,17 @@ def _serve_object_tcp(obj, port, block=True):
                                 replay.popitem(last=False)
                     else:
                         method, args, kwargs = msg
+                    # server-side chaos seam: a HETU_CHAOS plan with a
+                    # role matching this process can SIGKILL it mid-run
+                    # (the one-shot shard-loss fault) or slow its
+                    # responses; loss kinds stay client-side where the
+                    # resend machinery lives
+                    plan = faults.plan_from_env()
+                    if plan is not None:
+                        f = plan.draw(method,
+                                      kinds=("kill", "slow", "delay"))
+                        if f.kind in ("slow", "delay"):
+                            time.sleep(f.seconds)
                     try:
                         if method.startswith("_"):
                             raise AttributeError(

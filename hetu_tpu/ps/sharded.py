@@ -1,5 +1,5 @@
 """Sharded PS client: one logical parameter server over N server
-processes.
+processes, with optional replica-group failover.
 
 Reference: ps-lite's server GROUP — keys are range-partitioned across
 servers by the Postoffice (ps-lite/include/ps/internal/postoffice.h), so
@@ -20,6 +20,28 @@ Which rows-sharding applies to a key is recorded on server 0
 (``__rows__<key>`` metadata), so a worker that did not create the table
 still routes correctly.
 
+Replication / failover (``HETU_PS_REPLICATE=1`` or ``replicate=True``,
+N > 1 only): every key primaried on server ``s`` keeps a replica under
+``__rep__<key>`` on its ring backup ``(s+1) % N``.  Mutations are
+applied to the primary and then async-replayed (FIFO, one replication
+thread, so stateful server optimizers see the identical update order)
+onto the replica, whose own server-side optimizer instance walks the
+identical trajectory.  When an op on a primary exhausts the transport's
+retry budget (PSConnectionError — the wire's (client_id, seq) replay
+cache makes the retries themselves idempotent), the client marks the
+shard failed and fails over to the backup's replica for reads AND
+writes; the backup is then the authority, so nothing double-applies.  A
+restarted primary must be re-seeded from its replica BEFORE rejoining —
+``resync_shard(s)`` (or the supervisor's ``resync_primary``) copies
+value + optimizer spec back and returns traffic to the primary.
+Caveat: resync re-creates optimizer slot state fresh (exact-trajectory
+equivalence across a failover holds for SGD; stateful optimizers
+converge but do not match bit-for-bit after a resync).
+
+Failovers/resyncs append structured records to ``failure_events``; when
+a rendezvous scheduler is configured its heartbeat map is consulted
+(best-effort) to stamp the event with cluster-level liveness.
+
 ``PSClient.get()`` returns this client automatically when the launcher
 exposes several servers via HETU_PS_ADDRS.
 """
@@ -27,11 +49,21 @@ exposes several servers via HETU_PS_ADDRS.
 from __future__ import annotations
 
 import os
+import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from .client import PSClient, _TCPTransport, _LocalTransport
+from .client import (PSClient, PSConnectionError, _TCPTransport,
+                     _LocalTransport, _local_chaos_call)
+
+REPLICA_PREFIX = "__rep__"
+
+
+def _env_replicate():
+    return os.environ.get("HETU_PS_REPLICATE", "0").lower() \
+        not in ("", "0", "false")
 
 
 class _LocalServerTransport:
@@ -42,14 +74,23 @@ class _LocalServerTransport:
         self.server = server
 
     def call(self, method, *args, **kwargs):
-        return getattr(self.server, method)(*args, **kwargs)
+        return _local_chaos_call(self.server, method, args, kwargs)
 
     def close(self):
         pass
 
 
+def _plain(key):
+    return key
+
+
+def _replica(key):
+    return REPLICA_PREFIX + key
+
+
 class ShardedPSClient:
-    def __init__(self, addrs=None, servers=None, rank=0, nrank=1):
+    def __init__(self, addrs=None, servers=None, rank=0, nrank=1,
+                 replicate=None):
         if servers is not None:
             transports = [_LocalServerTransport(s) for s in servers]
         else:
@@ -67,6 +108,8 @@ class ShardedPSClient:
         self.n = len(self.clients)
         self.rank = rank
         self.nrank = nrank
+        self.replicate = (_env_replicate() if replicate is None
+                          else bool(replicate)) and self.n > 1
         # _pool serves EXTERNAL async submissions (the executor's
         # ps_lookup_async duck-types it); _fan_pool is private to the
         # per-shard fan-out — sharing one pool deadlocks when an external
@@ -75,13 +118,108 @@ class ShardedPSClient:
             max_workers=max(self.n, 2), thread_name_prefix="ps-shard")
         self._fan_pool = ThreadPoolExecutor(
             max_workers=max(self.n, 2), thread_name_prefix="ps-fan")
+        # ONE replication worker: FIFO replay keeps the replica's
+        # (stateful) server optimizer on the primary's update order
+        self._rep_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ps-replica") \
+            if self.replicate else None
         self._row_sharded = {}      # key -> (rows, width) or None
+        self._failed = set()        # shard indices currently failed over
+        self._fail_mu = threading.Lock()
+        self.failure_events = []    # structured failover/resync log
 
     # ------------------------------------------------------------------ #
 
-    def _home(self, key):
+    def _event(self, kind, **fields):
+        rec = {"t": round(time.time(), 3), "event": kind, **fields}
+        self.failure_events.append(rec)
+        print(f"[ps-client] {kind}: {fields}", flush=True)
+
+    def _sched_health(self):
+        """Best-effort scheduler liveness snapshot for event context."""
+        sched = os.environ.get("HETU_SCHEDULER_ADDR")
+        if not sched:
+            return None
+        try:
+            host, port = sched.rsplit(":", 1)
+            t = _TCPTransport(host, int(port), timeout=2.0,
+                              connect_timeout=2.0, retries=1)
+            h = t.call("health")
+            t.close()
+            return {k: v["alive"] for k, v in h.items()}
+        except Exception:
+            return None
+
+    def _backup(self, s):
+        return (s + 1) % self.n
+
+    def _mark_failed(self, s, err):
+        with self._fail_mu:
+            if s in self._failed:
+                return
+            self._failed.add(s)
+        self._event("ps_shard_failover", shard=s, backup=self._backup(s),
+                    error=f"{type(err).__name__}: {err}"[:200],
+                    scheduler_view=self._sched_health())
+
+    def _exec(self, s, op):
+        """Run ``op(client, keymap)`` against shard ``s``'s primary,
+        failing over to the ring backup's replica namespace when the
+        primary is (or becomes) unreachable."""
+        with self._fail_mu:
+            failed = s in self._failed
+        if not failed:
+            try:
+                return op(self.clients[s], _plain)
+            except PSConnectionError as e:
+                if not self.replicate:
+                    raise
+                self._mark_failed(s, e)
+        return op(self.clients[self._backup(s)], _replica)
+
+    def _replicate_op(self, s, op):
+        """Async FIFO replay of a mutation onto shard ``s``'s replica
+        (no-op when the shard is failed over — the backup already took
+        the write directly)."""
+        if not self.replicate:
+            return
+        b = self._backup(s)
+        with self._fail_mu:
+            if s in self._failed:
+                return
+        backup = self.clients[b]
+
+        def run():
+            with self._fail_mu:
+                # the backup HOST is also shard b's primary: if that
+                # shard is already marked dead, don't burn a retry
+                # budget per queued write against a dead socket.  (A
+                # write whose SOURCE shard failed after queueing must
+                # still run: it carries a primary-applied mutation the
+                # now-authoritative replica lacks.)
+                if b in self._failed:
+                    return
+            try:
+                op(backup, _replica)
+            except PSConnectionError as e:
+                self._event("ps_replica_write_failed", shard=s,
+                            backup=b,
+                            error=f"{type(e).__name__}: {e}"[:200])
+                # a dead backup is ALSO a dead primary (same process):
+                # propagate so shard b's traffic fails over promptly
+                self._mark_failed(b, e)
+            except Exception as e:  # noqa: BLE001 — degraded, not fatal
+                self._event("ps_replica_write_failed", shard=s,
+                            backup=b,
+                            error=f"{type(e).__name__}: {e}"[:200])
+        self._rep_pool.submit(run)
+
+    def _home_idx(self, key):
         import zlib
-        return self.clients[zlib.crc32(key.encode()) % self.n]
+        return zlib.crc32(key.encode()) % self.n
+
+    def _home(self, key):
+        return self.clients[self._home_idx(key)]
 
     def _rows_of(self, key):
         meta = self._meta_of(key)
@@ -91,9 +229,12 @@ class ShardedPSClient:
         if key in self._row_sharded:
             return self._row_sharded[key]
         try:
-            arr = np.asarray(self.clients[0].pull("__rows__" + key))
+            arr = np.asarray(self._exec(
+                0, lambda cli, km: cli.pull(km("__rows__" + key))))
             meta = (int(arr[0]), int(arr[1]) if arr.size > 1 else None)
-        except Exception:
+        except PSConnectionError:
+            raise           # a dead, un-replicated server 0 must stay
+        except Exception:   # loud — "no metadata" would misroute keys
             meta = None
         self._row_sharded[key] = meta
         return meta
@@ -108,27 +249,55 @@ class ShardedPSClient:
     def param_set(self, key, value, opt=None, opt_args=None):
         value = np.asarray(value, np.float32)
         if value.ndim == 2 and self.n > 1:
-            self.clients[0].param_set("__rows__" + key,
-                                      np.asarray(value.shape, np.float32))
+            shape_arr = np.asarray(value.shape, np.float32)
+            self._exec(0, lambda cli, km: cli.param_set(
+                km("__rows__" + key), shape_arr))
+            if self.replicate:
+                # synchronous at creation: the replica must exist BEFORE
+                # any failure can route to it (creation is rare; the hot
+                # path replicates async)
+                self.clients[self._backup(0)].param_set(
+                    _replica("__rows__" + key), shape_arr)
             self._row_sharded[key] = (value.shape[0], value.shape[1])
-            self._fan(lambda s: self.clients[s].param_set(
-                key, value[s::self.n], opt=opt, opt_args=opt_args))
+
+            def one(s):
+                self._exec(s, lambda cli, km: cli.param_set(
+                    km(key), value[s::self.n], opt=opt, opt_args=opt_args))
+                if self.replicate:
+                    self.clients[self._backup(s)].param_set(
+                        _replica(key), value[s::self.n], opt=opt,
+                        opt_args=opt_args)
+            self._fan(one)
             return True
         self._row_sharded[key] = None
-        return self._home(key).param_set(key, value, opt=opt,
-                                         opt_args=opt_args)
+        h = self._home_idx(key)
+        out = self._exec(h, lambda cli, km: cli.param_set(
+            km(key), value, opt=opt, opt_args=opt_args))
+        if self.replicate:
+            self.clients[self._backup(h)].param_set(
+                _replica(key), value, opt=opt, opt_args=opt_args)
+        return out
 
     def parameter_init(self, key, shape, **kw):
         # sharded init of 2-D tables is delegated to param_set by the
-        # executor bridge; plain inits route whole
+        # executor bridge; plain inits route whole.  Replication uses
+        # the same deterministic (seeded) init, so replica == primary.
         self._row_sharded[key] = None
-        return self._home(key).parameter_init(key, shape, **kw)
+        h = self._home_idx(key)
+        out = self._exec(h, lambda cli, km: cli.parameter_init(
+            km(key), shape, **kw))
+        if self.replicate:
+            self.clients[self._backup(h)].parameter_init(
+                _replica(key), shape, **kw)
+        return out
 
     def pull(self, key):
         rows = self._rows_of(key)
         if rows is None:
-            return self._home(key).pull(key)
-        parts = self._fan(lambda s: np.asarray(self.clients[s].pull(key)))
+            return self._exec(self._home_idx(key),
+                              lambda cli, km: cli.pull(km(key)))
+        parts = self._fan(lambda s: np.asarray(self._exec(
+            s, lambda cli, km: cli.pull(km(key)))))
         out = np.empty((rows, parts[0].shape[1]), np.float32)
         for s, p in enumerate(parts):
             out[s::self.n] = p
@@ -138,14 +307,23 @@ class ShardedPSClient:
         grad = np.asarray(grad, np.float32)
         rows = self._rows_of(key)
         if rows is None:
-            return self._home(key).push(key, grad)
-        self._fan(lambda s: self.clients[s].push(key, grad[s::self.n]))
+            h = self._home_idx(key)
+            out = self._exec(h, lambda cli, km: cli.push(km(key), grad))
+            self._replicate_op(h, lambda cli, km: cli.push(km(key), grad))
+            return out
+
+        def one(s):
+            part = grad[s::self.n]
+            self._exec(s, lambda cli, km: cli.push(km(key), part))
+            self._replicate_op(s, lambda cli, km: cli.push(km(key), part))
+        self._fan(one)
 
     def sparse_pull(self, key, ids):
         ids = np.asarray(ids, np.int64)
         meta = self._meta_of(key)
         if meta is None:
-            return self._home(key).sparse_pull(key, ids)
+            return self._exec(self._home_idx(key),
+                              lambda cli, km: cli.sparse_pull(km(key), ids))
         if len(ids) == 0:
             return np.empty((0, meta[1] or 0), np.float32)
         shard = ids % self.n
@@ -155,7 +333,9 @@ class ShardedPSClient:
             m = shard == s
             if not m.any():
                 return None
-            return np.asarray(self.clients[s].sparse_pull(key, local[m]))
+            sub = local[m]
+            return np.asarray(self._exec(
+                s, lambda cli, km: cli.sparse_pull(km(key), sub)))
         parts = self._fan(one)
         width = meta[1] or next(p.shape[1] for p in parts
                                 if p is not None)
@@ -169,14 +349,23 @@ class ShardedPSClient:
         ids = np.asarray(ids, np.int64)
         rows_arr = np.asarray(rows_arr, np.float32)
         if self._rows_of(key) is None:
-            return self._home(key).sparse_push(key, ids, rows_arr)
+            h = self._home_idx(key)
+            out = self._exec(h, lambda cli, km: cli.sparse_push(
+                km(key), ids, rows_arr))
+            self._replicate_op(h, lambda cli, km: cli.sparse_push(
+                km(key), ids, rows_arr))
+            return out
         shard = ids % self.n
         local = ids // self.n
 
         def one(s):
             m = shard == s
             if m.any():
-                self.clients[s].sparse_push(key, local[m], rows_arr[m])
+                sub, rsub = local[m], rows_arr[m]
+                self._exec(s, lambda cli, km: cli.sparse_push(
+                    km(key), sub, rsub))
+                self._replicate_op(s, lambda cli, km: cli.sparse_push(
+                    km(key), sub, rsub))
         self._fan(one)
 
     def sd_pushpull(self, key, ids, rows_arr, pull_ids=None):
@@ -185,7 +374,12 @@ class ShardedPSClient:
         pids = ids if pull_ids is None else np.asarray(pull_ids, np.int64)
         meta = self._meta_of(key)
         if meta is None:
-            return self._home(key).sd_pushpull(key, ids, rows_arr, pids)
+            h = self._home_idx(key)
+            out = self._exec(h, lambda cli, km: cli.sd_pushpull(
+                km(key), ids, rows_arr, pids))
+            self._replicate_op(h, lambda cli, km: cli.sparse_push(
+                km(key), ids, rows_arr))
+            return out
         # ONE fused round trip per shard (this is the hot CTR path)
         shard, local = ids % self.n, ids // self.n
         pshard, plocal = pids % self.n, pids // self.n
@@ -194,8 +388,15 @@ class ShardedPSClient:
             m, mp = shard == s, pshard == s
             if not m.any() and not mp.any():
                 return None
-            return np.asarray(self.clients[s].sd_pushpull(
-                key, local[m], rows_arr[m], plocal[mp]))
+            sub, rsub, psub = local[m], rows_arr[m], plocal[mp]
+            out = np.asarray(self._exec(
+                s, lambda cli, km: cli.sd_pushpull(km(key), sub, rsub,
+                                                   psub)))
+            if m.any():
+                # replicate the PUSH half only (the pull is a read)
+                self._replicate_op(s, lambda cli, km: cli.sparse_push(
+                    km(key), sub, rsub))
+            return out
         parts = self._fan(one)
         width = meta[1] or next(p.shape[1] for p in parts
                                 if p is not None)
@@ -210,7 +411,8 @@ class ShardedPSClient:
     def save(self, key, path):
         os.makedirs(path, exist_ok=True)
         if self._rows_of(key) is None:
-            return self._home(key).save(key, path)
+            return self._exec(self._home_idx(key),
+                              lambda cli, km: cli.save(km(key), path))
         table = self.pull(key)
         np.save(os.path.join(path, f"ps_param_{key}.npy"), table)
 
@@ -218,18 +420,84 @@ class ShardedPSClient:
         if self._rows_of(key) is None:
             # the server loads from ITS filesystem (multi-host: the file
             # lives where save() wrote it)
-            return self._home(key).load(key, path)
+            return self._exec(self._home_idx(key),
+                              lambda cli, km: cli.load(km(key), path))
         arr = np.load(os.path.join(path, f"ps_param_{key}.npy"))
         # param_assign keeps each shard's server optimizer + slot state
-        self._fan(lambda s: self.clients[s].t.call(
-            "param_assign", key, arr[s::self.n]))
+
+        def one(s):
+            part = arr[s::self.n]
+            self._exec(s, lambda cli, km: cli.t.call(
+                "param_assign", km(key), part))
+            self._replicate_op(s, lambda cli, km: cli.t.call(
+                "param_assign", km(key), part))
+        self._fan(one)
 
     def clear(self, key):
         self._row_sharded.pop(key, None)
-        self._fan(lambda s: self.clients[s].clear(key))
+
+        def one(s):
+            self._exec(s, lambda cli, km: cli.clear(km(key)))
+            self._replicate_op(s, lambda cli, km: cli.clear(km(key)))
+        self._fan(one)
 
     def wait(self, ticket):
         return self.clients[0].wait(ticket)
+
+    # ---------------- failover lifecycle ---------------- #
+
+    def drain_replication(self, timeout=30.0):
+        """Block until queued async replica writes have been applied
+        (the chaos tests compare replica contents; callers normally
+        never need this)."""
+        if self._rep_pool is None:
+            return
+        self._rep_pool.submit(lambda: None).result(timeout=timeout)
+
+    def failed_shards(self):
+        with self._fail_mu:
+            return sorted(self._failed)
+
+    def resync_shard(self, s):
+        """Copy shard ``s``'s replica (held by its ring backup) back
+        onto a RESTARTED primary, then return traffic to it.  The
+        primary must be reachable; value + optimizer spec are restored
+        (optimizer slot state restarts fresh — see module docstring)."""
+        self.drain_replication()
+        b = self._backup(s)
+        backup, primary = self.clients[b], self.clients[s]
+        restored = []
+        for rkey in sorted(backup.getLoads()):
+            if not rkey.startswith(REPLICA_PREFIX):
+                continue
+            key = rkey[len(REPLICA_PREFIX):]
+            _, opt, opt_args = backup.t.call("param_spec", rkey)
+            primary.param_set(key, np.asarray(backup.pull(rkey)),
+                              opt=opt, opt_args=opt_args)
+            restored.append(key)
+        # the restarted server is also the ring BACKUP of shard s-1:
+        # rebuild that replica from its (live) primary, or a later
+        # failure of s-1 would fail over onto pre-crash data
+        prev = (s - 1) % self.n
+        if prev != s:
+            try:
+                pcli = self.clients[prev]
+                for key in sorted(pcli.getLoads()):
+                    if key.startswith(REPLICA_PREFIX):
+                        continue
+                    _, opt, opt_args = pcli.t.call("param_spec", key)
+                    primary.param_set(_replica(key),
+                                      np.asarray(pcli.pull(key)),
+                                      opt=opt, opt_args=opt_args)
+            except Exception as e:  # noqa: BLE001 — degraded, not fatal
+                self._event("ps_replica_rebuild_failed", shard=prev,
+                            backup=s,
+                            error=f"{type(e).__name__}: {e}"[:200])
+        with self._fail_mu:
+            self._failed.discard(s)
+        self._event("ps_shard_resynced", shard=s, backup=b,
+                    keys=len(restored))
+        return restored
 
     # ---------------- coordination: server 0 ---------------- #
 
@@ -247,11 +515,14 @@ class ShardedPSClient:
                                                    wait_time)
 
     def getLoads(self):
-        return self._fan(lambda s: self.clients[s].getLoads())
+        return self._fan(lambda s: self._exec(
+            s, lambda cli, km: cli.getLoads()))
 
     def finalize(self):
         self._pool.shutdown(wait=True)
         self._fan_pool.shutdown(wait=True)
+        if self._rep_pool is not None:
+            self._rep_pool.shutdown(wait=True)
         for c in self.clients:
             c.finalize()
 
@@ -263,3 +534,15 @@ class ShardedPSClient:
 
     push_embedding = sync_embedding
     push_sync_embedding = sync_embedding
+
+
+def resync_primary(addrs, index):
+    """Supervisor hook (launcher.run_cluster): after respawning the PS
+    process at ``addrs[index]``, copy its replica back from the ring
+    backup so it rejoins with current data.  Returns the restored key
+    names."""
+    c = ShardedPSClient(addrs=addrs, replicate=True)
+    try:
+        return c.resync_shard(index)
+    finally:
+        c.finalize()

@@ -16,3 +16,18 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# jax-version shims (e.g. pre-0.5 runtimes lack top-level jax.shard_map)
+# must land before any test module runs `from jax import shard_map`
+from hetu_tpu._compat import ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tier (excluded from tier-1 runs)")
+    config.addinivalue_line(
+        "markers",
+        "smoke: <3-min verification tier (run with -m smoke; see "
+        "ROADMAP.md tier-1 line)")

@@ -3,10 +3,15 @@ numeric checks (reference has per-op grad kernels exercised via training
 tests; we verify against jax autodiff directly)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
 import hetu_tpu as ht
+
+# smoke tier: this module is part of the <3-min verification
+# battery (`pytest -m smoke`; ROADMAP tier-1 note)
+pytestmark = pytest.mark.smoke
 
 
 def _graph_grads(build_fn, inputs_np):

@@ -10,6 +10,10 @@ from hetu_tpu.cache.cache import PythonCache, NativeCache, EmbeddingCache
 from hetu_tpu.cache.cstable import CacheSparseTable
 from hetu_tpu.ps.server import PSServer
 
+# smoke tier: this module is part of the <3-min verification
+# battery (`pytest -m smoke`; ROADMAP tier-1 note)
+pytestmark = pytest.mark.smoke
+
 W = 4
 
 

@@ -6,6 +6,10 @@ import pytest
 
 import hetu_tpu as ht
 
+# smoke tier: this module is part of the <3-min verification
+# battery (`pytest -m smoke`; ROADMAP tier-1 note)
+pytestmark = pytest.mark.smoke
+
 
 def _train_quadratic(opt, steps=3):
     """loss = 0.5*sum(w^2); grad = w. Track w trajectory."""

@@ -8,6 +8,10 @@ import pytest
 from hetu_tpu.ps.server import PSServer
 from hetu_tpu.ps.client import PSClient, _TCPTransport, _LocalTransport
 
+# smoke tier: this module is part of the <3-min verification
+# battery (`pytest -m smoke`; ROADMAP tier-1 note)
+pytestmark = pytest.mark.smoke
+
 
 @pytest.fixture
 def local_client():

@@ -8,6 +8,10 @@ import hetu_tpu as ht
 from hetu_tpu.ps.server import PSServer
 from hetu_tpu.ps.sharded import ShardedPSClient
 
+# smoke tier: this module is part of the <3-min verification
+# battery (`pytest -m smoke`; ROADMAP tier-1 note)
+pytestmark = pytest.mark.smoke
+
 
 def _group(n=2):
     servers = [PSServer() for _ in range(n)]
