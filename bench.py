@@ -1024,6 +1024,128 @@ def bench_decode(platform, reduced):
     return art
 
 
+_SERVE_FILE = os.path.join(_HERE, "BENCH_SERVE.json")
+
+
+def bench_serve(platform, reduced):
+    """Continuous-batching serving throughput (hetu_tpu/serving): replay
+    a seeded mixed-length request trace through the engine AND through
+    the static-batch baseline (offline ``generate_fast``: pad to the
+    longest request, no early exit) on the same weights, counting the
+    same USEFUL tokens for both — the artifact records both rates, the
+    engine's TTFT percentiles, and its mean batch occupancy."""
+    import jax.numpy as jnp
+    import hetu_tpu as ht
+    from hetu_tpu.models import GPTConfig, GPTForCausalLM
+    from hetu_tpu.models.gpt_decode import _prep_param, generate_fast
+    from hetu_tpu.serving import Request, ServingEngine
+
+    # GPT-2-small shape on chip; a 2-layer h128 model on the CPU harness
+    # (big enough that compute, not per-step dispatch, dominates)
+    vocab, hidden, layers_n, heads, s_max, slots, n_req = \
+        50257, 768, 12, 12, 1024, 8, 32
+    if reduced:
+        vocab, hidden, layers_n, heads, s_max, slots, n_req = \
+            256, 128, 2, 2, 256, 4, 16
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                    num_hidden_layers=layers_n,
+                    num_attention_heads=heads,
+                    max_position_embeddings=s_max, batch_size=slots,
+                    seq_len=s_max, dropout_rate=0.0)
+    model = GPTForCausalLM(cfg, name="srv")
+    ids = ht.placeholder_op("srv_ids")
+    logits = model(ids)
+    ex = ht.Executor({"gen": [logits]})     # materializes init params
+    del logits
+    dt_ = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    params = {k: _prep_param(v, dt_) for k, v in ex.var_values.items()}
+
+    # seeded mixed-length trace: mostly short requests, a long straggler
+    # every 8th — the shape continuous batching exists for (static
+    # batching pads every batch member to the straggler)
+    rng = np.random.RandomState(1234)
+    straggle = s_max // 2
+    trace = []
+    for i in range(n_req):
+        P = int(rng.randint(4, 17))
+        gen = straggle if i % 8 == 7 else int(rng.randint(8, 33))
+        trace.append((rng.randint(0, vocab, P).astype(np.int32), gen))
+    useful = sum(g for _, g in trace)
+
+    def make_requests():
+        return [Request(prompt=p, max_new_tokens=g) for p, g in trace]
+
+    # ---- warm every compile outside the measured windows: the fused
+    # decode step plus ONE prefill per prompt-length bucket the trace
+    # hits (a cold bucket compile inside the window would be charged to
+    # the engine) ---- #
+    warm = ServingEngine(params, cfg, slots=slots, queue_limit=n_req,
+                         dtype=dt_)
+    buckets = sorted({warm.kv.bucket_prompt(len(p)) for p, _ in trace})
+    warm.run([Request(prompt=[1] * b, max_new_tokens=2)
+              for b in buckets])
+    generate_fast(params, cfg,
+                  np.zeros((slots, 8), np.int32), num_tokens=2,
+                  dtype=dt_)
+
+    # ---- continuous batching ---- #
+    eng = ServingEngine(params, cfg, slots=slots, queue_limit=n_req,
+                        dtype=dt_)
+    t0 = time.perf_counter()
+    res = eng.run(make_requests())
+    wall_c = time.perf_counter() - t0
+    assert len(res) == n_req
+    snap = eng.metrics.snapshot()
+
+    # ---- static baseline: batches in arrival order, pad-to-longest,
+    # no early exit (the offline scan's whole-batch contract) ---- #
+    t0 = time.perf_counter()
+    for i in range(0, n_req, slots):
+        batch = trace[i:i + slots]
+        pmax = max(len(p) for p, _ in batch)
+        gmax = max(g for _, g in batch)
+        padded = np.zeros((len(batch), pmax), np.int32)
+        for j, (p, _) in enumerate(batch):
+            padded[j, :len(p)] = p
+        generate_fast(params, cfg, padded, num_tokens=gmax, dtype=dt_)
+    wall_s = time.perf_counter() - t0
+
+    tps_c = round(useful / wall_c, 1)
+    tps_s = round(useful / wall_s, 1)
+    art = {
+        "platform": platform,
+        "reduced_scale": reduced,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
+        "continuous": {
+            "tokens_per_sec": tps_c,
+            "wall_s": round(wall_c, 3),
+            "ttft_p50_s": snap["ttft_p50_s"],
+            "ttft_p99_s": snap["ttft_p99_s"],
+            "mean_batch_occupancy": (round(snap["mean_batch_occupancy"], 4)
+                                     if snap["mean_batch_occupancy"]
+                                     else None),
+            "steps": snap["steps"],
+        },
+        "static_baseline": {
+            "tokens_per_sec": tps_s,
+            "wall_s": round(wall_s, 3),
+            "batches": -(-n_req // slots),
+            "note": "generate_fast, pad-to-longest, no early exit",
+        },
+        "speedup": round(tps_c / tps_s, 3) if tps_s else None,
+        "trace": {"seed": 1234, "n_requests": n_req,
+                  "prompt_len": "4..16", "short_new_tokens": "8..32",
+                  "straggler_every": 8, "straggler_new_tokens": straggle,
+                  "useful_tokens": useful},
+        "config": {"slots": slots, "s_max": s_max, "hidden": hidden,
+                   "layers": layers_n, "heads": heads, "vocab": vocab,
+                   "dtype": "bf16" if dt_ == jnp.bfloat16 else "f32",
+                   "kernel": "fused_slot_decode_step"},
+    }
+    _persist_artifact(_SERVE_FILE, art, reduced, has_data=True)
+    return art
+
+
 _SWEEP_FILE = os.path.join(_HERE, "SWEEP_BERT_BASE.json")
 
 _PROBE_SWEEP_SRC = """
@@ -1167,6 +1289,26 @@ def main():
             **({"not_written": art["not_written"]}
                if "not_written" in art else
                {"decode_file": os.path.basename(_DECODE_FILE)})}))
+        return
+
+    if os.environ.get("HETU_BENCH_SERVE"):
+        art = bench_serve(platform, reduced)
+        cont = art["continuous"]
+        print(json.dumps({
+            "metric": "serve_continuous_tokens_per_sec",
+            "value": cont["tokens_per_sec"], "unit": "tokens/sec",
+            # vs_baseline here = speedup over static batching on the
+            # same trace (the serving acceptance ratio, not the north
+            # star target)
+            "vs_baseline": art["speedup"], "platform": platform,
+            "static_tokens_per_sec":
+                art["static_baseline"]["tokens_per_sec"],
+            "ttft_p50_s": cont["ttft_p50_s"],
+            "ttft_p99_s": cont["ttft_p99_s"],
+            "mean_batch_occupancy": cont["mean_batch_occupancy"],
+            **({"not_written": art["not_written"]}
+               if "not_written" in art else
+               {"serve_file": os.path.basename(_SERVE_FILE)})}))
         return
 
     if os.environ.get("HETU_BENCH_CTR_ROWS"):

@@ -56,6 +56,12 @@ run calibration 3600 python -m hetu_tpu.planner.chip_calibration
 # 4b. KV-cached serving throughput (BENCH_DECODE.json)
 HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 
+# 4c. continuous-batching engine vs static batching on the seeded
+#     mixed-length trace (BENCH_SERVE.json: both rates + TTFT p50/p99 +
+#     occupancy; runs after decode so the scan compile is already in
+#     the shared compilation cache)
+HETU_BENCH_SERVE=1 run serve 3600 python bench.py
+
 # 5. long-context tile tuning: A/B a couple of block shapes at 32k
 for blocks in "512,1024" "1024,1024" "1024,2048" "512,2048"; do
   HETU_BENCH_LC_BLOCKS=$blocks HETU_BENCH_CONFIGS=long_context \
@@ -85,4 +91,5 @@ HETU_BENCH_FORCE_FLASH=1 HETU_BENCH_CONFIGS=bert4l \
 # name) so the matrix records the best measured configuration.
 
 echo "done; artifacts: BENCH_MATRIX.json SWEEP_BERT_BASE.json \
-BENCH_CTR_ROWS.json CALIBRATION_TPU.json (logs in $LOG)"
+BENCH_CTR_ROWS.json CALIBRATION_TPU.json BENCH_DECODE.json \
+BENCH_SERVE.json (logs in $LOG)"

@@ -59,6 +59,7 @@ from . import onnx
 from . import graphboard
 from . import hf
 from . import launcher
+from . import serving
 
 # MoE / communication op surface
 from .graph.ops_moe import (

@@ -17,7 +17,16 @@ no re-tracing of the training graph:
     out = generate_fast(ex.var_values, cfg, prompts, num_tokens=50,
                         temperature=0.8, top_k=40, seed=0)
 
-Sampling: greedy (temperature=0), temperature, and top-k.
+Sampling: greedy (temperature=0), temperature, and top-k; ``eos_id``
+stops a sequence at EOS (pad after, per-step compute short-circuits
+once the whole batch is done).
+
+``_decode_step`` is the SHARED decode core: the offline scan above and
+the continuous-batching serving engine (``hetu_tpu.serving``) both run
+it — the offline path with one scalar position for the whole batch, the
+server with a per-slot position vector (slots hold sequences of unequal
+filled lengths).  ``serve_prefill_fn``/``serve_decode_fn`` below are the
+server's two jitted entry points over the same arithmetic.
 """
 
 from __future__ import annotations
@@ -53,13 +62,24 @@ def _gelu_tanh(x):
 
 def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
     """One incremental position: token [B] int32 at position ``pos``.
-    Returns (logits [B, V], new cache_k, new cache_v)."""
+    Returns (logits [B, V], new cache_k, new cache_v).
+
+    ``pos`` is a scalar (offline scan: the whole batch sits at one
+    position) OR an int32 [B] vector (serving: every slot decodes at its
+    own filled length).  Scalar positions keep the contiguous
+    dynamic_update_slice write; vector positions scatter one row per
+    slot and mask attention per slot."""
     name, L, H, Dh, S_max = cfg_tuple
     B = token.shape[0]
     hdim = H * Dh
+    per_slot = jnp.ndim(pos) > 0
     h = params[f"{name}_wte_table"][token] + params[f"{name}_wpe"][pos]
 
-    live = (jnp.arange(S_max) <= pos)[None, None, :]       # [1,1,S]
+    if per_slot:
+        live = jnp.arange(S_max)[None, None, :] <= pos[:, None, None]
+        bidx = jnp.arange(B)
+    else:
+        live = (jnp.arange(S_max) <= pos)[None, None, :]   # [1,1,S]
     for i in range(L):
         us = f"{name}_h{i}"
         x = _ln(h, params[f"{us}_ln1_scale"], params[f"{us}_ln1_bias"])
@@ -70,10 +90,14 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
         k = k.reshape(B, H, Dh)
         v = v.reshape(B, H, Dh)
         # write this position's k/v into the cache
-        cache_k = jax.lax.dynamic_update_slice(
-            cache_k, k[None, :, None], (i, 0, pos, 0, 0))
-        cache_v = jax.lax.dynamic_update_slice(
-            cache_v, v[None, :, None], (i, 0, pos, 0, 0))
+        if per_slot:
+            cache_k = cache_k.at[i, bidx, pos].set(k)
+            cache_v = cache_v.at[i, bidx, pos].set(v)
+        else:
+            cache_k = jax.lax.dynamic_update_slice(
+                cache_k, k[None, :, None], (i, 0, pos, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(
+                cache_v, v[None, :, None], (i, 0, pos, 0, 0))
         ks = cache_k[i]                                    # [B,S,H,Dh]
         vs = cache_v[i]
         s = jnp.einsum("bhd,bshd->bhs", q, ks) * (Dh ** -0.5)
@@ -124,15 +148,40 @@ def _sample(logits, temperature, top_k, key):
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg_tuple", "top_k"))
+def _sample_slot(logits, temperature, top_k, key):
+    """Per-slot sampling with temperature AND top_k TRACED (unlike the
+    offline ``_sample``, whose static top_k would force one compile per
+    distinct request setting — a serving batch mixes settings freely).
+    The kth-largest threshold comes from a full sort: O(V log V), noise
+    next to the decode matmuls at serving batch sizes; top_k=0 disables
+    the mask."""
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    t_safe = jnp.maximum(temperature, 1e-6)
+    scaled = logits / t_safe
+    desc = -jnp.sort(-scaled)
+    kth = desc[jnp.clip(top_k - 1, 0, logits.shape[-1] - 1)]
+    masked = jnp.where((top_k > 0) & (scaled < kth), NEG_INF, scaled)
+    sampled = jax.random.categorical(key, masked).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg_tuple", "top_k", "use_eos"))
 def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
-                   temperature, top_k, rng):
+                   temperature, top_k, rng, eos_id=0, pad_id=0,
+                   use_eos=False):
     """The whole generation as one scan over ALL S_max-1 positions: at
     positions < prompt_len the next input token is the PROMPT's
     (teacher forcing); beyond it, the sampled one.  Scanning to the
     static S_max (rather than the request's length) keeps prompt length
     and num_tokens TRACED — one compile serves every request shape at
-    this (batch, S_max); the host slices the requested span after."""
+    this (batch, S_max); the host slices the requested span after.
+
+    With ``use_eos`` (static: the default program is unchanged), a
+    sequence that samples ``eos_id`` past its prompt emits the EOS and
+    then pads with ``pad_id``; once EVERY row is done the per-step body
+    is skipped via lax.cond — a runtime short-circuit inside the single
+    compiled scan."""
     name, L, H, Dh, S_max = cfg_tuple
     B = prompt_padded.shape[0]
     # cache dtype follows the weights: bf16 decode halves the KV cache
@@ -142,22 +191,120 @@ def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
     cache_v = jnp.zeros((L, B, S_max, H, Dh), cdtype)
 
     def step(carry, t):
-        cache_k, cache_v, token, rng = carry
-        logits, cache_k, cache_v = _decode_step(
-            params, cfg_tuple, cache_k, cache_v, t, token)
-        rng, sub = jax.random.split(rng)
-        sampled = _sample(logits, temperature, top_k, sub)
-        # next input: prompt token while still inside the prompt
-        nxt = jnp.where(t + 1 < prompt_len,
-                        prompt_padded[:, jnp.minimum(t + 1, S_max - 1)],
-                        sampled)
-        return (cache_k, cache_v, nxt, rng), nxt
+        def live_step(carry):
+            cache_k, cache_v, token, rng, done = carry
+            logits, cache_k, cache_v = _decode_step(
+                params, cfg_tuple, cache_k, cache_v, t, token)
+            rng, sub = jax.random.split(rng)
+            sampled = _sample(logits, temperature, top_k, sub)
+            # next input: prompt token while still inside the prompt;
+            # pad once this row already emitted its EOS
+            in_prompt = t + 1 < prompt_len
+            nxt = jnp.where(
+                in_prompt,
+                prompt_padded[:, jnp.minimum(t + 1, S_max - 1)],
+                jnp.where(done, jnp.int32(pad_id), sampled))
+            if use_eos:
+                done = done | (~in_prompt & (sampled == eos_id))
+            return (cache_k, cache_v, nxt, rng, done), nxt
+
+        if not use_eos:
+            return live_step(carry)
+        return jax.lax.cond(
+            jnp.all(carry[4]),
+            lambda c: (c, jnp.full((B,), pad_id, jnp.int32)),
+            live_step, carry)
 
     first = prompt_padded[:, 0]
-    (_, _, _, _), toks = jax.lax.scan(
-        step, (cache_k, cache_v, first, rng), jnp.arange(S_max - 1))
+    done0 = jnp.zeros((B,), bool)
+    _, toks = jax.lax.scan(
+        step, (cache_k, cache_v, first, rng, done0), jnp.arange(S_max - 1))
     # toks[t] is the input token for position t+1
     return jnp.concatenate([first[:, None], toks.T], axis=1)
+
+
+# ------------------------- serving entry points ------------------------- #
+#
+# The continuous-batching server (hetu_tpu/serving/engine.py) drives the
+# SAME ``_decode_step`` core through two jitted functions: a teacher-
+# forced prefill of one new sequence into its cache slot, and one fused
+# decode step over every slot with per-slot positions.  Host code owns
+# the tiny scheduling state (positions, tokens, rng keys as numpy); the
+# device owns only the big [L, B_slots, S_max, H, Dh] cache pair, which
+# threads through each call.
+
+
+def _serve_prefill(params, cfg_tuple, cache_k, cache_v, slot, prompt,
+                   prompt_len, temperature, top_k, rng_key):
+    """Teacher-forced prefill of ONE sequence into cache row ``slot``:
+    scan the (bucket-padded) prompt writing each position's K/V, then
+    sample the first generated token from the logits at prompt_len-1.
+    Positions at or past prompt_len are skipped via lax.cond (the
+    bucket's padded tail costs no compute); recompiles once per prompt-
+    length BUCKET, not per length.  Returns (first_token, cache_k,
+    cache_v, new_rng_key)."""
+    name, L, H, Dh, S_max = cfg_tuple
+    P_b = prompt.shape[0]
+    V = params[f"{name}_wte_table"].shape[0]
+    ck = jax.lax.dynamic_slice(cache_k, (0, slot, 0, 0, 0),
+                               (L, 1, S_max, H, Dh))
+    cv = jax.lax.dynamic_slice(cache_v, (0, slot, 0, 0, 0),
+                               (L, 1, S_max, H, Dh))
+
+    def step(carry, t):
+        def live(carry):
+            ck, cv, last = carry
+            logits, ck, cv = _decode_step(
+                params, cfg_tuple, ck, cv, t, prompt[t][None])
+            last = jnp.where(t == prompt_len - 1, logits[0], last)
+            return ck, cv, last
+        return jax.lax.cond(t < prompt_len, live, lambda c: c, carry), None
+
+    (ck, cv, last), _ = jax.lax.scan(
+        step, (ck, cv, jnp.zeros((V,), jnp.float32)), jnp.arange(P_b))
+    cache_k = jax.lax.dynamic_update_slice(cache_k, ck, (0, slot, 0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, cv, (0, slot, 0, 0, 0))
+    rng_key, sub = jax.random.split(rng_key)
+    first = _sample_slot(last, temperature, top_k, sub)
+    return first, cache_k, cache_v, rng_key
+
+
+def _serve_decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
+                       temperature, top_k, rng_keys):
+    """One fused decode step over ALL slots: slot b consumes ``token[b]``
+    at its own position ``pos[b]`` (per-slot attention masking inside
+    ``_decode_step``) and samples its next token from its own rng
+    stream — outputs depend only on each request's (prompt, seed,
+    settings), never on slot assignment or batch company.  Free slots
+    ride along harmlessly: their frozen-position writes land in rows the
+    next prefill/decode overwrites before any mask admits them."""
+    logits, cache_k, cache_v = _decode_step(
+        params, cfg_tuple, cache_k, cache_v, pos, token)
+    splits = jax.vmap(jax.random.split)(rng_keys)          # [B,2,2]
+    new_keys, subs = splits[:, 0], splits[:, 1]
+    sampled = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
+    return sampled, cache_k, cache_v, new_keys
+
+
+@functools.lru_cache(maxsize=None)
+def serve_prefill_fn(donate=True):
+    """Jitted ``_serve_prefill``; ``donate=True`` donates the cache pair
+    so XLA updates it in place — without donation every call pays a
+    full-cache copy (the scatter/update allocates a fresh buffer),
+    which dwarfs the step's matmuls at serving cache sizes."""
+    kw = {"static_argnames": ("cfg_tuple",)}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    return jax.jit(_serve_prefill, **kw)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_decode_fn(donate=True):
+    """Jitted ``_serve_decode_step`` (see ``serve_prefill_fn``)."""
+    kw = {"static_argnames": ("cfg_tuple",)}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    return jax.jit(_serve_decode_step, **kw)
 
 
 def _infer_name(params, name=None):
@@ -210,7 +357,8 @@ def tp_shard_params(params, mesh, config, axis="tp", name=None):
 
 
 def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
-                  top_k=0, seed=0, name=None, dtype=None):
+                  top_k=0, seed=0, name=None, dtype=None, eos_id=None,
+                  pad_id=0):
     """KV-cached generation.
 
     params: {name: array} (e.g. ``executor.var_values`` — pass it
@@ -220,7 +368,11 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
       [B, P] array); name: the model's parameter-name prefix — inferred
       when the params hold exactly one ``*_wte_table``; dtype:
       ``jnp.bfloat16`` halves weights AND the KV cache and takes the
-      fast MXU path (logits/sampling stay f32); default float32.
+      fast MXU path (logits/sampling stay f32); default float32;
+      eos_id: a row that samples this id past its prompt emits it, then
+      ``pad_id`` for the rest of the requested span (and per-step
+      compute short-circuits once every row is done) — both traced, so
+      different EOS/pad ids share one compile.
       Returns [B, P + num_tokens] numpy int32.
     """
     prompts = np.asarray(prompts, np.int32)
@@ -248,5 +400,8 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
               for k, v in params.items() if k.startswith(name + "_")}
     out = _generate_scan(params, cfg_tuple, jnp.asarray(pad),
                          jnp.int32(P), jnp.float32(temperature),
-                         int(top_k), jax.random.PRNGKey(seed))
+                         int(top_k), jax.random.PRNGKey(seed),
+                         eos_id=jnp.int32(-1 if eos_id is None else eos_id),
+                         pad_id=jnp.int32(pad_id),
+                         use_eos=eos_id is not None)
     return np.asarray(out[:, :total])

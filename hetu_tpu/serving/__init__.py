@@ -1,0 +1,36 @@
+"""hetu_tpu.serving: continuous-batching inference over the KV-cached
+decode path.
+
+The offline path (``models/gpt_decode.generate_fast``) compiles one
+whole-generation scan per (batch, S_max) — every request in the batch
+enters and leaves together, padded to the longest.  This package is the
+online counterpart: an iteration-level scheduler (Orca-style continuous
+batching) that admits and retires sequences BETWEEN fused decode steps,
+over a slot-structured KV cache, sharing ``_decode_step`` — the same
+compiled arithmetic — with the offline path.
+
+    engine.py     ServingEngine: admission queue with backpressure, the
+                  per-step admit -> prefill -> fused-decode -> retire loop
+    kv_manager.py KVCacheManager: free-slot allocation + per-slot filled
+                  lengths over one preallocated [L, B_slots, S_max, H, Dh]
+                  cache pair, pow2-bucketed shapes
+    request.py    Request / Result dataclasses
+    metrics.py    ServingMetrics: TTFT, tok/s, occupancy; JSONL events
+
+Quickstart (greedy results are token-identical to ``generate_fast``):
+
+    from hetu_tpu.serving import ServingEngine, Request
+    eng = ServingEngine(ex.var_values, cfg, slots=8)
+    eng.submit(Request(prompt=[7, 8, 9], max_new_tokens=32, eos_id=50256))
+    results = eng.run()           # {request_id: Result}
+"""
+
+from .request import Request, Result
+from .kv_manager import KVCacheManager, round_up_pow2
+from .metrics import ServingMetrics
+from .engine import ServingEngine, QueueFull
+
+__all__ = [
+    "ServingEngine", "QueueFull", "Request", "Result",
+    "KVCacheManager", "ServingMetrics", "round_up_pow2",
+]

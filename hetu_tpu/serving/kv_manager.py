@@ -1,0 +1,106 @@
+"""Slot-structured KV-cache management for continuous batching.
+
+One preallocated ``[L, B_slots, S_max, H, Dh]`` cache pair (k and v)
+lives on device for the engine's lifetime; this manager owns the pair
+plus the host-side slot bookkeeping: a free list, per-slot filled
+lengths, and the owner map.  Slots are the unit of admission — a
+sequence holds one row from prefill to retirement, then the row is
+recycled (numerically safe: attention masks to each slot's own filled
+prefix, and every position is rewritten before the mask admits it).
+
+Shapes are BUCKETED to powers of two (``B_slots`` and ``S_max``
+independently) so engines configured for nearby workloads land on the
+same jit cache entries — the compile cache stays bounded by the ladder,
+not by the number of distinct deployment configs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def round_up_pow2(n, floor=1):
+    """Smallest power of two >= max(n, floor)."""
+    n = max(int(n), int(floor))
+    return 1 << (n - 1).bit_length()
+
+
+class KVCacheManager:
+    """Free-slot allocator over one preallocated cache pair.
+
+    layers/heads/head_dim: model shape; slots: requested concurrent
+    sequences (bucketed up to a power of two); max_seq_len: longest
+    prompt+generation to admit (bucketed, then capped at ``pos_cap`` —
+    the model's max_position_embeddings, since the position table can't
+    index past it); dtype: cache dtype (follow the weights: bf16 halves
+    the cache).  Memory: L*B*S*H*Dh * itemsize * 2.
+    """
+
+    def __init__(self, *, layers, heads, head_dim, slots, max_seq_len,
+                 pos_cap=None, dtype=jnp.float32, bucket=True):
+        if bucket:
+            slots = round_up_pow2(slots)
+            s = round_up_pow2(max_seq_len, floor=16)
+        else:
+            s = int(max_seq_len)
+        if pos_cap is not None:
+            s = min(s, int(pos_cap))
+        if s < max_seq_len:
+            raise ValueError(
+                f"max_seq_len={max_seq_len} exceeds the position-table "
+                f"cap {pos_cap}")
+        self.n_slots = int(slots)
+        self.s_max = int(s)
+        self.cache_k = jnp.zeros(
+            (layers, self.n_slots, self.s_max, heads, head_dim), dtype)
+        self.cache_v = jnp.zeros_like(self.cache_k)
+        self._free = list(range(self.n_slots))
+        self.lengths = np.zeros(self.n_slots, np.int32)
+        self.owner = [None] * self.n_slots
+        self.total_allocs = 0
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    @property
+    def occupancy(self):
+        return 1.0 - len(self._free) / self.n_slots
+
+    def live(self):
+        """Slot indices currently holding a sequence (ascending)."""
+        return [i for i in range(self.n_slots) if self.owner[i] is not None]
+
+    def bucket_prompt(self, p):
+        """Prompt-length bucket for the prefill scan: pow2, floor 8,
+        capped at S_max — a handful of prefill compiles serves every
+        prompt length."""
+        return min(round_up_pow2(p, floor=8), self.s_max)
+
+    def alloc(self, owner, length):
+        """Claim a free slot for ``owner`` whose prompt fills ``length``
+        positions; returns the slot index or None when full."""
+        if length > self.s_max:
+            raise ValueError(
+                f"sequence length {length} exceeds S_max {self.s_max}")
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self.owner[slot] = owner
+        self.lengths[slot] = length
+        self.total_allocs += 1
+        return slot
+
+    def advance(self, slot, n=1):
+        """Record ``n`` more filled positions in ``slot``."""
+        self.lengths[slot] += n
+
+    def release(self, slot):
+        """Return a retired sequence's slot to the free list (its cache
+        rows are left as-is — recycled content is masked/overwritten)."""
+        if self.owner[slot] is None:
+            raise ValueError(f"slot {slot} is already free")
+        self.owner[slot] = None
+        self.lengths[slot] = 0
+        self._free.append(slot)

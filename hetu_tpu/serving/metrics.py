@@ -1,0 +1,127 @@
+"""Serving telemetry: per-request latency aggregates + engine gauges.
+
+Structured events follow the launcher's convention (launcher.py
+``_event``): ``{"t": <epoch>, "event": <kind>, **fields}`` records kept
+in memory and, when a log path is set (argument or ``$HETU_SERVE_LOG``),
+appended as JSONL — the same shape ``$HETU_FAILURE_LOG`` uses, so one
+tail/jq pipeline reads both streams.
+
+Aggregates answer the serving questions: TTFT percentiles (queue wait
+included — measured from submit to first token), decode tokens/s, mean
+batch occupancy (how full the fused step ran), queue depth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _pct(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else None
+
+
+class ServingMetrics:
+    def __init__(self, log_path=None):
+        self.log_path = (log_path if log_path is not None
+                         else os.environ.get("HETU_SERVE_LOG"))
+        self.events = []
+        self.submitted = 0
+        self.rejected = 0
+        self.finished = 0
+        self.tokens_generated = 0
+        self.ttfts = []            # seconds, submit -> first token
+        self.latencies = []        # seconds, submit -> finish
+        self.step_live = []        # live slots per fused step
+        self.step_queue = []       # queue depth per fused step
+        self.step_dt = []          # seconds per fused step
+        self._slots = None
+        self._t0 = None
+        self._t_last = None
+
+    # ------------------------------------------------------------- #
+
+    def event(self, kind, **fields):
+        rec = {"t": round(time.time(), 3), "event": kind, **fields}
+        self.events.append(rec)
+        if self.log_path:
+            try:
+                with open(self.log_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError:
+                pass
+        return rec
+
+    def _mark(self):
+        now = time.perf_counter()
+        if self._t0 is None:
+            self._t0 = now
+        self._t_last = now
+
+    # ------------------------------------------------------------- #
+
+    def record_submit(self, request_id, queue_depth):
+        self.submitted += 1
+        self.event("serve_submit", request=request_id,
+                   queue_depth=queue_depth)
+
+    def record_reject(self, request_id, queue_depth):
+        self.rejected += 1
+        self.event("serve_queue_reject", request=request_id,
+                   queue_depth=queue_depth)
+
+    def record_admit(self, request_id, slot, queue_wait_s, ttft_s):
+        self._mark()
+        self.ttfts.append(ttft_s)
+        self.tokens_generated += 1          # prefill emits token #1
+        self.event("serve_admit", request=request_id, slot=slot,
+                   queue_wait_s=round(queue_wait_s, 6),
+                   ttft_s=round(ttft_s, 6))
+
+    def record_step(self, live, slots, queue_depth, dt_s, new_tokens):
+        self._mark()
+        self._slots = slots
+        self.step_live.append(live)
+        self.step_queue.append(queue_depth)
+        self.step_dt.append(dt_s)
+        self.tokens_generated += new_tokens
+
+    def record_finish(self, request_id, reason, n_generated, latency_s):
+        self._mark()
+        self.finished += 1
+        self.latencies.append(latency_s)
+        self.event("serve_finish", request=request_id, reason=reason,
+                   n_generated=n_generated, latency_s=round(latency_s, 6))
+
+    # ------------------------------------------------------------- #
+
+    def snapshot(self):
+        """Aggregate view (JSON-able): throughput, TTFT p50/p99, mean
+        batch occupancy over fused steps, queue stats."""
+        wall = ((self._t_last - self._t0)
+                if self._t0 is not None and self._t_last > self._t0
+                else None)
+        occ = ([l / self._slots for l in self.step_live]
+               if self._slots else [])
+        return {
+            "requests_submitted": self.submitted,
+            "requests_rejected": self.rejected,
+            "requests_finished": self.finished,
+            "tokens_generated": self.tokens_generated,
+            "wall_s": round(wall, 6) if wall else None,
+            "tokens_per_sec": (round(self.tokens_generated / wall, 2)
+                               if wall else None),
+            "ttft_p50_s": _pct(self.ttfts, 50),
+            "ttft_p99_s": _pct(self.ttfts, 99),
+            "ttft_mean_s": (float(np.mean(self.ttfts))
+                            if self.ttfts else None),
+            "step_p50_s": _pct(self.step_dt, 50),
+            "step_p99_s": _pct(self.step_dt, 99),
+            "steps": len(self.step_live),
+            "mean_batch_occupancy": (float(np.mean(occ)) if occ else None),
+            "mean_queue_depth": (float(np.mean(self.step_queue))
+                                 if self.step_queue else None),
+        }

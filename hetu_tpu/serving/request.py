@@ -1,0 +1,76 @@
+"""Request/Result contracts for the serving engine.
+
+A Request carries everything that makes its output reproducible in
+isolation: prompt, sampling settings, and a PER-REQUEST rng seed — so
+the engine's outputs are a pure function of the request, independent of
+arrival order, slot assignment, or what else shares the batch (the
+scheduler-determinism tests pin this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    prompt: non-empty token ids; max_new_tokens: tokens to generate
+    (the EOS, when hit, counts as the last one); temperature/top_k:
+    per-request sampling settings (0/0 = greedy) — both traced in the
+    fused step, so mixed settings share one compile; eos_id: stop
+    sampling once this id is emitted past the prompt; seed: the
+    request's own rng stream; stream_cb: called as cb(request, token)
+    for every generated token as it lands (iteration-level streaming).
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    eos_id: Optional[int] = None
+    seed: int = 0
+    stream_cb: Optional[Callable] = None
+    request_id: Optional[str] = None
+    # set by the engine
+    submitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = [int(t) for t in np.asarray(self.prompt).reshape(-1)]
+        if not self.prompt:
+            raise ValueError("prompt must hold at least one token")
+        if int(self.max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        self.max_new_tokens = int(self.max_new_tokens)
+        if self.request_id is None:
+            self.request_id = f"req-{next(_ids)}"
+
+
+@dataclasses.dataclass
+class Result:
+    """A finished request: ``tokens`` is prompt + generated (numpy
+    int32, EOS included when that's what stopped it — no padding, unlike
+    the offline path's fixed span); ``finish_reason`` is "eos" or
+    "length"."""
+
+    request_id: str
+    tokens: np.ndarray
+    prompt_len: int
+    finish_reason: str
+    n_generated: int
+    ttft_s: float
+    latency_s: float
+    slot: int
+
+    @property
+    def generated(self) -> List[int]:
+        return [int(t) for t in self.tokens[self.prompt_len:]]

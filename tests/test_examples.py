@@ -136,6 +136,15 @@ def test_gpt_example_script_runs():
                     "--num-steps", "3"])
 
 
+def test_serve_gpt_example_chains_decode():
+    """Serving demo: the trained +1 chain decodes correctly through the
+    continuous-batching engine for every request in the mixed burst."""
+    mod = _load("nlp/serve_gpt.py", "ex_serve")
+    frac = _run_main(mod, ["--train-steps", "250", "--requests", "5",
+                           "--slots", "2"])
+    assert frac == 1.0
+
+
 def test_gpt_greedy_generation():
     """Inference path: after training next=(x+1)%V, greedy decoding must
     reproduce the arithmetic chain from a prompt (eval subgraph shares

@@ -110,6 +110,35 @@ class TestFastDecode:
         assert out[0].tolist() == list(range(7, 16))
         assert out[1].tolist() == list(range(20, 29))
 
+    def test_eos_stops_generation(self, trained):
+        """eos_id regression: on the trained +1 chain [7,8,9] -> 10,11,
+        12,... an eos_id of 12 must emit 10,11,12 then pad the rest of
+        the requested span (shape contract unchanged); rows that never
+        sample EOS run the full span as before."""
+        cfg, ex, _ = trained
+        cfg2 = GPTConfig(vocab_size=61, hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=2,
+                         max_position_embeddings=16, batch_size=2,
+                         seq_len=16, dropout_rate=0.0)
+        out = generate_fast(ex.var_values, cfg2,
+                            [[7, 8, 9], [20, 21, 22]], num_tokens=6,
+                            eos_id=12, pad_id=0)
+        # row 0 hits EOS after 3 generated tokens; pad after
+        assert out[0].tolist() == [7, 8, 9, 10, 11, 12, 0, 0, 0]
+        # row 1 never samples 12 inside its span: untouched
+        assert out[1].tolist() == list(range(20, 29))
+        # eos only triggers PAST the prompt: a 12 inside the prompt is
+        # teacher-forced context, not a stop
+        out2 = generate_fast(ex.var_values, cfg2,
+                             [[11, 12, 13], [30, 31, 32]], num_tokens=4,
+                             eos_id=12, pad_id=0)
+        assert out2[0].tolist() == [11, 12, 13, 14, 15, 16, 17]
+        # custom pad_id lands in the padded tail
+        out3 = generate_fast(ex.var_values, cfg2,
+                             [[7, 8, 9], [7, 8, 9]], num_tokens=6,
+                             eos_id=10, pad_id=59)
+        assert out3[0].tolist() == [7, 8, 9, 10, 59, 59, 59, 59, 59]
+
     def test_overlong_request_raises(self, trained):
         cfg, ex, _ = trained
         with pytest.raises(ValueError):
