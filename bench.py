@@ -1112,6 +1112,68 @@ def bench_serve(platform, reduced):
 
     tps_c = round(useful / wall_c, 1)
     tps_s = round(useful / wall_s, 1)
+
+    def engine_trace(trace_, fast, useful_):
+        """Warm-run then measure one engine path over a trace; returns
+        the rate plus the per-phase attribution from the step events."""
+        reqs = [Request(prompt=p, max_new_tokens=g) for p, g in trace_]
+        warm_e = ServingEngine(params, cfg, slots=slots,
+                               queue_limit=len(trace_), dtype=dt_,
+                               fast_path=fast)
+        warm_e.run([Request(prompt=p, max_new_tokens=g)
+                    for p, g in trace_])   # full trace: every (group,
+        # bucket) compile the measured run will hit is now cached
+        e = ServingEngine(params, cfg, slots=slots,
+                          queue_limit=len(trace_), dtype=dt_,
+                          fast_path=fast)
+        t0 = time.perf_counter()
+        res = e.run(reqs)
+        wall = time.perf_counter() - t0
+        snap_ = e.metrics.snapshot()
+        return {
+            "tokens_per_sec": round(useful_ / wall, 1),
+            "wall_s": round(wall, 3),
+            "prefill_total_s": snap_["prefill_total_s"],
+            "decode_total_s": snap_["decode_total_s"],
+            "prefill_ms_p50": snap_["prefill_ms_p50"],
+            "decode_ms_p50": snap_["decode_ms_p50"],
+            "prefill_dispatches": snap_["prefill_dispatches"],
+        }, sorted(r.tokens.tolist() for r in res.values())
+
+    # ---- masked vs ragged fast-path A/B on the same mixed trace;
+    # greedy parity between the paths is the acceptance criterion ---- #
+    ab = {}
+    outs = {}
+    for label, fast in (("masked", False), ("ragged", True)):
+        ab[label], outs[label] = engine_trace(trace, fast, useful)
+    ab["greedy_identical"] = outs["masked"] == outs["ragged"]
+    ab["speedup"] = (round(ab["ragged"]["tokens_per_sec"]
+                           / ab["masked"]["tokens_per_sec"], 3)
+                     if ab["masked"]["tokens_per_sec"] else None)
+
+    # ---- prefill-heavy trace variant: long prompts, short tails —
+    # the phase mix where flash prefill carries the win ---- #
+    rng2 = np.random.RandomState(4321)
+    ptrace = []
+    for _ in range(n_req):
+        P = int(rng2.randint(s_max // 4, s_max // 2))
+        ptrace.append((rng2.randint(0, vocab, P).astype(np.int32),
+                       int(rng2.randint(4, 9))))
+    useful_p = sum(g for _, g in ptrace)
+    heavy = {"trace": {"seed": 4321, "n_requests": n_req,
+                       "prompt_len": f"{s_max // 4}..{s_max // 2 - 1}",
+                       "new_tokens": "4..8",
+                       "useful_tokens": useful_p}}
+    houts = {}
+    for label, fast in (("masked", False), ("ragged", True)):
+        heavy[label], houts[label] = engine_trace(ptrace, fast, useful_p)
+    heavy["greedy_identical"] = houts["masked"] == houts["ragged"]
+    heavy["speedup"] = (round(heavy["ragged"]["tokens_per_sec"]
+                              / heavy["masked"]["tokens_per_sec"], 3)
+                        if heavy["masked"]["tokens_per_sec"] else None)
+
+    phase_ab = _serve_phase_ab(params, cfg, dt_, reduced)
+
     art = {
         "platform": platform,
         "reduced_scale": reduced,
@@ -1133,6 +1195,9 @@ def bench_serve(platform, reduced):
             "note": "generate_fast, pad-to-longest, no early exit",
         },
         "speedup": round(tps_c / tps_s, 3) if tps_s else None,
+        "fast_path_ab": ab,
+        "prefill_heavy": heavy,
+        "phase_ab": phase_ab,
         "trace": {"seed": 1234, "n_requests": n_req,
                   "prompt_len": "4..16", "short_new_tokens": "8..32",
                   "straggler_every": 8, "straggler_new_tokens": straggle,
@@ -1140,10 +1205,101 @@ def bench_serve(platform, reduced):
         "config": {"slots": slots, "s_max": s_max, "hidden": hidden,
                    "layers": layers_n, "heads": heads, "vocab": vocab,
                    "dtype": "bf16" if dt_ == jnp.bfloat16 else "f32",
-                   "kernel": "fused_slot_decode_step"},
+                   "kernel": "fused_slot_decode_step",
+                   "fast_path": "flash_prefill + ragged paged decode "
+                                "(kernels/decode_attention.py); "
+                                "interpret-mode emulation off-TPU — "
+                                "stage 4c is the A/B of record"},
     }
     _persist_artifact(_SERVE_FILE, art, reduced, has_data=True)
     return art
+
+
+def _serve_phase_ab(params, cfg, dt_, reduced):
+    """Per-phase micro A/B outside the scheduler: (a) the fused decode
+    step, masked vs ragged, at 25%/50% cache fill — the ragged kernel
+    fetches ceil(filled/block_k) KV blocks, so its step time scales
+    with fill while masked-S_max stays flat; (b) one-request prefill,
+    teacher-forced scan vs flash, at prompt length 128 (the acceptance
+    floor).  Engine-free: raw serve_*_fn calls on a standalone cache."""
+    import jax
+    from hetu_tpu.models.gpt_decode import (
+        serve_decode_fn, serve_prefill_batch_fn, serve_prefill_fn,
+    )
+    from hetu_tpu.serving import KVCacheManager
+
+    Dh = cfg.hidden_size // cfg.num_attention_heads
+    kv = KVCacheManager(
+        layers=cfg.num_hidden_layers, heads=cfg.num_attention_heads,
+        head_dim=Dh, slots=cfg.batch_size,
+        max_seq_len=cfg.max_position_embeddings, dtype=dt_)
+    cfg_tuple = ("srv", cfg.num_hidden_layers, cfg.num_attention_heads,
+                 Dh, kv.s_max)
+    B = kv.n_slots
+    iters = 5 if reduced else 30
+    tok = np.ones(B, np.int32)
+    temps = np.zeros(B, np.float32)
+    topks = np.zeros(B, np.int32)
+    keys = np.stack([np.asarray(jax.random.PRNGKey(i), np.uint32)
+                     for i in range(B)])
+
+    def time_decode(attn, filled):
+        fn = serve_decode_fn(donate=False, attn=attn)
+        pos = np.full(B, filled - 1, np.int32)
+        out = fn(params, cfg_tuple, kv.cache_k, kv.cache_v, pos, tok,
+                 temps, topks, keys)
+        jax.block_until_ready(out[0])              # warm the compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(params, cfg_tuple, kv.cache_k, kv.cache_v, pos,
+                     tok, temps, topks, keys)
+        jax.block_until_ready(out[0])
+        return round((time.perf_counter() - t0) / iters * 1e3, 3)
+
+    decode_rows = []
+    for frac in (0.25, 0.5):
+        filled = max(1, int(kv.s_max * frac))
+        masked_ms = time_decode("masked", filled)
+        ragged_ms = time_decode("ragged", filled)
+        decode_rows.append({
+            "fill": frac, "filled_len": filled, "s_max": kv.s_max,
+            "masked_ms": masked_ms, "ragged_ms": ragged_ms,
+            "ragged_speedup": (round(masked_ms / ragged_ms, 3)
+                               if ragged_ms else None)})
+
+    P = min(128, kv.s_max // 2)
+    prompt = np.arange(1, P + 1, dtype=np.int32) % cfg.vocab_size
+    key = np.asarray(jax.random.PRNGKey(0), np.uint32)
+
+    def time_prefill(flash):
+        if flash:
+            fn = serve_prefill_batch_fn(donate=False)
+            args = (params, cfg_tuple, kv.cache_k, kv.cache_v,
+                    np.zeros(1, np.int32), prompt[None],
+                    np.asarray([P], np.int32), np.zeros(1, np.float32),
+                    np.zeros(1, np.int32), key[None])
+        else:
+            fn = serve_prefill_fn(donate=False)
+            args = (params, cfg_tuple, kv.cache_k, kv.cache_v,
+                    np.int32(0), prompt, np.int32(P),
+                    np.float32(0.0), np.int32(0), key)
+        out = fn(*args)
+        jax.block_until_ready(out[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out[0])
+        return round((time.perf_counter() - t0) / iters * 1e3, 3)
+
+    scan_ms = time_prefill(False)
+    flash_ms = time_prefill(True)
+    return {
+        "decode": decode_rows,
+        "prefill": {"prompt_len": P, "scan_ms": scan_ms,
+                    "flash_ms": flash_ms,
+                    "flash_speedup": (round(scan_ms / flash_ms, 3)
+                                      if flash_ms else None)},
+    }
 
 
 _SWEEP_FILE = os.path.join(_HERE, "SWEEP_BERT_BASE.json")

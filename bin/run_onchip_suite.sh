@@ -57,9 +57,15 @@ run calibration 3600 python -m hetu_tpu.planner.chip_calibration
 HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 
 # 4c. continuous-batching engine vs static batching on the seeded
-#     mixed-length trace (BENCH_SERVE.json: both rates + TTFT p50/p99 +
-#     occupancy; runs after decode so the scan compile is already in
-#     the shared compilation cache)
+#     mixed-length trace, PLUS the serving fast-path A/B — masked
+#     reference vs ragged (flash prefill + paged decode kernel) on the
+#     mixed AND prefill-heavy traces with per-phase prefill/decode
+#     timings, and the phase micro A/B (decode step at 25%/50% fill,
+#     prefill scan-vs-flash at P=128) — all in one invocation
+#     (BENCH_SERVE.json fast_path_ab / prefill_heavy / phase_ab; this
+#     on-chip run is the A/B of record — the CPU harness emulates the
+#     kernels in interpret mode).  Runs after decode so the scan
+#     compile is already in the shared compilation cache.
 HETU_BENCH_SERVE=1 run serve 3600 python bench.py
 
 # 5. long-context tile tuning: A/B a couple of block shapes at 32k

@@ -13,3 +13,9 @@ try:
     __all__.append("flash_attention")
 except ImportError:  # pallas unavailable: call sites fall back to jnp paths
     pass
+
+try:
+    from . import decode_attention  # noqa: F401
+    __all__.append("decode_attention")
+except ImportError:  # pallas unavailable: serving falls back to masked
+    pass

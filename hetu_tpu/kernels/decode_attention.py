@@ -1,0 +1,163 @@
+"""Ragged paged decode-attention Pallas kernel for the serving engine.
+
+One fused decode step attends q_len=1 per cache slot over that slot's
+OWN filled prefix.  The masked reference path (``_decode_step``'s
+einsum) streams and masks the full padded ``S_max`` for every slot, so
+a slot holding 80 tokens in a 2048-position bucket pays ~25x the
+attention FLOPs and KV DMA it needs.  This kernel makes the step scale
+with actual tokens: grid (slots, kv_blocks), per-slot filled lengths
+ride in as SCALAR-PREFETCH (``PrefetchScalarGridSpec``) so the kv
+block-index map can see them — blocks wholly past a slot's filled
+length map back to its LAST LIVE block (flash_attention's
+``_causal_kv_index`` revisit trick: a repeated index skips the DMA
+entirely), and their compute is separately skipped with ``@pl.when``.
+A slot therefore fetches exactly ``ceil(filled / block_k)`` KV blocks,
+and the ragged batch's total traffic is O(sum(filled)) instead of
+O(B * S_max).
+
+The online-softmax accumulators (m, l, acc) live in VMEM scratch and
+persist across the kv steps of one slot (TPU grids execute
+sequentially, kv innermost).  Scores and the output accumulate in f32
+regardless of the cache dtype (bf16 caches keep full-precision
+softmax), matching the flash prefill kernel's accounting.
+
+Decode is inference-only — no VJP.  On non-TPU backends the kernel
+runs in interpret mode, so the same code path is testable on the CPU
+harness (parity suite in tests/test_serve_fastpath.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _fit_block, _prec
+
+_LANES = 128
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, scale, bk, n_kv):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    filled = lens_ref[b]
+
+    # blocks wholly past this slot's filled prefix are dead: their DMA
+    # was already skipped by the revisit index map; skip the compute too
+    @pl.when(j * bk < filled)
+    def _compute():
+        q = q_ref[0, 0]          # [H, Dh]
+        k = k_ref[0]             # [bk, H, Dh]
+        v = v_ref[0]
+        H = q.shape[0]
+        # s[h, s] = q[h] . k[s, h] — per-head matvec, batched over heads
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            precision=_prec(q.dtype),
+            preferred_element_type=jnp.float32) * scale   # [H, bk]
+        kv_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (H, bk), 1)
+        s = jnp.where(kv_pos < filled, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(jnp.clip(m_prev - m_new, max=0.0))
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            precision=_prec(v.dtype),
+            preferred_element_type=jnp.float32)           # [H, Dh]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def paged_decode_attention(q, k, v, lengths, *, block_k=128,
+                           interpret=None):
+    """One decode position per slot over a paged/ragged KV cache.
+
+    q: [B, H, Dh] (this step's query per slot); k, v: [B, S_max, H, Dh]
+    (the cache rows, one per slot — the layer's ``cache_k[i]``);
+    lengths: [B] int32 — positions 0..lengths[b]-1 of slot b are live
+    (the slot's filled count INCLUDING the position just written).
+    Returns o [B, H, Dh] in q's dtype.  Each slot fetches only
+    ``ceil(lengths[b] / block_k)`` KV blocks; a slot with lengths 0
+    returns zeros (matching the masked reference's fully-dead-row
+    convention).
+    """
+    B, H, Dh = q.shape
+    S = k.shape[1]
+    bk = _fit_block(block_k, S)
+    n_kv = S // bk
+    scale = Dh ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+
+    def kv_idx(b, j, lens_ref):
+        # dead blocks revisit the slot's last live block: the repeated
+        # index skips the DMA (same trick as _causal_kv_index)
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bk
+        return (b, jnp.minimum(j, last), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, H, Dh), lambda b, j, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H, Dh),
+                               lambda b, j, lens: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((H, _LANES), jnp.float32),   # running denom
+            pltpu.VMEM((H, Dh), jnp.float32),       # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bk=bk, n_kv=n_kv),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, Dh), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q[:, None], k, v)
+    return out[:, 0]
+
+
+def masked_decode_reference(q, k, v, lengths):
+    """Exact masked-``S_max`` oracle (f32) for the parity suite: the
+    same arithmetic ``_decode_step``'s einsum path runs, minus the
+    compute-dtype shortcuts."""
+    S = k.shape[1]
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
+    live = jnp.arange(S)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32))
+    return out * (lengths > 0)[:, None, None]

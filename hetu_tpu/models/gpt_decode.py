@@ -32,12 +32,41 @@ server's two jitted entry points over the same arithmetic.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def _pow2(n, floor=1):
+    """Smallest power of two >= max(n, floor) (kv_manager.round_up_pow2
+    re-exports this shape policy; duplicated here to keep models ->
+    serving import-free)."""
+    n = max(int(n), int(floor))
+    return 1 << (n - 1).bit_length()
+
+
+def _resolve_fast(mode=None):
+    """Serving fast-path selection, shared by ``generate_fast`` and the
+    serving engine: an explicit argument wins; else ``$HETU_SERVE_FAST``
+    ("1" forces the flash-prefill + ragged-decode kernels, "0" forces
+    the masked/scan reference); else auto — fast on TPU, reference
+    elsewhere.  Off-TPU the fast kernels run in interpret mode: correct
+    (the parity suite pins it) but emulated, so the reference path
+    stays the off-TPU default."""
+    if mode is None:
+        mode = os.environ.get("HETU_SERVE_FAST", "auto")
+    if isinstance(mode, bool):
+        return mode
+    s = str(mode).strip().lower()
+    if s in ("1", "on", "true", "fast", "ragged", "flash"):
+        return True
+    if s in ("0", "off", "false", "masked", "scan", "slow"):
+        return False
+    return jax.default_backend() == "tpu"
 
 
 def _ln(x, scale, bias, eps=1e-5):
@@ -60,7 +89,8 @@ def _gelu_tanh(x):
         0.7978845608028654 * (x + 0.044715 * x ** 3)))
 
 
-def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
+def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
+                 attn="masked"):
     """One incremental position: token [B] int32 at position ``pos``.
     Returns (logits [B, V], new cache_k, new cache_v).
 
@@ -68,13 +98,22 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
     position) OR an int32 [B] vector (serving: every slot decodes at its
     own filled length).  Scalar positions keep the contiguous
     dynamic_update_slice write; vector positions scatter one row per
-    slot and mask attention per slot."""
+    slot and mask attention per slot.
+
+    ``attn`` (static) picks the attention implementation: "masked"
+    streams the full padded S_max and masks (the reference), "ragged"
+    runs the paged decode kernel — each slot fetches only
+    ceil(filled/block_k) KV blocks (kernels/decode_attention.py)."""
     name, L, H, Dh, S_max = cfg_tuple
     B = token.shape[0]
     hdim = H * Dh
     per_slot = jnp.ndim(pos) > 0
     h = params[f"{name}_wte_table"][token] + params[f"{name}_wpe"][pos]
 
+    if attn == "ragged":
+        from ..kernels.decode_attention import paged_decode_attention
+        lens = ((pos + 1).astype(jnp.int32) if per_slot
+                else jnp.full((B,), pos + 1, jnp.int32))
     if per_slot:
         live = jnp.arange(S_max)[None, None, :] <= pos[:, None, None]
         bidx = jnp.arange(B)
@@ -100,10 +139,13 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token):
                 cache_v, v[None, :, None], (i, 0, pos, 0, 0))
         ks = cache_k[i]                                    # [B,S,H,Dh]
         vs = cache_v[i]
-        s = jnp.einsum("bhd,bshd->bhs", q, ks) * (Dh ** -0.5)
-        s = jnp.where(live, s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhs,bshd->bhd", p, vs).reshape(B, hdim)
+        if attn == "ragged":
+            o = paged_decode_attention(q, ks, vs, lens).reshape(B, hdim)
+        else:
+            s = jnp.einsum("bhd,bshd->bhs", q, ks) * (Dh ** -0.5)
+            s = jnp.where(live, s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhs,bshd->bhd", p, vs).reshape(B, hdim)
         o = o @ params[f"{us}_attn_proj_weight"] \
             + params[f"{us}_attn_proj_bias"]
         h = h + o
@@ -223,6 +265,114 @@ def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
     return jnp.concatenate([first[:, None], toks.T], axis=1)
 
 
+# --------------------------- flash prefill --------------------------- #
+
+
+def _prefill_forward(params, cfg_tuple, tokens, kv_lens):
+    """ONE full-prompt forward over a bucket-padded token block: every
+    layer's K/V for all positions in one batched pass — the MXU sees
+    [P, D] matmuls instead of P sequential launches of [1, D], and
+    attention is the Pallas flash kernel (causal + kv_lens, so blocks
+    wholly past a row's prompt length skip compute AND DMA).
+
+    tokens: [N, P_b] int32 (positions >= kv_lens[n] are pad — their
+    K/V are deterministic garbage the decode mask never admits before
+    overwrite); kv_lens: [N] int32.  Returns (logits [N, V] f32 at each
+    row's prompt_len-1, ks, vs [L, N, P_b, H, Dh]).
+    """
+    from ..kernels.flash_attention import flash_attention
+    name, L, H, Dh, S_max = cfg_tuple
+    N, P_b = tokens.shape
+    hdim = H * Dh
+    kv_lens = kv_lens.astype(jnp.int32)
+    h = params[f"{name}_wte_table"][tokens] \
+        + params[f"{name}_wpe"][jnp.arange(P_b)][None]
+    ks, vs = [], []
+    for i in range(L):
+        us = f"{name}_h{i}"
+        x = _ln(h, params[f"{us}_ln1_scale"], params[f"{us}_ln1_bias"])
+        q = (x @ params[f"{us}_attn_q_weight"]
+             + params[f"{us}_attn_q_bias"]).reshape(N, P_b, H, Dh)
+        k = (x @ params[f"{us}_attn_k_weight"]
+             + params[f"{us}_attn_k_bias"]).reshape(N, P_b, H, Dh)
+        v = (x @ params[f"{us}_attn_v_weight"]
+             + params[f"{us}_attn_v_bias"]).reshape(N, P_b, H, Dh)
+        o = flash_attention(q, k, v, causal=True, kv_lens=kv_lens)
+        o = o.reshape(N, P_b, hdim) @ params[f"{us}_attn_proj_weight"] \
+            + params[f"{us}_attn_proj_bias"]
+        h = h + o
+        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
+                       + params[f"{us}_ffn_wi_bias"])
+        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
+        h = h + f
+        ks.append(k)
+        vs.append(v)
+    h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
+    last = h[jnp.arange(N), jnp.maximum(kv_lens - 1, 0)]     # [N, hdim]
+    logits = (last @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
+        + params.get(f"{name}_head_bias", 0.0)
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg_tuple", "top_k", "use_eos"))
+def _generate_flash(params, cfg_tuple, prompt_bucket, prompt_len,
+                    temperature, top_k, rng, eos_id=0, pad_id=0,
+                    use_eos=False):
+    """``_generate_scan``'s fast-prefill twin: the prompt phase is ONE
+    batched ``_prefill_forward`` pass (cache positions 0..P_b-1 filled
+    via dynamic_update_slice, first token sampled from the logits at
+    prompt_len-1), and the scan runs DECODE-ONLY steps — positions
+    inside the prompt are skipped with lax.cond instead of
+    teacher-forced one token at a time.  Compiles per (B, S_max, P_b)
+    with P_b pow2-bucketed by the caller; greedy outputs match the
+    teacher-forced scan (same per-position arithmetic, batched).
+
+    Returns (first_gen [B] — the token at position prompt_len — and
+    toks [B, S_max-1] where toks[:, t] is the token at position t+1,
+    junk for t < prompt_len; the caller overlays the prompt)."""
+    name, L, H, Dh, S_max = cfg_tuple
+    B, P_b = prompt_bucket.shape
+    cdtype = params[f"{name}_wte_table"].dtype
+    logits, ks, vs = _prefill_forward(
+        params, cfg_tuple, prompt_bucket,
+        jnp.broadcast_to(prompt_len, (B,)))
+    cache_k = jax.lax.dynamic_update_slice(
+        jnp.zeros((L, B, S_max, H, Dh), cdtype), ks.astype(cdtype),
+        (0, 0, 0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(
+        jnp.zeros((L, B, S_max, H, Dh), cdtype), vs.astype(cdtype),
+        (0, 0, 0, 0, 0))
+    rng, sub = jax.random.split(rng)
+    first_gen = _sample(logits, temperature, top_k, sub)
+    done0 = (first_gen == eos_id) if use_eos else jnp.zeros((B,), bool)
+
+    def step(carry, t):
+        def live_step(carry):
+            cache_k, cache_v, token, rng, done = carry
+            logits, cache_k, cache_v = _decode_step(
+                params, cfg_tuple, cache_k, cache_v, t, token)
+            rng, sub = jax.random.split(rng)
+            sampled = _sample(logits, temperature, top_k, sub)
+            nxt = jnp.where(done, jnp.int32(pad_id), sampled)
+            if use_eos:
+                done = done | (sampled == eos_id)
+            return (cache_k, cache_v, nxt, rng, done), nxt
+
+        skip = t < prompt_len
+        if use_eos:
+            skip = skip | jnp.all(carry[4])
+        return jax.lax.cond(
+            skip, lambda c: (c, jnp.full((B,), pad_id, jnp.int32)),
+            live_step, carry)
+
+    _, toks = jax.lax.scan(
+        step, (cache_k, cache_v, first_gen, rng, done0),
+        jnp.arange(S_max - 1))
+    return first_gen, toks.T
+
+
 # ------------------------- serving entry points ------------------------- #
 #
 # The continuous-batching server (hetu_tpu/serving/engine.py) drives the
@@ -269,17 +419,43 @@ def _serve_prefill(params, cfg_tuple, cache_k, cache_v, slot, prompt,
     return first, cache_k, cache_v, rng_key
 
 
+def _serve_prefill_batch(params, cfg_tuple, cache_k, cache_v, slots,
+                         prompts, prompt_lens, temperature, top_k,
+                         rng_keys):
+    """Flash prefill of a BUCKETED GROUP of admissions in one dispatch:
+    ``_prefill_forward`` computes every layer's K/V for all N prompts
+    at once, the rows scatter into their cache slots, and each request
+    samples its first token from its own rng stream.  slots [N] int32;
+    prompts [N, P_b]; prompt_lens/temperature/top_k [N]; rng_keys
+    [N, 2].  The engine pads a group to a pow2 N by REPLICATING entry 0
+    (duplicate scatter indices write identical values, so the pad rows
+    are order-safe no-ops).  Returns (first_tokens [N], cache_k,
+    cache_v, new_rng_keys)."""
+    N, P_b = prompts.shape
+    logits, ks, vs = _prefill_forward(params, cfg_tuple, prompts,
+                                      prompt_lens)
+    cdtype = cache_k.dtype
+    cache_k = cache_k.at[:, slots, :P_b].set(ks.astype(cdtype))
+    cache_v = cache_v.at[:, slots, :P_b].set(vs.astype(cdtype))
+    splits = jax.vmap(jax.random.split)(rng_keys)          # [N,2,2]
+    new_keys, subs = splits[:, 0], splits[:, 1]
+    first = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
+    return first, cache_k, cache_v, new_keys
+
+
 def _serve_decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
-                       temperature, top_k, rng_keys):
+                       temperature, top_k, rng_keys, attn="masked"):
     """One fused decode step over ALL slots: slot b consumes ``token[b]``
     at its own position ``pos[b]`` (per-slot attention masking inside
     ``_decode_step``) and samples its next token from its own rng
     stream — outputs depend only on each request's (prompt, seed,
     settings), never on slot assignment or batch company.  Free slots
     ride along harmlessly: their frozen-position writes land in rows the
-    next prefill/decode overwrites before any mask admits them."""
+    next prefill/decode overwrites before any mask admits them.
+    ``attn`` (static): "masked" reference or the "ragged" paged decode
+    kernel (per-slot filled lengths bound the KV blocks fetched)."""
     logits, cache_k, cache_v = _decode_step(
-        params, cfg_tuple, cache_k, cache_v, pos, token)
+        params, cfg_tuple, cache_k, cache_v, pos, token, attn=attn)
     splits = jax.vmap(jax.random.split)(rng_keys)          # [B,2,2]
     new_keys, subs = splits[:, 0], splits[:, 1]
     sampled = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
@@ -299,12 +475,25 @@ def serve_prefill_fn(donate=True):
 
 
 @functools.lru_cache(maxsize=None)
-def serve_decode_fn(donate=True):
-    """Jitted ``_serve_decode_step`` (see ``serve_prefill_fn``)."""
+def serve_prefill_batch_fn(donate=True):
+    """Jitted ``_serve_prefill_batch`` — the fast path's admission
+    dispatch (see ``serve_prefill_fn`` for the donation rationale).
+    Compiles per (group bucket N, prompt bucket P_b) pair; both are
+    pow2-bucketed by the engine, so the ladder bounds the cache."""
     kw = {"static_argnames": ("cfg_tuple",)}
     if donate:
         kw["donate_argnums"] = (2, 3)
-    return jax.jit(_serve_decode_step, **kw)
+    return jax.jit(_serve_prefill_batch, **kw)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_decode_fn(donate=True, attn="masked"):
+    """Jitted ``_serve_decode_step`` (see ``serve_prefill_fn``)."""
+    kw = {"static_argnames": ("cfg_tuple", "attn")}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    fn = jax.jit(_serve_decode_step, **kw)
+    return functools.partial(fn, attn=attn)
 
 
 def _infer_name(params, name=None):
@@ -358,7 +547,7 @@ def tp_shard_params(params, mesh, config, axis="tp", name=None):
 
 def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
                   top_k=0, seed=0, name=None, dtype=None, eos_id=None,
-                  pad_id=0):
+                  pad_id=0, prefill=None):
     """KV-cached generation.
 
     params: {name: array} (e.g. ``executor.var_values`` — pass it
@@ -372,7 +561,12 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
       eos_id: a row that samples this id past its prompt emits it, then
       ``pad_id`` for the rest of the requested span (and per-step
       compute short-circuits once every row is done) — both traced, so
-      different EOS/pad ids share one compile.
+      different EOS/pad ids share one compile; prefill: "flash" runs
+      the prompt as ONE batched full-prompt pass (Pallas flash
+      attention, pow2-bucketed prompt length), "scan" teacher-forces it
+      token by token inside the scan (the reference), default consults
+      ``$HETU_SERVE_FAST`` then auto-selects flash on TPU — greedy
+      outputs are identical either way.
       Returns [B, P + num_tokens] numpy int32.
     """
     prompts = np.asarray(prompts, np.int32)
@@ -393,15 +587,28 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
     Dh = c.hidden_size // c.num_attention_heads
     cfg_tuple = (name, c.num_hidden_layers, c.num_attention_heads,
                  Dh, S_max)
-    pad = np.zeros((B, S_max), np.int32)
-    pad[:, :P] = prompts
     dtype = dtype or jnp.float32
     params = {k: _prep_param(v, dtype)
               for k, v in params.items() if k.startswith(name + "_")}
+    common = dict(eos_id=jnp.int32(-1 if eos_id is None else eos_id),
+                  pad_id=jnp.int32(pad_id), use_eos=eos_id is not None)
+    if _resolve_fast(prefill):
+        P_b = min(_pow2(P, floor=8), S_max)
+        padb = np.zeros((B, P_b), np.int32)
+        padb[:, :P] = prompts
+        first, toks = _generate_flash(
+            params, cfg_tuple, jnp.asarray(padb), jnp.int32(P),
+            jnp.float32(temperature), int(top_k),
+            jax.random.PRNGKey(seed), **common)
+        out = np.zeros((B, total), np.int32)
+        out[:, :P] = prompts
+        out[:, P] = np.asarray(first)
+        if total > P + 1:
+            out[:, P + 1:] = np.asarray(toks)[:, P:total - 1]
+        return out
+    pad = np.zeros((B, S_max), np.int32)
+    pad[:, :P] = prompts
     out = _generate_scan(params, cfg_tuple, jnp.asarray(pad),
                          jnp.int32(P), jnp.float32(temperature),
-                         int(top_k), jax.random.PRNGKey(seed),
-                         eos_id=jnp.int32(-1 if eos_id is None else eos_id),
-                         pad_id=jnp.int32(pad_id),
-                         use_eos=eos_id is not None)
+                         int(top_k), jax.random.PRNGKey(seed), **common)
     return np.asarray(out[:, :total])

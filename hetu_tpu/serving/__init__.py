@@ -16,6 +16,15 @@ compiled arithmetic — with the offline path.
                   cache pair, pow2-bucketed shapes
     request.py    Request / Result dataclasses
     metrics.py    ServingMetrics: TTFT, tok/s, occupancy; JSONL events
+                  (per-step prefill_ms/decode_ms attribution)
+
+Both phases have a ragged fast path (``fast_path=``/``$HETU_SERVE_FAST``,
+auto-on on TPU): admission prefills whole same-bucket GROUPS in one
+batched flash-attention pass, and the fused decode step runs the paged
+decode-attention kernel (kernels/decode_attention.py) so each slot
+fetches only ceil(filled/block_k) KV blocks instead of streaming all of
+S_max.  The masked/scan path remains the reference — greedy outputs are
+token-identical between the two.
 
 Quickstart (greedy results are token-identical to ``generate_fast``):
 

@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from ..models.gpt_decode import (
-    _infer_name, _prep_param, serve_decode_fn, serve_prefill_fn,
+    _infer_name, _prep_param, _pow2, _resolve_fast, serve_decode_fn,
+    serve_prefill_batch_fn, serve_prefill_fn,
 )
 from .kv_manager import KVCacheManager
 from .metrics import ServingMetrics
@@ -56,7 +57,14 @@ class ServingEngine:
     JSONL event stream (default ``$HETU_SERVE_LOG``); donate: donate the
     cache pair to the jitted steps so XLA updates it in place (default
     True — without it every step copies the whole cache, ~3ms per 100MB;
-    measured 320x on the scatter alone on the CPU harness).
+    measured 320x on the scatter alone on the CPU harness); fast_path:
+    True runs the ragged serving fast path — flash prefill (one batched
+    full-prompt pass per admission group) + the paged decode-attention
+    kernel (each slot fetches only ceil(filled/block_k) KV blocks
+    instead of streaming all of S_max) — False the masked/scan
+    reference, default consults ``$HETU_SERVE_FAST`` then auto-selects
+    fast on TPU (greedy outputs are identical either way; the parity
+    suite pins it in interpret mode).
 
     Composes with ``tp_shard_params``: pass the placed dict and the
     fused step runs tensor-parallel (``_prep_param`` preserves the
@@ -65,7 +73,7 @@ class ServingEngine:
 
     def __init__(self, params, config, *, slots=8, queue_limit=64,
                  max_seq_len=None, name=None, dtype=None, log_path=None,
-                 donate=True):
+                 donate=True, fast_path=None):
         c = config
         self._name = _infer_name(params, name)
         dt_ = dtype or jnp.float32
@@ -80,8 +88,15 @@ class ServingEngine:
             dtype=self.params[f"{self._name}_wte_table"].dtype)
         self.cfg_tuple = (self._name, c.num_hidden_layers,
                           c.num_attention_heads, Dh, self.kv.s_max)
+        self.fast_path = _resolve_fast(fast_path)
         self._prefill = serve_prefill_fn(donate)
-        self._decode = serve_decode_fn(donate)
+        self._prefill_batch = (serve_prefill_batch_fn(donate)
+                               if self.fast_path else None)
+        self._decode = serve_decode_fn(
+            donate, "ragged" if self.fast_path else "masked")
+        self.prefill_dispatches = 0   # jitted prefill calls (the
+        # batched-admission win: a burst of k same-bucket arrivals on
+        # the fast path costs ONE dispatch, not k)
         self.queue_limit = int(queue_limit)
         self._queue = collections.deque()
         self.metrics = ServingMetrics(log_path)
@@ -127,41 +142,55 @@ class ServingEngine:
         """One scheduler iteration: admit+prefill into free slots, then
         one fused decode step over every live slot, retiring finished
         sequences as their tokens land.  Returns the Results that
-        completed this iteration."""
+        completed this iteration.
+
+        Admission runs in WAVES: each wave claims every free slot,
+        groups its admissions by prompt-length bucket, and prefills one
+        group per jitted dispatch (fast path — the masked reference
+        keeps its per-request scan); a request that finishes AT prefill
+        frees its slot for the next wave of the same step."""
         done = []
-        # ---- admit: fill every free slot from the queue ---- #
-        while self._queue and self.kv.free_slots:
-            req = self._queue.popleft()
-            P = len(req.prompt)
-            slot = self.kv.alloc(req.request_id, P)
-            pb = self.kv.bucket_prompt(P)
-            prompt = np.zeros(pb, np.int32)
-            prompt[:P] = req.prompt
-            key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
-            first, ck, cv, key = self._prefill(
-                self.params, self.cfg_tuple,
-                self.kv.cache_k, self.kv.cache_v,
-                np.int32(slot), prompt, np.int32(P),
-                np.float32(req.temperature), np.int32(req.top_k), key)
-            self.kv.cache_k, self.kv.cache_v = ck, cv
-            tok0 = int(first)
-            now = time.perf_counter()
-            req.first_token_at = now
-            self._pos[slot] = P
-            self._tok[slot] = tok0
-            self._temp[slot] = req.temperature
-            self._topk[slot] = req.top_k
-            self._keys[slot] = np.asarray(key)
-            self._reqs[slot] = req
-            self._gen[slot] = [tok0]
-            self.metrics.record_admit(
-                req.request_id, slot, now - req.submitted_at,
-                now - req.submitted_at)
-            if req.stream_cb:
-                req.stream_cb(req, tok0)
-            r = self._maybe_finish(slot, tok0)
-            if r:
-                done.append(r)      # frees the slot for this same loop
+        prefill_s = 0.0
+        while True:
+            admits = []
+            while self._queue and self.kv.free_slots:
+                req = self._queue.popleft()
+                admits.append((req, self.kv.alloc(req.request_id,
+                                                  len(req.prompt))))
+            if not admits:
+                break
+            groups = {}
+            for req, slot in admits:
+                pb = self.kv.bucket_prompt(len(req.prompt))
+                groups.setdefault(pb, []).append((req, slot))
+            for pb, group in sorted(groups.items()):
+                t0 = time.perf_counter()
+                if self.fast_path:
+                    firsts, keys = self._prefill_group_flash(pb, group)
+                else:
+                    firsts, keys = self._prefill_group_ref(pb, group)
+                dt = time.perf_counter() - t0
+                prefill_s += dt
+                self.metrics.record_prefill(
+                    len(group), pb, dt, batched=self.fast_path)
+                for (req, slot), tok0, key in zip(group, firsts, keys):
+                    now = time.perf_counter()
+                    req.first_token_at = now
+                    self._pos[slot] = len(req.prompt)
+                    self._tok[slot] = tok0
+                    self._temp[slot] = req.temperature
+                    self._topk[slot] = req.top_k
+                    self._keys[slot] = key
+                    self._reqs[slot] = req
+                    self._gen[slot] = [tok0]
+                    self.metrics.record_admit(
+                        req.request_id, slot, now - req.submitted_at,
+                        now - req.submitted_at)
+                    if req.stream_cb:
+                        req.stream_cb(req, tok0)
+                    r = self._maybe_finish(slot, tok0)
+                    if r:
+                        done.append(r)   # frees the slot: next wave
         # ---- one fused decode step over all live slots ---- #
         live = self.kv.live()
         if live:
@@ -192,8 +221,67 @@ class ServingEngine:
             self.metrics.record_step(
                 live=len(live), slots=self.kv.n_slots,
                 queue_depth=len(self._queue), dt_s=dt,
-                new_tokens=len(live))
+                new_tokens=len(live), prefill_s=prefill_s)
         return done
+
+    # ------------------------------------------------------------- #
+
+    def _prefill_group_ref(self, pb, group):
+        """Reference admission: one teacher-forced prefill scan per
+        request (the pre-fast-path behavior, kept bit-identical)."""
+        firsts, keys = [], []
+        for req, slot in group:
+            P = len(req.prompt)
+            prompt = np.zeros(pb, np.int32)
+            prompt[:P] = req.prompt
+            key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+            first, ck, cv, key = self._prefill(
+                self.params, self.cfg_tuple,
+                self.kv.cache_k, self.kv.cache_v,
+                np.int32(slot), prompt, np.int32(P),
+                np.float32(req.temperature), np.int32(req.top_k), key)
+            self.kv.cache_k, self.kv.cache_v = ck, cv
+            self.prefill_dispatches += 1
+            firsts.append(int(first))
+            keys.append(np.asarray(key))
+        return firsts, keys
+
+    def _prefill_group_flash(self, pb, group):
+        """Fast-path admission: the whole same-bucket group in ONE
+        batched flash-prefill dispatch.  The group size is pow2-bucketed
+        (bounding the compile ladder) by REPLICATING entry 0 into the
+        pad rows — duplicate cache-scatter indices then write identical
+        values, so padding is order-safe and its outputs are simply
+        dropped."""
+        n = len(group)
+        nb = min(_pow2(n), self.kv.n_slots)
+        rows = list(range(n)) + [0] * (nb - n)
+        prompts = np.zeros((nb, pb), np.int32)
+        lens = np.zeros(nb, np.int32)
+        slots = np.zeros(nb, np.int32)
+        temps = np.zeros(nb, np.float32)
+        topks = np.zeros(nb, np.int32)
+        keys = np.zeros((nb, 2), np.uint32)
+        for row, i in enumerate(rows):
+            req, slot = group[i]
+            P = len(req.prompt)
+            prompts[row, :P] = req.prompt
+            lens[row] = P
+            slots[row] = slot
+            temps[row] = req.temperature
+            topks[row] = req.top_k
+            keys[row] = np.asarray(jax.random.PRNGKey(req.seed),
+                                   np.uint32)
+        first, ck, cv, new_keys = self._prefill_batch(
+            self.params, self.cfg_tuple,
+            self.kv.cache_k, self.kv.cache_v,
+            slots, prompts, lens, temps, topks, keys)
+        self.kv.cache_k, self.kv.cache_v = ck, cv
+        self.prefill_dispatches += 1
+        first = np.asarray(first)
+        new_keys = np.array(new_keys, np.uint32)
+        return ([int(first[i]) for i in range(n)],
+                [new_keys[i] for i in range(n)])
 
     def run(self, requests=()):
         """Submit ``requests`` then step until everything (including

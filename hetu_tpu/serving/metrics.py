@@ -37,7 +37,11 @@ class ServingMetrics:
         self.latencies = []        # seconds, submit -> finish
         self.step_live = []        # live slots per fused step
         self.step_queue = []       # queue depth per fused step
-        self.step_dt = []          # seconds per fused step
+        self.step_dt = []          # seconds per fused decode step
+        self.step_prefill = []     # prefill seconds folded into a step
+        self.prefill_dt = []       # seconds per prefill dispatch
+        self.prefill_reqs = 0      # requests prefilled
+        self.prefill_batched = 0   # batched (fast-path) dispatches
         self._slots = None
         self._t0 = None
         self._t_last = None
@@ -81,13 +85,34 @@ class ServingMetrics:
                    queue_wait_s=round(queue_wait_s, 6),
                    ttft_s=round(ttft_s, 6))
 
-    def record_step(self, live, slots, queue_depth, dt_s, new_tokens):
+    def record_prefill(self, n, bucket, dt_s, batched=False):
+        """One prefill dispatch: ``n`` requests admitted in one jitted
+        call (n > 1 only on the batched fast path) at prompt bucket
+        ``bucket``."""
+        self._mark()
+        self.prefill_dt.append(dt_s)
+        self.prefill_reqs += n
+        if batched:
+            self.prefill_batched += 1
+        self.event("serve_prefill", n=n, bucket=bucket,
+                   prefill_ms=round(dt_s * 1e3, 3), batched=bool(batched))
+
+    def record_step(self, live, slots, queue_depth, dt_s, new_tokens,
+                    prefill_s=0.0):
+        """One fused decode step; ``prefill_s`` is the prefill wall time
+        this scheduler iteration paid before decoding, so the per-step
+        JSONL event attributes the phases separately (the masked vs
+        ragged A/B reads these)."""
         self._mark()
         self._slots = slots
         self.step_live.append(live)
         self.step_queue.append(queue_depth)
         self.step_dt.append(dt_s)
+        self.step_prefill.append(prefill_s)
         self.tokens_generated += new_tokens
+        self.event("serve_step", live=live, queue_depth=queue_depth,
+                   prefill_ms=round(prefill_s * 1e3, 3),
+                   decode_ms=round(dt_s * 1e3, 3))
 
     def record_finish(self, request_id, reason, n_generated, latency_s):
         self._mark()
@@ -120,6 +145,16 @@ class ServingMetrics:
                             if self.ttfts else None),
             "step_p50_s": _pct(self.step_dt, 50),
             "step_p99_s": _pct(self.step_dt, 99),
+            "decode_ms_p50": (round(_pct(self.step_dt, 50) * 1e3, 3)
+                              if self.step_dt else None),
+            "prefill_ms_p50": (round(_pct(self.prefill_dt, 50) * 1e3, 3)
+                               if self.prefill_dt else None),
+            "prefill_total_s": (round(float(np.sum(self.prefill_dt)), 6)
+                                if self.prefill_dt else None),
+            "decode_total_s": (round(float(np.sum(self.step_dt)), 6)
+                               if self.step_dt else None),
+            "prefill_dispatches": len(self.prefill_dt),
+            "prefill_batched_dispatches": self.prefill_batched,
             "steps": len(self.step_live),
             "mean_batch_occupancy": (float(np.mean(occ)) if occ else None),
             "mean_queue_depth": (float(np.mean(self.step_queue))

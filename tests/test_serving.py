@@ -315,8 +315,10 @@ class TestServingMetrics:
 def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     """Acceptance: under the seeded mixed-length trace, continuous
     batching measures higher useful-token throughput than the static
-    pad-to-longest baseline on the same harness, and the artifact
-    records both numbers."""
+    pad-to-longest baseline on the same harness, the masked-vs-ragged
+    fast-path A/B records per-phase timings with GREEDY-IDENTICAL
+    outputs, and flash prefill beats the scan prefill at prompt length
+    128 — all recorded in the artifact."""
     import bench
     monkeypatch.setattr(bench, "_SERVE_FILE",
                         str(tmp_path / "BENCH_SERVE.json"))
@@ -327,7 +329,26 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     assert art["speedup"] > 1.0
     assert art["continuous"]["ttft_p50_s"] is not None
     assert art["continuous"]["mean_batch_occupancy"] > 0
+    # fast-path A/B: acceptance is greedy parity + per-phase numbers
+    # (the ragged-vs-masked WIN is an on-chip claim — interpret-mode
+    # emulation pays per-block overhead on CPU; suite stage 4c measures)
+    for section in ("fast_path_ab", "prefill_heavy"):
+        ab = art[section]
+        assert ab["greedy_identical"] is True
+        for path in ("masked", "ragged"):
+            assert ab[path]["tokens_per_sec"] > 0
+            assert ab[path]["prefill_total_s"] is not None
+            assert ab[path]["decode_total_s"] is not None
+    # flash prefill beats the teacher-forced scan at P=128 even on the
+    # CPU harness (the scan pays P sequential [1, D] dispatch rounds)
+    pf = art["phase_ab"]["prefill"]
+    assert pf["prompt_len"] >= 128
+    assert pf["flash_ms"] < pf["scan_ms"], pf
+    assert len(art["phase_ab"]["decode"]) == 2
+    for row in art["phase_ab"]["decode"]:
+        assert row["masked_ms"] > 0 and row["ragged_ms"] > 0
     with open(tmp_path / "BENCH_SERVE.json") as f:
         on_disk = json.load(f)
     assert on_disk["continuous"]["tokens_per_sec"] == cont
     assert on_disk["static_baseline"]["tokens_per_sec"] == stat
+    assert on_disk["fast_path_ab"]["greedy_identical"] is True
