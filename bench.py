@@ -36,6 +36,8 @@ import json
 import os
 import time
 
+from hetu_tpu import envvars
+
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -180,7 +182,7 @@ def _build_lm(batch, seq, hidden, heads, layers_n, vocab, use_flash, mesh,
     # [B*S, vocab] chain doesn't fit.  HETU_BENCH_FUSED_HEAD=1 A/Bs it.
     head_bias = ht.init.zeros((vocab,), name="lm_head_bias")
     flat_labels = ht.array_reshape_op(labels, [batch * seq])
-    if os.environ.get("HETU_BENCH_FUSED_HEAD"):
+    if envvars.get_bool("HETU_BENCH_FUSED_HEAD"):
         loss = ht.reduce_mean_op(
             ht.tied_lm_head_xent_op(h, emb.embedding_table, head_bias,
                                     flat_labels), axes=0)
@@ -223,7 +225,7 @@ def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
     use_flash = (platform == "tpu" and seq >= 1024) or reduced
     # sweep/ablation override: pin the attention impl regardless of the
     # crossover default (HETU_BENCH_SWEEP drives both impls per batch)
-    forced = os.environ.get("HETU_BENCH_FORCE_FLASH")
+    forced = envvars.get_str("HETU_BENCH_FORCE_FLASH")
     if forced is not None:
         use_flash = forced == "1"
     flash_err = None
@@ -343,7 +345,7 @@ def bench_bert_base(platform, reduced):
     The measured round-3 sweep had batch 32 fastest (258.5 vs ~252
     samples/s at 48/64), so probes run 32 first and the winner falls
     back to 32.  Override with HETU_BENCH_BERT_BATCH to pin a batch."""
-    fixed = os.environ.get("HETU_BENCH_BERT_BATCH")
+    fixed = envvars.get_int("HETU_BENCH_BERT_BATCH")
     if fixed is not None or reduced:
         return _bench_lm(platform, reduced, layers_n=12, seq=512,
                          per_chip_batch=int(fixed or 32), iters=10)
@@ -600,12 +602,12 @@ def _ctr_hybrid_once(platform, reduced, *, batch=1024, iters=20,
     # emits bf16 grads, halving BOTH directions of the host link — the
     # link IS the hybrid path's bottleneck (the PS accumulates fp32
     # regardless).  HETU_BENCH_CTR_FP32=1 pins the old full-width wire.
-    mp = None if os.environ.get("HETU_BENCH_CTR_FP32") else "bf16"
+    mp = None if envvars.get_bool("HETU_BENCH_CTR_FP32") else "bf16"
     from hetu_tpu.ps.server import PSServer
     import hetu_tpu.ps.client as psc
     PSServer._instance = None      # each tier gets a fresh server so
     psc.PSClient._instance = None  # neither inherits the other's state
-    if not os.environ.get("HETU_PS_ADDR"):
+    if not envvars.is_set("HETU_PS_ADDR"):
         # BOTH tiers get the C++ van (the cache tier's sync_embedding/
         # push_embedding verbs are van ops too — r5); enable BEFORE the
         # init window so a cold g++ build of the .so is not charged to
@@ -769,10 +771,10 @@ def bench_moe(platform, reduced):
             128, 4, 2
     # chip-fill tuning knobs for the on-chip re-measure (VERDICT r3
     # item 4: the recorded config underfilled the chip)
-    if os.environ.get("HETU_BENCH_MOE_BATCH"):
-        batch = int(os.environ["HETU_BENCH_MOE_BATCH"])
-    if os.environ.get("HETU_BENCH_MOE_TOKENS"):
-        tokens = int(os.environ["HETU_BENCH_MOE_TOKENS"])
+    if envvars.is_set("HETU_BENCH_MOE_BATCH"):
+        batch = envvars.get_int("HETU_BENCH_MOE_BATCH")
+    if envvars.is_set("HETU_BENCH_MOE_TOKENS"):
+        tokens = envvars.get_int("HETU_BENCH_MOE_TOKENS")
     rng = np.random.RandomState(0)
     # device-resident feeds: a 25MB host feed per step would measure the
     # tunnel's H2D, not the MoE step (jax.Arrays pass through the feed
@@ -802,7 +804,7 @@ def bench_moe(platform, reduced):
     # row scatter-add) — the right choice is hardware-generation
     # dependent, so measure rather than assume
     variants = {}
-    saved_env = os.environ.get("HETU_MOE_SCATTER_DISPATCH")
+    saved_env = envvars.get_raw("HETU_MOE_SCATTER_DISPATCH")
     try:
         for name, ep in (("expert_loop", False), ("stacked", True)):
             for dname, denv in (("matmul_dispatch", None),
@@ -894,7 +896,7 @@ def bench_long_context(platform, reduced):
     # block-size override for on-chip tuning sweeps: the 512x1024
     # default was tuned at seq 4-8k; S/cp-sized and 32k chunks may want
     # different tiles (VERDICT r3 item 2)
-    blocks = os.environ.get("HETU_BENCH_LC_BLOCKS")
+    blocks = envvars.get_str("HETU_BENCH_LC_BLOCKS")
     bq, bk = (int(t) for t in blocks.split(",")) if blocks else (512, 1024)
     # record what will actually RUN: the kernel shrinks non-divisor
     # tiles to the largest divisor, and a sweep must not label two
@@ -1356,8 +1358,8 @@ def sweep_bert(platform, reduced, batches=(16, 32, 48, 64)):
     for b, attn, head in grid:
         cell = {"batch": b, "attention": attn, "head": head}
         if reduced:
-            old_flash = os.environ.get("HETU_BENCH_FORCE_FLASH")
-            old_fused = os.environ.get("HETU_BENCH_FUSED_HEAD")
+            old_flash = envvars.get_raw("HETU_BENCH_FORCE_FLASH")
+            old_fused = envvars.get_raw("HETU_BENCH_FUSED_HEAD")
             os.environ["HETU_BENCH_FORCE_FLASH"] = \
                 "1" if attn == "flash" else "0"
             if head == "fused":
@@ -1417,13 +1419,12 @@ def _enable_compile_cache():
     costs 20-40s through the tunnel — sharing compiled programs across
     invocations shrinks the recovery-window cost substantially.
     HETU_BENCH_NO_COMPILE_CACHE=1 opts out."""
-    if os.environ.get("HETU_BENCH_NO_COMPILE_CACHE"):
+    if envvars.get_bool("HETU_BENCH_NO_COMPILE_CACHE"):
         return
     import jax
     try:
         jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("HETU_COMPILE_CACHE_DIR",
-                                         "/tmp/hetu_xla_cache"))
+                          envvars.get_path("HETU_COMPILE_CACHE_DIR"))
     except Exception:
         pass          # older jax without the knob: run uncached
 
@@ -1431,10 +1432,10 @@ def _enable_compile_cache():
 def main():
     platform, bringup_err = _bring_up_backend()
     _enable_compile_cache()
-    reduced = bool(os.environ.get("HETU_BENCH_SMALL")) or \
+    reduced = envvars.get_bool("HETU_BENCH_SMALL") or \
         platform in ("cpu", "cpu-fallback")
 
-    if os.environ.get("HETU_BENCH_DECODE"):
+    if envvars.get_bool("HETU_BENCH_DECODE"):
         art = bench_decode(platform, reduced)
         print(json.dumps({
             "metric": "gpt_decode_tokens_per_sec",
@@ -1447,7 +1448,7 @@ def main():
                {"decode_file": os.path.basename(_DECODE_FILE)})}))
         return
 
-    if os.environ.get("HETU_BENCH_SERVE"):
+    if envvars.get_bool("HETU_BENCH_SERVE"):
         art = bench_serve(platform, reduced)
         cont = art["continuous"]
         print(json.dumps({
@@ -1467,7 +1468,7 @@ def main():
                {"serve_file": os.path.basename(_SERVE_FILE)})}))
         return
 
-    if os.environ.get("HETU_BENCH_CTR_ROWS"):
+    if envvars.get_bool("HETU_BENCH_CTR_ROWS"):
         art = sweep_ctr_rows(platform, reduced)
         best = max((r for r in art["rungs"] if "error" not in r),
                    key=lambda r: r["rows"], default=None)
@@ -1486,7 +1487,7 @@ def main():
                {"rows_file": os.path.basename(_CTR_ROWS_FILE)})}))
         return
 
-    if os.environ.get("HETU_BENCH_SWEEP"):
+    if envvars.get_bool("HETU_BENCH_SWEEP"):
         art = sweep_bert(platform, reduced)
         pv = art.get("planner_validation", {})
         print(json.dumps({
@@ -1504,7 +1505,7 @@ def main():
                {"sweep_file": os.path.basename(_SWEEP_FILE)})}))
         return
 
-    sel = os.environ.get("HETU_BENCH_CONFIGS")
+    sel = envvars.get_str("HETU_BENCH_CONFIGS")
     names = [n.strip() for n in sel.split(",")] if sel else list(_CONFIGS)
     # bert_base FIRST: its batch probes run in subprocesses, which only
     # work before any in-process config initializes (and exclusively

@@ -33,6 +33,31 @@ run() {  # name, timeout_s, cmd... — a re-wedged tunnel mid-stage must
   echo "=== $name rc=$rc ==="
 }
 
+# 00. static gate: lint + a build-time verification pass, BEFORE any
+#     chip time.  The lint is pure-AST (no jax init) and the verifier
+#     builds/validates a representative graph on CPU in seconds; a
+#     miswired tree must cost this stage, not a TPU allocation.
+run lint 300 python bin/hetu_lint.py hetu_tpu/ bench.py bin/
+if grep -q 'finding(s)' "$LOG/lint.log"; then
+  echo "lint gate FAILED — fix findings before burning chip time" >&2
+  exit 1
+fi
+run verify 600 env HETU_VALIDATE=1 JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np, hetu_tpu as ht
+x = ht.placeholder_op("x")
+w = ht.init.xavier_uniform((64, 64), name="vg_w")
+h = ht.relu_op(ht.matmul_op(x, w))
+loss = ht.reduce_mean_op(ht.reduce_mean_op(h, axes=1), axes=0)
+train = ht.optim.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+ex = ht.Executor({"train": [loss, train]})
+ex.run("train", feed_dict={x: np.ones((8, 64), np.float32)})
+print("verify gate OK")
+PYEOF
+if ! grep -q 'verify gate OK' "$LOG/verify.log"; then
+  echo "verification gate FAILED — see $LOG/verify.log" >&2
+  exit 1
+fi
+
 # 0. the rows a mid-capture wedge has previously cost us: the Aug-2
 #    recovery window measured bert_base/bert4l/gpt/resnet18 fresh, then
 #    the tunnel wedged INSIDE ctr_hybrid — so a fresh window banks the

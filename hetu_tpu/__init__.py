@@ -60,6 +60,8 @@ from . import graphboard
 from . import hf
 from . import launcher
 from . import serving
+from . import envvars
+from . import analysis
 
 # MoE / communication op surface
 from .graph.ops_moe import (

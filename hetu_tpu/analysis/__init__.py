@@ -1,0 +1,46 @@
+"""Static analysis: pre-trace graph verification, parallelism checking,
+and the repo lint gate.
+
+Three independent tools that all run BEFORE any jit trace or chip
+allocation, so a miswired graph or a misconfigured plan fails in
+milliseconds with the offending node named instead of as an XLA stack
+dump (or an on-chip crash) minutes later:
+
+- :mod:`.verify` — topo-walk any ``Op`` graph and abstract-eval every
+  node (``jax.eval_shape`` over ``Op.compute``), building a per-node
+  shape/dtype table; raises :class:`~.verify.GraphVerifyError` naming
+  the node, its op type, input shapes/dtypes and producers.  Also
+  detects cycles, duplicate names, dead nodes, f32 creep in bf16
+  subgraphs, and rng-consuming nodes in rng-less traces.
+- :mod:`.shard_check` — validate a graph + mesh + plan statically:
+  comm-op axes exist in the mesh, dp/tp divisibility, pipeline stage
+  sanity, and the static collective-ordering check (the build-time
+  sibling of ``parallel/collective_check.py``).
+- :mod:`.lint` — AST rules over the repo itself (env-var registry
+  discipline, no host calls in ``Op.compute``, no wall-clock/RNG
+  seeding in jitted code, donation on hot-path jits); CLI at
+  ``bin/hetu_lint.py``.
+
+``Executor`` and ``ServingEngine`` run verify + shard_check at build
+when ``HETU_VALIDATE=1`` (default-on under pytest), emitting JSONL
+records in the launcher's failure-log shape (:mod:`.report`).
+"""
+
+from .verify import (GraphVerifyError, VerifyReport, verify_graph,
+                     check_cycles)
+from .shard_check import (ShardCheckError, check_parallelism,
+                          check_mesh_axes, check_divisibility,
+                          check_pipeline_stages, check_stage_assignment,
+                          collective_sequence, check_collective_order_static)
+from .report import emit_records, validation_log_path
+from .integration import validate_executor_build, validate_subgraph_feeds, \
+    validate_serving
+
+__all__ = [
+    "GraphVerifyError", "VerifyReport", "verify_graph", "check_cycles",
+    "ShardCheckError", "check_parallelism", "check_mesh_axes",
+    "check_divisibility", "check_pipeline_stages", "check_stage_assignment",
+    "collective_sequence", "check_collective_order_static",
+    "emit_records", "validation_log_path",
+    "validate_executor_build", "validate_subgraph_feeds", "validate_serving",
+]

@@ -1,0 +1,348 @@
+"""AST lint rules for the repo's JAX/TPU footguns.
+
+Four rules, each born from a real regression class in this codebase:
+
+- ``env-registry`` — every ``HETU_*`` environment read must go through
+  the typed registry (``hetu_tpu/envvars.py``).  Raw
+  ``os.environ["HETU_X"]`` reads scatter defaults and parsing rules
+  across the tree (there were 60 before the registry) and leave knobs
+  undocumented.  Writes (``os.environ["X"] = v``) stay legal: the
+  launcher stamps child environments by design.  Also flags registry
+  getters called with a name the registry does not know.
+- ``np-in-compute`` — no host-library calls (``np.*``) inside
+  ``Op.compute``/``jax_fn``/``collective`` bodies: they either break
+  the jit trace outright or silently materialize on host per call.
+  Static shape/metadata helpers (``np.prod``, ``np.dtype``, ...) are
+  allowed — they run at trace time on python ints.
+- ``time-in-jit`` — no wall-clock reads or global-RNG seeding inside
+  jit-scoped code (``compute``/``jax_fn`` bodies, ``@jax.jit``
+  functions, functions passed to ``jax.jit`` in the same module): the
+  value freezes at trace time and silently never updates again.
+- ``jit-donate`` — hot-path jits (step/decode/prefill functions, which
+  carry caches or optimizer state) must declare donation; without it
+  every call copies the whole carried buffer (measured 320x on the
+  serving cache scatter).
+
+``bin/hetu_lint.py`` is the CLI; ``tests/test_lint_clean.py`` keeps the
+repo itself clean, making the gate permanent tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+RULES = ("env-registry", "np-in-compute", "time-in-jit", "jit-donate")
+
+# trace-safe static/metadata helpers: run on python ints at trace time
+_NP_ALLOWED = frozenset({
+    "prod", "dtype", "issubdtype", "iinfo", "finfo", "shape", "ndim",
+})
+
+# method names whose bodies execute inside a jit trace (Op protocol)
+_TRACE_METHODS = frozenset({"compute", "jax_fn", "collective"})
+
+# wall-clock / global-rng calls that freeze at trace time
+_TIME_CALLS = frozenset({
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("random", "seed"), ("random", "random"),
+})
+_NP_RANDOM = frozenset({"seed", "RandomState", "default_rng", "rand",
+                        "randn", "randint", "random", "uniform",
+                        "normal"})
+
+# jitted-function names that carry donated state on the hot path
+_HOT_JIT_HINTS = ("step", "decode", "prefill")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    msg: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.msg}"
+
+
+def _attr_chain(node):
+    """'os.environ.get' -> ['os', 'environ', 'get'] (or None)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+def _registry_names():
+    try:
+        from ..envvars import REGISTRY
+        return set(REGISTRY)
+    except Exception:
+        return None
+
+
+# --------------------------------------------------------------------- #
+# rule: env-registry
+# --------------------------------------------------------------------- #
+
+def _check_env_registry(tree, path, findings):
+    if os.path.basename(path) == "envvars.py":
+        return
+    registry = _registry_names()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript):
+            chain = _attr_chain(node.value)
+            if chain and chain[-1] == "environ" \
+                    and isinstance(node.ctx, ast.Load):
+                key = _const_str(node.slice)
+                if key and key.startswith("HETU_"):
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset,
+                        "env-registry",
+                        f"raw os.environ[{key!r}] read; use "
+                        f"hetu_tpu.envvars.get_*({key!r})"))
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            is_env_get = (chain[-1] == "get"
+                          and len(chain) >= 2
+                          and chain[-2] == "environ") \
+                or chain[-1] == "getenv"
+            if is_env_get and node.args:
+                key = _const_str(node.args[0])
+                if key and key.startswith("HETU_"):
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset,
+                        "env-registry",
+                        f"raw environ read of {key!r}; use "
+                        f"hetu_tpu.envvars.get_*({key!r})"))
+            # registry getter called with an unregistered literal name
+            if registry is not None and chain[-1].startswith(("get_",
+                                                              "require_",
+                                                              "is_set")) \
+                    and len(chain) >= 2 and chain[-2] == "envvars" \
+                    and node.args:
+                key = _const_str(node.args[0])
+                if key and key.startswith("HETU_") \
+                        and key not in registry:
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset,
+                        "env-registry",
+                        f"{key!r} is not registered in "
+                        f"hetu_tpu/envvars.py"))
+
+
+# --------------------------------------------------------------------- #
+# rules: np-in-compute + time-in-jit
+# --------------------------------------------------------------------- #
+
+def _jitted_function_names(tree):
+    """Names of module-level functions that end up inside jax.jit:
+    decorated with it, or passed to it by name anywhere in the file."""
+    jitted = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                chain = _attr_chain(target)
+                if chain and chain[-1] == "jit":
+                    jitted.add(node.name)
+                if isinstance(dec, ast.Call):
+                    # functools.partial(jax.jit, ...)
+                    for arg in dec.args:
+                        c = _attr_chain(arg)
+                        if c and c[-1] == "jit":
+                            jitted.add(node.name)
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] == "jit":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        jitted.add(arg.id)
+    return jitted
+
+
+def _iter_trace_scopes(tree):
+    """Yield (FunctionDef, why) for every function whose body runs
+    inside a trace: Op protocol methods and jitted functions."""
+    jitted = _jitted_function_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for fn in node.body:
+                if isinstance(fn, ast.FunctionDef) \
+                        and fn.name in _TRACE_METHODS:
+                    yield fn, f"{node.name}.{fn.name}"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in jitted:
+                yield node, f"jitted fn {node.name}"
+
+
+def _check_trace_bodies(tree, path, findings):
+    seen = set()
+    for fn, why in _iter_trace_scopes(tree):
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain:
+                continue
+            root = chain[0]
+            if root in ("np", "numpy"):
+                if len(chain) >= 3 and chain[1] == "random" \
+                        and chain[2] in _NP_RANDOM:
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset,
+                        "time-in-jit",
+                        f"{'.'.join(chain)} inside {why}: host RNG "
+                        f"state freezes at trace time; use tc.rng_for/"
+                        f"jax.random"))
+                elif chain[-1] not in _NP_ALLOWED:
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset,
+                        "np-in-compute",
+                        f"host call {'.'.join(chain)} inside {why}: "
+                        f"breaks the trace or materializes on host "
+                        f"per step; use jnp"))
+            elif tuple(chain[:2]) in _TIME_CALLS:
+                findings.append(Finding(
+                    path, node.lineno, node.col_offset, "time-in-jit",
+                    f"{'.'.join(chain)} inside {why}: the value "
+                    f"freezes at trace time and never updates"))
+
+
+# --------------------------------------------------------------------- #
+# rule: jit-donate
+# --------------------------------------------------------------------- #
+
+def _check_jit_donate(tree, path, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain or chain[-1] != "jit":
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue
+        name = node.args[0].id.lower()
+        if not any(h in name for h in _HOT_JIT_HINTS):
+            continue
+        kw_names = {k.arg for k in node.keywords}
+        if None in kw_names:
+            continue    # **kwargs expansion: donation decided upstream
+        if not kw_names & {"donate_argnums", "donate_argnames"}:
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "jit-donate",
+                f"jax.jit({node.args[0].id}) on a hot-path function "
+                f"without donate_argnums/donate_argnames: every call "
+                f"copies the carried state (cache/params) instead of "
+                f"updating in place"))
+
+
+# --------------------------------------------------------------------- #
+# driver
+# --------------------------------------------------------------------- #
+
+_RULE_FNS = {
+    "env-registry": _check_env_registry,
+    "np-in-compute": _check_trace_bodies,   # shares a walker with
+    "time-in-jit": _check_trace_bodies,     # time-in-jit
+    "jit-donate": _check_jit_donate,
+}
+
+
+def lint_source(src, path="<string>", rules=RULES):
+    """Lint one source string; returns [Finding]."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, e.offset or 0, "syntax",
+                        f"cannot parse: {e.msg}")]
+    findings = []
+    ran = set()
+    for rule in rules:
+        fn = _RULE_FNS[rule]
+        if id(fn) in ran:
+            continue
+        ran.add(id(fn))
+        fn(tree, path, findings)
+    rules = set(rules)
+    return [f for f in findings if f.rule in rules or f.rule == "syntax"]
+
+
+def lint_file(path, rules=RULES):
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path=path, rules=rules)
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py"):
+            yield p
+
+
+def lint_paths(paths, rules=RULES):
+    findings = []
+    for f in iter_py_files(paths):
+        findings.extend(lint_file(f, rules=rules))
+    return findings
+
+
+def main(argv=None):
+    """CLI: ``hetu_lint.py [--rules r1,r2] [--env-table] paths...``.
+    Exits non-zero when findings exist; ``--env-table`` prints the
+    generated env-var documentation table and exits."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="hetu_lint",
+        description="AST lint gate for hetu_tpu (env registry, host "
+                    "calls in compute, wall-clock in jit, hot-path "
+                    "donation)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--rules", default=",".join(RULES),
+                    help=f"comma-separated subset of {RULES}")
+    ap.add_argument("--env-table", action="store_true",
+                    help="print the HETU_* env-var markdown table "
+                         "generated from hetu_tpu/envvars.py and exit")
+    args = ap.parse_args(argv)
+    if args.env_table:
+        from ..envvars import env_table
+        print(env_table())
+        return 0
+    rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+    unknown = [r for r in rules if r not in RULES]
+    if unknown:
+        ap.error(f"unknown rule(s) {unknown}; choose from {RULES}")
+    if not args.paths:
+        ap.error("no paths given")
+    findings = lint_paths(args.paths, rules=rules)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} finding(s)")
+        return 1
+    return 0

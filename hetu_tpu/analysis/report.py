@@ -1,0 +1,41 @@
+"""JSONL reporting for the static checks, in the launcher's record shape.
+
+One contract across the repo (PR 1's event-log convention,
+``launcher.py _event`` / ``serving/metrics.py event``): every record is
+``{"t": <epoch seconds, 3 decimals>, "event": <kind>, **fields}``
+appended as one JSON line, so the same ``tail -f | jq`` pipeline reads
+failure events, serving telemetry, and (now) verifier reports.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from .. import envvars
+
+
+def validation_log_path():
+    """The JSONL sink for verifier/shard-check records, or None."""
+    return envvars.get_path("HETU_VALIDATE_LOG")
+
+
+def make_record(event, **fields):
+    """One launcher-shaped record: {"t": ..., "event": event, **fields}."""
+    return {"t": round(time.time(), 3), "event": event, **fields}
+
+
+def emit_records(records, path=None):
+    """Append records (dicts from :func:`make_record`) to ``path`` or
+    ``$HETU_VALIDATE_LOG``.  Best-effort: an unwritable log must never
+    take down a build that validated fine."""
+    path = path if path is not None else validation_log_path()
+    if not path or not records:
+        return records
+    try:
+        with open(path, "a") as f:
+            for rec in records:
+                f.write(json.dumps(rec, default=str) + "\n")
+    except OSError:
+        pass
+    return records

@@ -1,0 +1,322 @@
+"""Static parallelism checker: graph + mesh + plan, validated pre-chip.
+
+Auto-parallel systems (Galvatron, Alpa — PAPERS.md) validate a
+placement/partition plan BEFORE committing it to devices; hand-written
+plans deserve the same guarantee.  Today a bad mesh axis, a non-divisible
+dp/tp split, or an uneven pipeline assignment only fails on-chip —
+where a debug cycle costs a TPU allocation.  Everything here runs on the
+host in microseconds:
+
+- :func:`check_mesh_axes` — every comm op (``graph/ops_comm.py``)
+  references an axis that exists in the mesh (``parallel/mesh.py``).
+- :func:`check_divisibility` — parameter sharding specs and batch feeds
+  divide evenly over their mesh axes (batch/heads/vocab vs dp/tp).
+- :func:`check_pipeline_stages` — the pipeline partitioner's stage plan
+  is sound for the requested stage count: a uniform body exists, and the
+  layer count splits evenly over the stages.
+- :func:`check_stage_assignment` — explicit per-node stage maps are
+  contiguous and monotone, with cross-stage edges only through comm ops
+  (the reference's PipelineSend/Receive boundary invariant).
+- :func:`check_collective_order_static` — per-group collective sequences
+  agree (the build-time sibling of ``parallel/collective_check.py``,
+  which needs a traced shard_map program; this one needs only the graph).
+
+:func:`check_parallelism` is the umbrella the executor wires in under
+``HETU_VALIDATE=1``: hard violations raise :class:`ShardCheckError`;
+advisory ones come back as findings dicts.
+"""
+
+from __future__ import annotations
+
+from ..graph.node import Op
+from ..graph.ops_comm import (CollectiveOp, PipelineReceiveOp,
+                              PipelineSendOp)
+from ..graph.ops_misc import PlaceholderOp
+
+
+class ShardCheckError(Exception):
+    """A statically-detected parallelism misconfiguration.  ``node`` is
+    the offending Op when attributable; ``kind`` one of ``mesh_axis``,
+    ``divisibility``, ``pipeline``, ``stage_assignment``,
+    ``collective_order``."""
+
+    def __init__(self, message, node=None, kind="mesh_axis"):
+        super().__init__(message)
+        self.node = node
+        self.kind = kind
+
+
+def _comm_nodes(topo):
+    return [n for n in topo
+            if isinstance(n, (CollectiveOp, PipelineSendOp,
+                              PipelineReceiveOp))]
+
+
+def _topo_of(eval_nodes):
+    from ..graph.autodiff import find_topo_sort
+    return find_topo_sort([n for n in eval_nodes if n is not None])
+
+
+# --------------------------------------------------------------------- #
+# mesh-axis existence
+# --------------------------------------------------------------------- #
+
+def check_mesh_axes(eval_nodes, mesh):
+    """Every comm op's axis must name a mesh axis.  Under a shard_map
+    trace a missing axis is a NameError deep in jax; under pjit it makes
+    the op silently a no-op — either way the plan is wrong.  Skipped
+    when there is no mesh (pure single-device jit: comm ops are
+    documented identities there)."""
+    if mesh is None:
+        return []
+    axes = set(mesh.axis_names)
+    comm = _comm_nodes(_topo_of(eval_nodes))
+    for n in comm:
+        axis = getattr(n, "axis", None)
+        if axis is not None and axis not in axes:
+            raise ShardCheckError(
+                f"comm op {n.name} ({type(n).__name__}) references mesh "
+                f"axis {axis!r} but the mesh has axes "
+                f"{tuple(mesh.axis_names)} — the collective would "
+                f"silently no-op under pjit and NameError under "
+                f"shard_map", node=n, kind="mesh_axis")
+    return comm
+
+
+# --------------------------------------------------------------------- #
+# divisibility (dp/tp splits)
+# --------------------------------------------------------------------- #
+
+def check_divisibility(eval_nodes, mesh, feed_shapes=None):
+    """Sharding specs must divide their dims; returns advisory findings
+    for feeds that will silently fall back to replication.
+
+    Hard errors: a variable's ``sharding_spec`` names a missing mesh
+    axis, or shards a dim the axis size does not divide (GSPMD would
+    reject the NamedSharding at placement — on-chip).  Advisory: a
+    batch feed whose dim 0 the 'dp' axis does not divide (the executor
+    silently replicates it, usually a misconfigured global batch)."""
+    findings = []
+    if mesh is None:
+        return findings
+    topo = _topo_of(eval_nodes)
+    shape_by_axis = dict(zip(mesh.axis_names,
+                             mesh.devices.shape))
+    for n in topo:
+        if not isinstance(n, PlaceholderOp):
+            continue
+        spec = getattr(n, "sharding_spec", None)
+        if spec is None or n.shape is None:
+            continue
+        for dim, entry in enumerate(tuple(spec)):
+            if entry is None:
+                continue
+            for axis in (entry if isinstance(entry, (tuple, list))
+                         else (entry,)):
+                size = shape_by_axis.get(axis)
+                if size is None:
+                    raise ShardCheckError(
+                        f"variable {n.name!r} sharding_spec {spec} "
+                        f"names axis {axis!r} absent from mesh axes "
+                        f"{tuple(mesh.axis_names)}", node=n,
+                        kind="divisibility")
+                if dim >= len(n.shape) or n.shape[dim] % size != 0:
+                    dim_sz = n.shape[dim] if dim < len(n.shape) else None
+                    raise ShardCheckError(
+                        f"variable {n.name!r} dim {dim} (size {dim_sz}) "
+                        f"is not divisible by mesh axis {axis!r} "
+                        f"(size {size}) — sharding_spec {spec} cannot "
+                        f"be placed", node=n, kind="divisibility")
+    dp = shape_by_axis.get("dp")
+    if dp and dp > 1:
+        for name, shape in (feed_shapes or {}).items():
+            if shape and len(shape) >= 1 and shape[0] % dp != 0:
+                findings.append({
+                    "kind": "feed_not_dp_divisible", "node": name,
+                    "detail": f"batch dim {shape[0]} % dp {dp} != 0; "
+                              f"the feed will be replicated, not "
+                              f"sharded"})
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# pipeline stage plans
+# --------------------------------------------------------------------- #
+
+def check_pipeline_stages(loss, num_stages, mesh=None, pipeline=None):
+    """Validate the pipeline partition of ``loss`` for ``num_stages``.
+
+    Hard error: the graph has a uniform repeated body of R units but
+    R % num_stages != 0 (uneven stages: the trimmed units silently pile
+    into the 'pre' stage, skewing the balance the schedule assumes).
+    Advisory finding: no uniform body at all (the executor falls back to
+    the trajectory-equivalent microbatch-scan path — correct, but the
+    'pp' mesh axis buys nothing)."""
+    findings = []
+    S = int(num_stages or (mesh.shape.get("pp", 1)
+                           if mesh is not None else 1))
+    if S <= 1:
+        return findings
+    from ..parallel.partition import (find_cuts, _find_periodic_body,
+                                      _make_blocks)
+    from ..graph.autodiff import find_topo_sort
+    topo = find_topo_sort([loss])
+    blocks = _make_blocks(topo, find_cuts(topo))
+    run = _find_periodic_body(blocks, 2)
+    if run is None:
+        findings.append({
+            "kind": "pipeline_no_uniform_body", "node": loss.name,
+            "detail": f"no uniform repeated body found for "
+                      f"{S}-stage pipelining; the microbatch-scan "
+                      f"fallback will run without stage parallelism"})
+        return findings
+    _, units, _ = run
+    if units < S:
+        raise ShardCheckError(
+            f"pipeline plan for {loss.name!r}: only {units} uniform "
+            f"body unit(s) for {S} stages — at least one stage would "
+            f"be empty", node=loss, kind="pipeline")
+    if units % S != 0:
+        raise ShardCheckError(
+            f"pipeline plan for {loss.name!r}: {units} uniform body "
+            f"units do not split evenly over {S} stages "
+            f"({units} % {S} = {units % S}) — the surplus layers would "
+            f"silently fold into the pre-stage and unbalance the "
+            f"schedule; use a layer count divisible by num_stages",
+            node=loss, kind="pipeline")
+    if pipeline not in (None, "gpipe", "1f1b", "pipedream", "hetpipe"):
+        raise ShardCheckError(
+            f"unknown pipeline mode {pipeline!r}", kind="pipeline")
+    return findings
+
+
+def check_stage_assignment(eval_nodes, stage_of, num_stages=None):
+    """Validate an EXPLICIT node -> stage map (hand-written plans).
+
+    - stage ids form a contiguous 0..S-1 range (no empty stages);
+    - monotone: a consumer's stage >= every producer's stage
+      (activations only flow forward);
+    - cross-stage edges go ONLY through pipeline comm ops
+      (PipelineSend/PipelineReceive) and advance exactly one stage —
+      the reference's single-tensor boundary invariant.
+
+    ``stage_of`` maps node or node-name -> int stage."""
+    topo = _topo_of(eval_nodes)
+
+    def stage(n):
+        if n in stage_of:
+            return stage_of[n]
+        return stage_of.get(n.name)
+
+    used = sorted({s for s in (stage(n) for n in topo) if s is not None})
+    if not used:
+        return []
+    S = int(num_stages or (max(used) + 1))
+    if used != list(range(S)):
+        missing = sorted(set(range(S)) - set(used))
+        raise ShardCheckError(
+            f"stage assignment uses stages {used} of 0..{S - 1}: "
+            f"stage(s) {missing} are empty — assignments must be "
+            f"contiguous", kind="stage_assignment")
+    for n in topo:
+        s_n = stage(n)
+        if s_n is None:
+            continue
+        for inp in n.inputs:
+            s_i = stage(inp)
+            if s_i is None or s_i == s_n:
+                continue
+            if s_i > s_n:
+                raise ShardCheckError(
+                    f"stage assignment is not monotone: {n.name} "
+                    f"(stage {s_n}) consumes {inp.name} (stage {s_i}) "
+                    f"— activations cannot flow backward",
+                    node=n, kind="stage_assignment")
+            is_comm = isinstance(n, (PipelineReceiveOp, PipelineSendOp)) \
+                or isinstance(inp, (PipelineSendOp, PipelineReceiveOp))
+            if not is_comm:
+                raise ShardCheckError(
+                    f"cross-stage edge {inp.name} (stage {s_i}) -> "
+                    f"{n.name} (stage {s_n}) bypasses the pipeline comm "
+                    f"ops — only PipelineSend/PipelineReceive may cross "
+                    f"a stage boundary", node=n, kind="stage_assignment")
+            if s_n - s_i != 1:
+                raise ShardCheckError(
+                    f"cross-stage edge {inp.name} -> {n.name} skips "
+                    f"stages ({s_i} -> {s_n}) — pipeline transport is "
+                    f"neighbor-to-neighbor", node=n,
+                    kind="stage_assignment")
+    return []
+
+
+# --------------------------------------------------------------------- #
+# static collective ordering
+# --------------------------------------------------------------------- #
+
+def collective_sequence(eval_nodes, axes=None):
+    """The graph's comm-op sequence in topo order:
+    [(op_class_name, axis), ...], optionally filtered to ``axes``.
+    Under SPMD every device runs this same sequence — recording it makes
+    divergence across separately-built per-stage/per-group programs
+    checkable (:func:`check_collective_order_static`)."""
+    seq = []
+    for n in _comm_nodes(_topo_of(eval_nodes)):
+        axis = getattr(n, "axis", None)
+        if axes is None or axis in axes:
+            seq.append((type(n).__name__, axis))
+    return seq
+
+
+def check_collective_order_static(group_sequences, axes=None):
+    """Every mesh group must issue the SAME collective sequence, or the
+    axis deadlocks (the static sibling of
+    ``parallel.collective_check.check_collective_order``, for graphs
+    built per group/stage rather than one traced shard_map program).
+
+    ``group_sequences``: {group_name: sequence} where a sequence is
+    either a node list (passed through :func:`collective_sequence`) or a
+    pre-extracted [(op, axis), ...] list."""
+    norm = {}
+    for name, seq in group_sequences.items():
+        if seq and isinstance(seq[0], Op):
+            seq = collective_sequence(seq, axes=axes)
+        elif axes is not None:
+            seq = [(op, ax) for op, ax in seq if ax in axes]
+        norm[name] = list(seq)
+    names = list(norm)
+    for other in names[1:]:
+        if norm[other] != norm[names[0]]:
+            raise ShardCheckError(
+                f"collective sequences diverge across mesh groups: "
+                f"{names[0]!r} issues {norm[names[0]] or 'none'} but "
+                f"{other!r} issues {norm[other] or 'none'} — devices "
+                f"disagreeing on the collective order deadlock the "
+                f"axis", kind="collective_order")
+    return norm[names[0]] if names else []
+
+
+# --------------------------------------------------------------------- #
+# umbrella
+# --------------------------------------------------------------------- #
+
+def check_parallelism(eval_nodes, mesh, config=None, feed_shapes=None):
+    """Run every static parallelism check that applies to this graph +
+    mesh + config.  Raises :class:`ShardCheckError` on hard violations;
+    returns advisory findings."""
+    eval_nodes = [n for n in eval_nodes if n is not None]
+    findings = []
+    check_mesh_axes(eval_nodes, mesh)
+    findings += check_divisibility(eval_nodes, mesh,
+                                   feed_shapes=feed_shapes)
+    if config is not None and getattr(config, "pipeline", None):
+        from ..optimizer import OptimizerOp
+        S = getattr(config, "num_stages", None) or (
+            mesh.shape.get("pp", 1) if mesh is not None else 1)
+        losses = [n for n in eval_nodes
+                  if not isinstance(n, OptimizerOp)]
+        has_opt = any(isinstance(n, OptimizerOp) for n in eval_nodes)
+        if has_opt and len(losses) == 1 and S and S > 1:
+            findings += check_pipeline_stages(
+                losses[0], S, mesh=mesh,
+                pipeline=getattr(config, "pipeline", None))
+    return findings

@@ -1,0 +1,338 @@
+"""Pre-trace graph verifier: abstract-eval every node, fail with names.
+
+The reference Hetu hand-writes ``infer_shape`` per op (every
+``gpu_ops/*.py`` file), so a miswired graph fails at BUILD time with the
+offending node named.  Our port replaced that surface with one generic
+``jax.eval_shape`` hook (``graph/node.py Op.infer_shape``) — but nothing
+called it graph-wide, so a shape/dtype mistake surfaced as a jit-trace
+stack dump deep inside XLA with no node attribution.  This module closes
+that gap: :func:`verify_graph` topo-walks any ``Op`` graph and
+abstract-evals each node (shape AND dtype — ``eval_shape`` costs no
+FLOPs and no device), building a per-node table; the first failure
+raises :class:`GraphVerifyError` naming the node, its op type, its input
+shapes/dtypes, and the producing nodes — no jit traceback.
+
+Also detected, because the topo walk sees the whole graph anyway:
+
+- cycles (``find_topo_sort`` silently mis-orders them),
+- duplicate node names (would collide in feeds/params dicts),
+- dead nodes (given the build universe, nodes unreachable from any
+  output — usually a forgotten eval node or a detached adjoint),
+- unexpected f32 creep inside bf16 subgraphs (an op that silently
+  upcasts defeats the mixed-precision policy's MXU savings),
+- rng-consuming nodes (dropout &c.) in traces built without an rng.
+
+Structural problems (cycle/duplicate/shape/rng) raise; advisory ones
+(dead nodes, dtype creep) land in ``VerifyReport.findings`` so callers
+can log them in the launcher's record shape (:mod:`.report`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.node import Op, TraceContext
+from ..graph.ops_misc import PlaceholderOp
+
+# sentinel for "shape unknown until feed time" — propagates through
+# consumers so build-time verification checks everything it CAN see and
+# the run-time pass (concrete feed shapes) covers the rest
+UNKNOWN = "<unknown>"
+
+
+class GraphVerifyError(Exception):
+    """A statically-detected graph defect.  ``node`` is the offending Op
+    (when one is attributable), ``kind`` the defect class: ``cycle``,
+    ``duplicate_name``, ``shape``, ``rng_missing``."""
+
+    def __init__(self, message, node=None, kind="shape"):
+        super().__init__(message)
+        self.node = node
+        self.kind = kind
+
+
+class VerifyReport:
+    """Result of a successful verification.
+
+    ``table`` maps node name -> abstract output (a ShapeDtypeStruct,
+    a pytree of them for multi-output ops, ``UNKNOWN`` for nodes
+    downstream of unshaped feeds, or None for executor-internal nodes
+    like optimizers).  ``findings`` is a list of advisory dicts
+    ({"kind", "node", ...}); ``rng_consumers`` the nodes that drew rng.
+    """
+
+    def __init__(self):
+        self.table = {}
+        self.findings = []
+        self.rng_consumers = []
+
+    def shape_of(self, node):
+        out = self.table.get(node.name if isinstance(node, Op) else node)
+        return tuple(out.shape) if hasattr(out, "shape") else None
+
+    def dtype_of(self, node):
+        out = self.table.get(node.name if isinstance(node, Op) else node)
+        return out.dtype if hasattr(out, "dtype") else None
+
+    def verified_count(self):
+        return sum(1 for v in self.table.values()
+                   if hasattr(v, "shape") or isinstance(v, (tuple, list)))
+
+
+# --------------------------------------------------------------------- #
+# structural checks
+# --------------------------------------------------------------------- #
+
+def check_cycles(eval_nodes):
+    """Iterative 3-color DFS over ``inputs`` edges; raises
+    GraphVerifyError(kind='cycle') naming the cycle's nodes.  Run before
+    ``find_topo_sort`` anywhere correctness matters: its visited-set DFS
+    TERMINATES on a cycle but returns a silently wrong order."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {}
+    for root in eval_nodes:
+        if color.get(id(root), WHITE) != WHITE:
+            continue
+        # stack of (node, input iterator); path tracks the gray chain
+        stack = [(root, iter(root.inputs))]
+        color[id(root)] = GRAY
+        path = [root]
+        while stack:
+            node, it = stack[-1]
+            child = next(it, None)
+            if child is None:
+                stack.pop()
+                path.pop()
+                color[id(node)] = BLACK
+                continue
+            c = color.get(id(child), WHITE)
+            if c == GRAY:
+                start = next(i for i, n in enumerate(path)
+                             if n is child)
+                cyc = " -> ".join(n.name for n in path[start:] + [child])
+                raise GraphVerifyError(
+                    f"cycle in graph: {cyc}", node=child, kind="cycle")
+            if c == WHITE:
+                color[id(child)] = GRAY
+                stack.append((child, iter(child.inputs)))
+                path.append(child)
+
+
+def _topo(eval_nodes):
+    """Cycle-checked topo order (post-order DFS, iterative)."""
+    check_cycles(eval_nodes)
+    from ..graph.autodiff import find_topo_sort
+    return find_topo_sort(eval_nodes)
+
+
+def _check_duplicate_names(topo):
+    seen = {}
+    for n in topo:
+        other = seen.get(n.name)
+        if other is not None and other is not n:
+            raise GraphVerifyError(
+                f"duplicate node name {n.name!r}: {type(other).__name__} "
+                f"and {type(n).__name__} — feeds/params are name-keyed, "
+                f"so one value would silently bind both nodes",
+                node=n, kind="duplicate_name")
+        seen[n.name] = n
+
+
+# --------------------------------------------------------------------- #
+# abstract evaluation
+# --------------------------------------------------------------------- #
+
+class _AbstractParams:
+    """``tc.params`` stand-in: hands back zero arrays shaped like the
+    variable (BatchNorm running stats &c.).  Values are only ever traced
+    under ``eval_shape``, so nothing big is computed — but the arrays ARE
+    materialized host-side; state vars are small by construction."""
+
+    def __getitem__(self, node):
+        shape = tuple(getattr(node, "shape", None) or ())
+        dtype = getattr(node, "dtype", None) or jnp.float32
+        return jnp.zeros(shape, dtype)
+
+    def __contains__(self, node):
+        return getattr(node, "shape", None) is not None
+
+
+class _RecordingTC(TraceContext):
+    """TraceContext that records rng consumption instead of requiring a
+    key — verification must see WHICH nodes need rng even when the trace
+    being modeled has none."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.rng_consumers = []
+
+    def rng_for(self, node):
+        self.rng_consumers.append(node)
+        if self._rng is None:
+            self._rng = jax.random.PRNGKey(0)
+        return super().rng_for(node)
+
+
+def _fmt_aval(v):
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return f"{jnp.dtype(v.dtype).name}{tuple(v.shape)}"
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(_fmt_aval(e) for e in v) + ")"
+    return str(v)
+
+
+def _abstract_eval(node, in_avals, tc):
+    """One node through ``jax.eval_shape`` of its own ``compute`` —
+    the graph-wide driver for the per-op ``infer_shape``/``eval_shape``
+    hook (ops overriding ``infer_shape`` keep shape authority; dtype
+    still comes from the eval)."""
+    out = jax.eval_shape(lambda *a: node.compute(list(a), tc), *in_avals)
+    if type(node).infer_shape is not Op.infer_shape and \
+            hasattr(out, "shape"):
+        # an op with a hand-written infer_shape is the authority on its
+        # shape; cross-check it against the eval so the two hooks can
+        # never silently diverge
+        shapes = [tuple(a.shape) for a in in_avals
+                  if hasattr(a, "shape")]
+        dtypes = [a.dtype for a in in_avals if hasattr(a, "dtype")]
+        declared = tuple(node.infer_shape(shapes, dtypes))
+        if declared != tuple(out.shape):
+            raise GraphVerifyError(
+                f"{node.name} ({type(node).__name__}): infer_shape "
+                f"declares {declared} but compute produces "
+                f"{tuple(out.shape)}", node=node, kind="shape")
+    return out
+
+
+def verify_graph(eval_nodes, *, feed_shapes=None, feed_dtypes=None,
+                 rng_available=True, mixed_precision=None, config=None,
+                 mesh=None, all_nodes=None, skip_ids=frozenset()):
+    """Verify the graph rooted at ``eval_nodes``; returns a
+    :class:`VerifyReport` or raises :class:`GraphVerifyError`.
+
+    feed_shapes/feed_dtypes: name -> shape/dtype for placeholders and
+    dataloader nodes whose shape the graph does not carry (run-time
+    validation passes the concrete feed signature; build-time passes
+    whatever is known and leaves the rest ``UNKNOWN``).
+    rng_available: whether the trace being modeled carries an rng key;
+    rng-consuming nodes without one raise (kind='rng_missing').
+    mixed_precision: the executor's compute-dtype policy — float inputs
+    are modeled in this dtype and f32 creep back is reported.
+    all_nodes: optional build universe; members unreachable from
+    ``eval_nodes`` are reported as dead-node findings.
+    skip_ids: ``id(node)`` set the executor special-cases to None
+    (e.g. IndexedSlices consumed only by the optimizer).
+    """
+    feed_shapes = feed_shapes or {}
+    feed_dtypes = feed_dtypes or {}
+    eval_nodes = [n for n in eval_nodes if n is not None]
+    topo = _topo(eval_nodes)
+    _check_duplicate_names(topo)
+
+    report = VerifyReport()
+    if all_nodes is not None:
+        reachable = {id(n) for n in topo}
+        for n in all_nodes:
+            if id(n) not in reachable:
+                report.findings.append({
+                    "kind": "dead_node", "node": n.name,
+                    "op": type(n).__name__,
+                    "detail": "unreachable from every output"})
+
+    mp = mixed_precision
+    if mp in ("bf16", "bfloat16"):
+        mp = jnp.bfloat16
+    elif mp in ("fp16", "float16"):
+        mp = jnp.float16
+
+    def cast_in(aval):
+        # model the executor's graph-entry cast (float feeds/params
+        # compute in the policy dtype)
+        if mp is not None and hasattr(aval, "dtype") \
+                and jnp.issubdtype(aval.dtype, jnp.floating):
+            return jax.ShapeDtypeStruct(aval.shape, mp)
+        return aval
+
+    tc = _RecordingTC(params=_AbstractParams(), rng=None,
+                      training=True, mesh=mesh, config=config,
+                      step=jnp.zeros((), jnp.int32))
+    tc.rng_ids = {n.id: i for i, n in enumerate(topo)}
+
+    from ..dataloader import DataloaderOp
+    from ..optimizer import OptimizerOp
+
+    avals = {}
+    eval_ids = {id(n) for n in eval_nodes}
+    for node in topo:
+        if isinstance(node, PlaceholderOp):
+            shape = node.shape if node.shape is not None \
+                else feed_shapes.get(node.name)
+            if shape is None:
+                avals[id(node)] = UNKNOWN
+            else:
+                dtype = feed_dtypes.get(node.name) or node.dtype \
+                    or jnp.float32
+                avals[id(node)] = cast_in(
+                    jax.ShapeDtypeStruct(tuple(shape), dtype))
+        elif isinstance(node, DataloaderOp):
+            # shape must come from the caller (the executor passes the
+            # SUBGRAPH's own loader shape — train and validate loaders
+            # behind one DataloaderOp can batch differently)
+            shape = feed_shapes.get(node.name)
+            dtype = feed_dtypes.get(node.name)
+            if shape is None:
+                avals[id(node)] = UNKNOWN
+            else:
+                avals[id(node)] = cast_in(jax.ShapeDtypeStruct(
+                    tuple(shape), dtype or jnp.float32))
+        elif isinstance(node, OptimizerOp) or id(node) in skip_ids:
+            # executor-internal: no dataflow value to type
+            avals[id(node)] = None
+        else:
+            in_avals = [avals[id(i)] for i in node.inputs]
+            if any(a is UNKNOWN or a is None for a in in_avals):
+                avals[id(node)] = UNKNOWN
+                report.table[node.name] = UNKNOWN
+                continue
+            try:
+                out = _abstract_eval(node, in_avals, tc)
+            except GraphVerifyError:
+                raise
+            except Exception as e:  # noqa: BLE001 — any trace failure
+                ins = ", ".join(
+                    f"{i.name}={_fmt_aval(a)}"
+                    for i, a in zip(node.inputs, in_avals))
+                raise GraphVerifyError(
+                    f"graph verification failed at node {node.name!r} "
+                    f"(op {type(node).__name__}) — abstract eval of its "
+                    f"compute raised {type(e).__name__}: {e}\n"
+                    f"  inputs: {ins or '(none)'}\n"
+                    f"  produced by: "
+                    f"{[i.name for i in node.inputs] or '(leaf)'}",
+                    node=node, kind="shape") from e
+            avals[id(node)] = out
+            if mp is not None and hasattr(out, "dtype") \
+                    and out.dtype == jnp.float32 \
+                    and id(node) not in eval_ids \
+                    and any(hasattr(a, "dtype") and a.dtype == mp
+                            for a in in_avals
+                            if hasattr(a, "dtype")):
+                # outputs (losses/metrics) legitimately report f32; an
+                # INTERIOR f32 widening silently defeats the policy
+                report.findings.append({
+                    "kind": "dtype_creep", "node": node.name,
+                    "op": type(node).__name__,
+                    "detail": f"f32 output from "
+                              f"{jnp.dtype(mp).name} inputs"})
+        report.table[node.name] = avals[id(node)]
+
+    report.rng_consumers = [n.name for n in tc.rng_consumers]
+    if not rng_available and tc.rng_consumers:
+        names = sorted({n.name for n in tc.rng_consumers})
+        raise GraphVerifyError(
+            f"nodes {names} consume RNG but the trace is built without "
+            f"an rng key (inference/serving path) — their outputs would "
+            f"assert at trace time", node=tc.rng_consumers[0],
+            kind="rng_missing")
+    return report

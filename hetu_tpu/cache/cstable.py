@@ -37,6 +37,8 @@ import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
+from .. import envvars
+
 import numpy as np
 
 from .cache import EmbeddingCache, merge_sparse
@@ -67,10 +69,9 @@ class CacheSparseTable:
         self.num_pushed_rows = 0
         self.num_synced_rows = 0
         # outage degradation state (module docstring)
-        self.max_stale = int(os.environ.get("HETU_CACHE_MAX_STALE",
-                                            "100"))
-        self.max_backlog_rows = int(os.environ.get(
-            "HETU_CACHE_BACKLOG_ROWS", "100000"))
+        self.max_stale = envvars.get_int("HETU_CACHE_MAX_STALE")
+        self.max_backlog_rows = envvars.get_int(
+            "HETU_CACHE_BACKLOG_ROWS")
         self._outage = 0            # consecutive failed PS RPCs
         self._backlog = (np.zeros(0, np.int64),
                          np.zeros((0, self.width), np.float32))

@@ -13,9 +13,11 @@ import gzip
 import os
 import pickle
 
+from . import envvars
+
 import numpy as np
 
-_DATA_HOME = os.environ.get("HETU_DATA_HOME", os.path.expanduser("~/.hetu_data"))
+_DATA_HOME = envvars.get_path("HETU_DATA_HOME")
 
 
 def one_hot(labels, num_classes):

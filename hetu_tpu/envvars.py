@@ -1,0 +1,342 @@
+"""Central typed registry for every ``HETU_*`` environment variable.
+
+Before this module the repo had ~60 scattered ``os.environ`` reads with
+per-site defaults and per-site parsing (``!= "0"`` here, ``bool(get())``
+there, ``.lower() not in (...)`` elsewhere) — undocumented drift the
+README could not keep up with.  Now every knob is REGISTERED once with a
+type, default, and help string, and every read goes through a typed
+getter; ``bin/hetu_lint.py`` (rule ``env-registry``) rejects any new raw
+``os.environ['HETU_*']`` read outside this file, and ``--env-table``
+regenerates the README's knob table from the registry.
+
+Getters re-read ``os.environ`` on every call (no import-time caching):
+tests and the chaos harness toggle vars at runtime and must observe the
+change.  Reading an UNREGISTERED name raises — adding the registry row
+(one line, with help text) is the price of a new knob.
+
+Boolean parsing is uniform: unset → default; ``"" / 0 / false / no /
+off`` (case-insensitive) → False; anything else → True.  This subsumes
+the three ad-hoc spellings the call sites used to have.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+_FALSY = ("", "0", "false", "no", "off")
+_MISSING = object()
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    type: str          # 'str' | 'int' | 'float' | 'bool' | 'path' | 'list'
+    default: object
+    help: str
+    section: str = "general"
+
+
+REGISTRY: dict[str, EnvVar] = {}
+
+
+def _reg(name, type_, default, help_, section):
+    REGISTRY[name] = EnvVar(name, type_, default, help_, section)
+
+
+# --------------------------------------------------------------------- #
+# static checks (this PR's subsystem)
+# --------------------------------------------------------------------- #
+_reg("HETU_VALIDATE", "bool", False,
+     "Run the pre-trace graph verifier + parallelism checker at executor/"
+     "engine build and before each new feed-shape compile (analysis/). "
+     "Default-on under pytest (tests/conftest.py).", "validate")
+_reg("HETU_VALIDATE_LOG", "path", None,
+     "JSONL sink for verifier/shard-check reports, in the launcher's "
+     "failure-log record shape ({t, event, ...}).", "validate")
+
+# --------------------------------------------------------------------- #
+# multi-process / TPU bring-up
+# --------------------------------------------------------------------- #
+_reg("HETU_TPU_COORDINATOR", "str", None,
+     "jax.distributed coordinator address for multi-host TPU bring-up "
+     "(ht.init() calls jax.distributed.initialize when set).", "cluster")
+_reg("HETU_TPU_NUM_PROCS", "int", 1,
+     "Process count for jax.distributed.initialize.", "cluster")
+_reg("HETU_TPU_PROC_ID", "int", 0,
+     "This process's index for jax.distributed.initialize.", "cluster")
+_reg("HETU_NUM_PROCESSES", "int", 1,
+     "Launcher-stamped world size for jax.distributed bring-up in "
+     "spawned workers.", "cluster")
+_reg("HETU_PROCESS_ID", "int", None,
+     "Launcher-stamped process index (required in launcher-spawned "
+     "multi-process workers).", "cluster")
+
+# --------------------------------------------------------------------- #
+# parameter server: addressing + transport
+# --------------------------------------------------------------------- #
+_reg("HETU_PS_ADDR", "str", None,
+     "host:port of a single PS server; unset = in-process local "
+     "transport.", "ps")
+_reg("HETU_PS_ADDRS", "list", (),
+     "Comma-separated server-group addresses; >1 activates the sharded "
+     "client.", "ps")
+_reg("HETU_PS_PORT", "int", 23455,
+     "Port a PS server binds (serve_from_env) / the launcher's base "
+     "port for sequential server slots.", "ps")
+_reg("HETU_PS_RANK", "int", 0, "This worker's rank for PS traffic.", "ps")
+_reg("HETU_PS_NRANK", "int", 1, "Worker count for PS barriers/SSP.", "ps")
+_reg("HETU_PS_TIMEOUT", "float", 60.0,
+     "Per-RPC timeout (seconds).", "ps")
+_reg("HETU_PS_CONNECT_TIMEOUT", "float", 10.0,
+     "TCP connect timeout (seconds).", "ps")
+_reg("HETU_PS_RETRIES", "int", 3,
+     "Resend attempts before PSConnectionError surfaces.", "ps")
+_reg("HETU_PS_BACKLOG_STEPS", "int", 32,
+     "Max training steps of push traffic buffered through a PS outage "
+     "(direct hybrid path) before the run fails.", "ps")
+_reg("HETU_PS_REPLICATE", "bool", False,
+     "Ring-replicate every key to its backup server ((s+1) % N) and "
+     "fail over on primary loss (sharded client, N > 1).", "ps")
+_reg("HETU_PS_USE_VAN", "bool", True,
+     "Allow the native-van fast tier when the server offers it; 0 pins "
+     "the python wire.", "ps")
+_reg("HETU_PS_VAN", "bool", False,
+     "serve_from_env: start the native van and auto-register "
+     "qualifying tables.", "ps")
+_reg("HETU_PS_VAN_PORT", "int", 0,
+     "Port for the native van listener (0 = ephemeral).", "ps")
+_reg("HETU_PS_VAN_BIND_ALL", "bool", False,
+     "Expose the (authentication-free) van beyond loopback for real "
+     "multi-host deployments.", "ps")
+
+# --------------------------------------------------------------------- #
+# scheduler rendezvous + liveness
+# --------------------------------------------------------------------- #
+_reg("HETU_SCHEDULER_ADDR", "str", None,
+     "host:port of the rendezvous scheduler; servers register, workers "
+     "resolve the group.", "scheduler")
+_reg("HETU_SCHEDULER_PORT", "int", 23454,
+     "Port the scheduler binds (serve_from_env).", "scheduler")
+_reg("HETU_PS_NSERVERS", "int", None,
+     "Expected server-group size for scheduler rendezvous (required "
+     "with HETU_SCHEDULER_ADDR and no static addresses).", "scheduler")
+_reg("HETU_PS_INDEX", "int", 0,
+     "This server's index when registering with the scheduler.",
+     "scheduler")
+_reg("HETU_PS_ADVERTISE", "str", None,
+     "Address a server advertises to the scheduler (default "
+     "hostname:port).", "scheduler")
+_reg("HETU_HEARTBEAT_INTERVAL", "float", 5.0,
+     "Seconds between liveness beats to the scheduler.", "scheduler")
+
+# --------------------------------------------------------------------- #
+# launcher / supervisor
+# --------------------------------------------------------------------- #
+_reg("HETU_SUPERVISE", "bool", True,
+     "heturun supervisor: respawn dead PS servers/workers; 0 restores "
+     "fire-and-wait.", "launcher")
+_reg("HETU_RESTART_LIMIT", "int", 3,
+     "Per-slot restart budget under the supervisor.", "launcher")
+_reg("HETU_RESTART_BACKOFF", "float", 0.5,
+     "Base seconds of exponential restart backoff.", "launcher")
+_reg("HETU_RESTART_COUNT", "int", 0,
+     "Stamped into respawned children (0 = first incarnation); gates "
+     "one-shot chaos kills.", "launcher")
+_reg("HETU_LIVENESS_STALE", "float", 0.0,
+     "> 0: supervisor kills a server whose scheduler heartbeat is "
+     "staler than this many seconds (wedge detection).", "launcher")
+_reg("HETU_FAILURE_LOG", "path", None,
+     "JSONL sink for launcher failure/restart events ({t, event, ...} "
+     "records).", "launcher")
+
+# --------------------------------------------------------------------- #
+# chaos harness
+# --------------------------------------------------------------------- #
+_reg("HETU_CHAOS", "str", None,
+     "Deterministic fault-injection spec for the PS transports "
+     "(ps/faults.py grammar: seed=/drop=/dup=/reset=/delay=/slow=/"
+     "kill=/role=).", "chaos")
+_reg("HETU_CHAOS_ROLE", "str", "",
+     "This process's role tag (server:<idx> / worker:<rank>) for "
+     "role-scoped chaos plans.", "chaos")
+
+# --------------------------------------------------------------------- #
+# embedding cache
+# --------------------------------------------------------------------- #
+_reg("HETU_CACHE_MAX_STALE", "int", 100,
+     "Consecutive failed sync RPCs a cache tolerates before raising.",
+     "cache")
+_reg("HETU_CACHE_BACKLOG_ROWS", "int", 100000,
+     "Max dirty rows buffered through a PS outage before raising.",
+     "cache")
+
+# --------------------------------------------------------------------- #
+# serving
+# --------------------------------------------------------------------- #
+_reg("HETU_SERVE_FAST", "str", "auto",
+     "Serving fast path: 1 forces flash-prefill + ragged decode "
+     "kernels, 0 the masked/scan reference, auto = fast on TPU.",
+     "serving")
+_reg("HETU_SERVE_LOG", "path", None,
+     "JSONL sink for serving engine events (same record shape as "
+     "HETU_FAILURE_LOG).", "serving")
+
+# --------------------------------------------------------------------- #
+# graph/ops knobs
+# --------------------------------------------------------------------- #
+_reg("HETU_MOE_SCATTER_DISPATCH", "bool", False,
+     "MoE dispatch formulation: row scatter-add instead of the GShard "
+     "one-hot matmul (read ONCE at op construction).", "ops")
+
+# --------------------------------------------------------------------- #
+# data / planner
+# --------------------------------------------------------------------- #
+_reg("HETU_DATA_HOME", "path", "~/.hetu_data",
+     "Dataset download/cache directory.", "data")
+_reg("HETU_CALIB_SMALL", "bool", False,
+     "Chip-calibration: reduced ladder for smoke runs.", "planner")
+_reg("HETU_COMPILE_CACHE_DIR", "path", "/tmp/hetu_xla_cache",
+     "Persistent XLA compilation-cache directory for bench runs.",
+     "planner")
+
+# --------------------------------------------------------------------- #
+# bench.py
+# --------------------------------------------------------------------- #
+_reg("HETU_BENCH_SMALL", "bool", False,
+     "Force the reduced (CPU-scale) bench configs.", "bench")
+_reg("HETU_BENCH_CONFIGS", "str", None,
+     "Comma-separated subset of bench matrix configs to run.", "bench")
+_reg("HETU_BENCH_SWEEP", "bool", False,
+     "Run the (batch x attention x head) ablation sweep.", "bench")
+_reg("HETU_BENCH_DECODE", "bool", False,
+     "Run the KV-cached decode benchmark.", "bench")
+_reg("HETU_BENCH_SERVE", "bool", False,
+     "Run the continuous-batching serving benchmark.", "bench")
+_reg("HETU_BENCH_CTR_ROWS", "bool", False,
+     "Run the max-embedding-rows-per-chip ladder.", "bench")
+_reg("HETU_BENCH_CTR_FP32", "bool", False,
+     "CTR hybrid: pin full-width fp32 host-link transfers (default "
+     "ships bf16).", "bench")
+_reg("HETU_BENCH_FORCE_FLASH", "str", None,
+     "Pin the attention impl for sweeps: 1 = flash kernel, 0 = XLA "
+     "batched attention (unset = size-based crossover).", "bench")
+_reg("HETU_BENCH_FUSED_HEAD", "bool", False,
+     "A/B the chunked fused LM head (memory tool) against the "
+     "materialized-logits default.", "bench")
+_reg("HETU_BENCH_BERT_BATCH", "int", None,
+     "Pin the BERT-base per-chip batch instead of probing.", "bench")
+_reg("HETU_BENCH_MOE_BATCH", "int", None,
+     "Override the MoE bench batch (chip-fill tuning).", "bench")
+_reg("HETU_BENCH_MOE_TOKENS", "int", None,
+     "Override the MoE bench tokens-per-sample.", "bench")
+_reg("HETU_BENCH_LC_BLOCKS", "str", None,
+     "Long-context flash tile override, 'bq,bk'.", "bench")
+_reg("HETU_BENCH_NO_COMPILE_CACHE", "bool", False,
+     "Opt out of the persistent XLA compile cache.", "bench")
+
+
+# --------------------------------------------------------------------- #
+# typed getters
+# --------------------------------------------------------------------- #
+
+def _spec(name) -> EnvVar:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unregistered env var {name!r}: every HETU_* knob must be "
+            f"declared in hetu_tpu/envvars.py (one _reg line with type, "
+            f"default, and help text)") from None
+
+
+def _raw(name, default):
+    spec = _spec(name)
+    v = os.environ.get(name)
+    if v is None:
+        return spec.default if default is _MISSING else default
+    return v
+
+
+def is_set(name) -> bool:
+    """True when the var is present AND non-empty in the environment."""
+    _spec(name)
+    return bool(os.environ.get(name))
+
+
+def get_str(name, default=_MISSING):
+    v = _raw(name, default)
+    return v if v is None else str(v)
+
+
+def get_int(name, default=_MISSING):
+    v = _raw(name, default)
+    return v if v is None else int(v)
+
+
+def get_float(name, default=_MISSING):
+    v = _raw(name, default)
+    return v if v is None else float(v)
+
+
+def get_bool(name, default=_MISSING) -> bool:
+    v = _raw(name, default)
+    if isinstance(v, bool) or v is None:
+        return bool(v)
+    return str(v).strip().lower() not in _FALSY
+
+
+def get_path(name, default=_MISSING):
+    v = _raw(name, default)
+    return v if v is None else os.path.expanduser(str(v))
+
+
+def get_list(name, default=_MISSING) -> list:
+    """Comma-separated list; empty items dropped."""
+    v = _raw(name, default)
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [a.strip() for a in str(v).split(",") if a.strip()]
+
+
+def get_raw(name):
+    """The raw environment string (or None), no typing or defaulting —
+    for save/restore of env state around A/B sweeps, where "unset" and
+    "set to the default" must stay distinguishable."""
+    _spec(name)
+    return os.environ.get(name)
+
+
+def require_int(name) -> int:
+    """get_int that raises when the var is unset (launcher contracts)."""
+    _spec(name)
+    if os.environ.get(name) is None:
+        raise EnvironmentError(f"required env var {name} is not set")
+    return int(os.environ[name])
+
+
+# --------------------------------------------------------------------- #
+# documentation table (bin/hetu_lint.py --env-table; README section)
+# --------------------------------------------------------------------- #
+
+def env_table() -> str:
+    """Markdown table of the full registry, grouped by section."""
+    lines = ["| Variable | Type | Default | Description |",
+             "|---|---|---|---|"]
+    by_sec = {}
+    for var in REGISTRY.values():
+        by_sec.setdefault(var.section, []).append(var)
+    for sec in sorted(by_sec):
+        for var in sorted(by_sec[sec], key=lambda v: v.name):
+            d = var.default
+            if d is None:
+                d = "unset"
+            elif isinstance(d, bool):
+                d = "1" if d else "0"
+            elif isinstance(d, (tuple, list)):
+                d = ",".join(d) or "unset"
+            lines.append(f"| `{var.name}` | {var.type} | `{d}` | "
+                         f"{var.help} |")
+    return "\n".join(lines)

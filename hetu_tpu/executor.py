@@ -485,6 +485,11 @@ class SubExecutor:
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feeds.items()))
         if feed_sig not in self._compiled:
+            # pre-trace validation with the concrete feed shapes: a
+            # miswired graph fails HERE with the node named, not as an
+            # XLA stack dump out of the compile below (HETU_VALIDATE=1)
+            from .analysis import validate_subgraph_feeds
+            validate_subgraph_feeds(ex, self, feeds)
             self._compiled[feed_sig] = self._compile(feed_sig)
         fn = self._compiled[feed_sig]
         if ex.mesh is not None:
@@ -730,6 +735,13 @@ class Executor:
                         _ParamView(self.var_values),
                         skip=sub.ps_var_names)
 
+        # static checks (HETU_VALIDATE=1): verify every subgraph's
+        # shapes/dtypes and the mesh/plan BEFORE any trace or chip work;
+        # a defect raises GraphVerifyError/ShardCheckError naming the
+        # node (analysis/integration.py; no-op when validation is off)
+        from .analysis import validate_executor_build
+        validate_executor_build(self)
+
     # ------------------------------------------------------------------ #
     # Hybrid/PS setup + host-side embedding API
     # (reference executor.py:253-258 cache wiring, optimizer.py:145-164
@@ -911,7 +923,8 @@ class Executor:
             else:
                 self.ps_comm.push(name, rows)
         except ConnectionError as e:
-            limit = int(os.environ.get("HETU_PS_BACKLOG_STEPS", "32"))
+            from .envvars import get_int
+            limit = get_int("HETU_PS_BACKLOG_STEPS")
             self._ps_push_backlog.append((kind, name, ids, rows))
             if len(self._ps_push_backlog) > limit:
                 raise PSConnectionError(

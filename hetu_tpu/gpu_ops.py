@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import jax
 
+from . import envvars
+
 _worker_comm = None
 
 
@@ -31,11 +33,11 @@ def wrapped_mpi_nccl_init(init_nccl=True, devices=None):
         def ncclCommInitRank(self):
             pass
 
-    if os.environ.get("HETU_TPU_COORDINATOR"):
+    if envvars.is_set("HETU_TPU_COORDINATOR"):
         jax.distributed.initialize(
-            coordinator_address=os.environ["HETU_TPU_COORDINATOR"],
-            num_processes=int(os.environ.get("HETU_TPU_NUM_PROCS", "1")),
-            process_id=int(os.environ.get("HETU_TPU_PROC_ID", "0")))
+            coordinator_address=envvars.get_str("HETU_TPU_COORDINATOR"),
+            num_processes=envvars.get_int("HETU_TPU_NUM_PROCS"),
+            process_id=envvars.get_int("HETU_TPU_PROC_ID"))
     return _Comm()
 
 

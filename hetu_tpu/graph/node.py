@@ -22,6 +22,13 @@ from ..context import get_current_context
 _UNTRACKED_RNG_OFFSET = 1 << 24
 
 
+class ShapeInferenceError(ValueError):
+    """``Op.infer_shape`` failed: the message names the node, its op
+    type, and its input shapes/dtypes (the graph-wide verifier in
+    ``hetu_tpu.analysis.verify`` wraps whole-graph walks the same way —
+    this covers standalone per-node use)."""
+
+
 class TraceContext:
     """Per-trace state threaded through ``Op.compute`` calls.
 
@@ -125,7 +132,19 @@ class Op:
             for s, d in zip(input_shapes, input_dtypes)
         ]
         tc = TraceContext(rng=None, training=False)
-        out = jax.eval_shape(lambda *a: self.compute(list(a), tc), *args)
+        try:
+            out = jax.eval_shape(lambda *a: self.compute(list(a), tc),
+                                 *args)
+        except Exception as e:
+            ins = ", ".join(
+                f"{jnp.dtype(d).name}{tuple(s)}"
+                for s, d in zip(input_shapes, input_dtypes))
+            raise ShapeInferenceError(
+                f"shape inference failed at node {self.name!r} (op "
+                f"{type(self).__name__}) with inputs [{ins}]"
+                + (f" produced by {[i.name for i in self.inputs]}"
+                   if self.inputs else "")
+                + f": {type(e).__name__}: {e}") from e
         return out.shape
 
     # ------------------------------------------------------------------ #

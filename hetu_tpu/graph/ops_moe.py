@@ -54,8 +54,8 @@ _ONEHOT_DISPATCH_MAX_ELEMS = 1 << 27
 
 
 def _force_scatter_dispatch():
-    import os
-    return bool(os.environ.get("HETU_MOE_SCATTER_DISPATCH"))
+    from ..envvars import get_bool
+    return get_bool("HETU_MOE_SCATTER_DISPATCH")
 
 
 def _scatter_rows(terms, n_slots, src, dtype, force_scatter=False):

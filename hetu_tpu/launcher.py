@@ -32,6 +32,8 @@ import multiprocessing
 
 from .context import DistConfig
 
+from . import envvars
+
 _procs: list = []
 DEFAULT_PS_PORT = 23455
 
@@ -120,7 +122,7 @@ def distributed_init():
     the top of a worker script launched by heturun.  No-op single-host."""
     import jax
 
-    nrank = int(os.environ.get("HETU_NUM_PROCESSES", "1"))
+    nrank = envvars.get_int("HETU_NUM_PROCESSES")
     if nrank <= 1:
         return
     # pre-0.5 jax needs the gloo CPU-collectives implementation selected
@@ -133,7 +135,7 @@ def distributed_init():
     jax.distributed.initialize(
         coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
         num_processes=nrank,
-        process_id=int(os.environ["HETU_PROCESS_ID"]))
+        process_id=envvars.require_int("HETU_PROCESS_ID"))
 
 
 def _sigint(sig, frame):
@@ -187,7 +189,7 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655,
     _procs.clear()
     global last_failure_events
     events = last_failure_events = []
-    log_path = os.environ.get("HETU_FAILURE_LOG")
+    log_path = envvars.get_path("HETU_FAILURE_LOG")
 
     def _event(kind, **fields):
         rec = {"t": round(time.time(), 3), "event": kind, **fields}
@@ -201,10 +203,10 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655,
         print(f"[heturun] {kind}: {fields}", flush=True)
 
     if supervise is None:
-        supervise = os.environ.get("HETU_SUPERVISE", "1") != "0"
-    restart_limit = int(os.environ.get("HETU_RESTART_LIMIT", "3"))
-    backoff0 = float(os.environ.get("HETU_RESTART_BACKOFF", "0.5"))
-    liveness_stale = float(os.environ.get("HETU_LIVENESS_STALE", "0"))
+        supervise = envvars.get_bool("HETU_SUPERVISE")
+    restart_limit = envvars.get_int("HETU_RESTART_LIMIT")
+    backoff0 = envvars.get_float("HETU_RESTART_BACKOFF")
+    liveness_stale = envvars.get_float("HETU_LIVENESS_STALE")
 
     ps_port = None
     local_names = ("localhost", "127.0.0.1", socket.gethostname())
@@ -216,7 +218,7 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655,
     sched_port = None
     server_slots = []
     if config.enable_PS:
-        base_port = int(os.environ.get("HETU_PS_PORT", DEFAULT_PS_PORT))
+        base_port = envvars.get_int("HETU_PS_PORT", DEFAULT_PS_PORT)
         # scheduler rendezvous (ps-lite Postoffice role): servers
         # register; workers can resolve the group dynamically.  Static
         # HETU_PS_ADDRS is still exported and takes precedence — the
@@ -259,8 +261,8 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655,
         for slot in server_slots:
             _wait_ps("localhost" if slot["host"] in local_names
                      else slot["host"], slot["port"])
-    replicated = len(ps_addrs) > 1 and os.environ.get(
-        "HETU_PS_REPLICATE", "0").lower() not in ("", "0", "false")
+    replicated = len(ps_addrs) > 1 and \
+        envvars.get_bool("HETU_PS_REPLICATE")
 
     nrank = config.num_workers
     chief = config.chief or "localhost"
@@ -425,7 +427,7 @@ def launch(target, args=(), num_servers=1):
     port = _free_port()
     proc = _start_ps_process(port)
     _wait_ps("localhost", port)
-    old = os.environ.get("HETU_PS_ADDR")
+    old = envvars.get_str("HETU_PS_ADDR")
     os.environ["HETU_PS_ADDR"] = f"localhost:{port}"
     try:
         from .ps.client import PSClient

@@ -38,6 +38,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import envvars
+
 NEG_INF = -1e30
 
 
@@ -58,7 +60,7 @@ def _resolve_fast(mode=None):
     (the parity suite pins it) but emulated, so the reference path
     stays the off-TPU default."""
     if mode is None:
-        mode = os.environ.get("HETU_SERVE_FAST", "auto")
+        mode = envvars.get_str("HETU_SERVE_FAST")
     if isinstance(mode, bool):
         return mode
     s = str(mode).strip().lower()

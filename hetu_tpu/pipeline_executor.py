@@ -505,6 +505,10 @@ class PipelineSubExecutor:
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feeds.items()))
         if feed_sig not in self._compiled:
+            # same pre-trace gate as SubExecutor.run: fail with the node
+            # named before the pipeline trace (HETU_VALIDATE=1)
+            from .analysis import validate_subgraph_feeds
+            validate_subgraph_feeds(ex, self, feeds)
             self._compiled[feed_sig] = self._compile(feed_sig)
         fn = self._compiled[feed_sig]
         if ex.mesh is not None:

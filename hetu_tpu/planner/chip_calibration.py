@@ -34,6 +34,8 @@ import json
 import os
 import time
 
+from .. import envvars
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -430,7 +432,7 @@ def load_calibration(path=CALIBRATION_FILE, n_devices=None):
 
 def main():
     from ..artifact import persist_artifact
-    small = bool(os.environ.get("HETU_CALIB_SMALL"))
+    small = envvars.get_bool("HETU_CALIB_SMALL")
     # cheap pre-check: a degraded run (small probes, or not on real
     # TPU) that would be refused anyway must not burn minutes of
     # matmul sweeps first

@@ -21,6 +21,8 @@ import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor, Future
 
+from .. import envvars
+
 import numpy as np
 
 from . import faults, wire
@@ -55,13 +57,13 @@ class _TCPTransport:
         self.host, self.port = host, port
         self.timeout = float(
             timeout if timeout is not None
-            else os.environ.get("HETU_PS_TIMEOUT", "60"))
+            else envvars.get_float("HETU_PS_TIMEOUT"))
         self.connect_timeout = float(
             connect_timeout if connect_timeout is not None
-            else os.environ.get("HETU_PS_CONNECT_TIMEOUT", "10"))
+            else envvars.get_float("HETU_PS_CONNECT_TIMEOUT"))
         self.retries = int(
             retries if retries is not None
-            else os.environ.get("HETU_PS_RETRIES", "3"))
+            else envvars.get_int("HETU_PS_RETRIES"))
 
     def _state(self):
         st = self._local
@@ -188,7 +190,7 @@ class PSClient:
 
     def __init__(self, transport=None, rank=0, nrank=1):
         if transport is None:
-            addr = os.environ.get("HETU_PS_ADDR")
+            addr = envvars.get_str("HETU_PS_ADDR")
             if addr:
                 host, port = addr.rsplit(":", 1)
                 transport = _TCPTransport(host, int(port))
@@ -212,7 +214,7 @@ class PSClient:
         """Beat the scheduler's liveness map (HETU_SCHEDULER_ADDR) every
         ``interval`` seconds from a daemon thread — the ps-lite
         Postoffice heartbeat role.  No-op without a scheduler."""
-        sched = os.environ.get("HETU_SCHEDULER_ADDR")
+        sched = envvars.get_str("HETU_SCHEDULER_ADDR")
         if not sched or self._hb_stop is not None:
             return False
         host, port = sched.rsplit(":", 1)
@@ -252,18 +254,17 @@ class PSClient:
     @classmethod
     def get(cls):
         if cls._instance is None:
-            rank = int(os.environ.get("HETU_PS_RANK", "0"))
-            nrank = int(os.environ.get("HETU_PS_NRANK", "1"))
-            addrs = [a for a in
-                     os.environ.get("HETU_PS_ADDRS", "").split(",") if a]
-            sched = os.environ.get("HETU_SCHEDULER_ADDR")
-            if not addrs and not os.environ.get("HETU_PS_ADDR") and sched:
+            rank = envvars.get_int("HETU_PS_RANK")
+            nrank = envvars.get_int("HETU_PS_NRANK")
+            addrs = envvars.get_list("HETU_PS_ADDRS")
+            sched = envvars.get_str("HETU_SCHEDULER_ADDR")
+            if not addrs and not envvars.is_set("HETU_PS_ADDR") and sched:
                 # rendezvous: block until the expected server group has
                 # registered, then connect directly (ps-lite Postoffice
                 # bootstrap role).  The expected count is REQUIRED:
                 # defaulting it would let early workers see a partial
                 # group and shard keys inconsistently.
-                nserv = os.environ.get("HETU_PS_NSERVERS")
+                nserv = envvars.get_int("HETU_PS_NSERVERS")
                 if nserv is None:
                     raise ValueError(
                         "HETU_SCHEDULER_ADDR is set but HETU_PS_NSERVERS "
@@ -273,7 +274,7 @@ class PSClient:
                 t = _TCPTransport(host, int(port))
                 addrs = t.call(
                     "get_servers", int(nserv),
-                    float(os.environ.get("HETU_PS_TIMEOUT", "60")))
+                    envvars.get_float("HETU_PS_TIMEOUT"))
                 t.close()
                 if len(addrs) == 1:
                     h2, p2 = addrs[0].rsplit(":", 1)
@@ -350,7 +351,7 @@ class PSClient:
         are re-checked at most every ``_VAN_REFRESH_S`` seconds, so a
         serve_van() issued after traffic started still gets picked up;
         repeated connect failures retire the fast tier per-thread."""
-        if os.environ.get("HETU_PS_USE_VAN", "1") == "0":
+        if not envvars.get_bool("HETU_PS_USE_VAN"):
             return None
         st = getattr(self._van_local, "state", None)
         if st is None:
@@ -378,8 +379,7 @@ class PSClient:
             try:
                 st["cli"] = VanClient(
                     host, st["port"],
-                    timeout=float(os.environ.get("HETU_PS_TIMEOUT",
-                                                 "60")))
+                    timeout=envvars.get_float("HETU_PS_TIMEOUT"))
             except OSError:
                 st["connect_fails"] += 1
                 if st["connect_fails"] >= self._VAN_MAX_CONNECT_TRIES:

@@ -48,6 +48,12 @@ import struct
 import threading
 
 
+def _restart_count():
+    """Supervisor incarnation index (0 = first run of this process)."""
+    from .. import envvars
+    return envvars.get_int("HETU_RESTART_COUNT")
+
+
 class InjectedFault(ConnectionError):
     """A chaos-injected transport failure (subclass of ConnectionError so
     the client's existing retry machinery treats it like the real
@@ -127,7 +133,8 @@ class FaultPlan:
         processes (HETU_CHAOS_ROLE, prefix match)."""
         if self.role is None:
             return True
-        return os.environ.get("HETU_CHAOS_ROLE", "").startswith(self.role)
+        from .. import envvars
+        return envvars.get_str("HETU_CHAOS_ROLE").startswith(self.role)
 
     # ---------------- the decision stream ---------------- #
 
@@ -144,7 +151,7 @@ class FaultPlan:
             n = self._n
         if self.kill is not None and n == self.kill and \
                 (kinds is None or "kill" in kinds) and \
-                os.environ.get("HETU_RESTART_COUNT", "0") == "0":
+                _restart_count() == 0:
             # one-shot across RESTARTS too: a supervisor-respawned
             # incarnation (HETU_RESTART_COUNT > 0) must not re-fire the
             # kill, or recovery could never be observed
@@ -177,7 +184,8 @@ def plan_from_env():
     None when chaos is off.  Cached per spec string so the decision
     counter persists across transports/calls; re-reading the env every
     call keeps test toggling cheap and race-free."""
-    spec = os.environ.get("HETU_CHAOS")
+    from .. import envvars
+    spec = envvars.get_str("HETU_CHAOS")
     if not spec:
         return None
     with _plans_mu:

@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from . import faults, wire
+from .. import envvars
 
 
 # ----------------------------------------------------------------- #
@@ -345,14 +346,14 @@ class PSServer:
 
     @classmethod
     def serve_from_env(cls):
-        port = int(os.environ.get("HETU_PS_PORT", "23455"))
+        port = envvars.get_int("HETU_PS_PORT")
         server = cls.get()
         tcp = server.serve_tcp(port, block=False)
-        if os.environ.get("HETU_PS_VAN"):
+        if envvars.get_bool("HETU_PS_VAN"):
             # fast tier: qualifying tables auto-register as clients
             # create them; workers discover it via the van_info RPC
             vport = server.enable_van_autoserve(
-                int(os.environ.get("HETU_PS_VAN_PORT", "0")))
+                envvars.get_int("HETU_PS_VAN_PORT"))
             print(f"[ps] native van listening on :{vport}", flush=True)
         # announce to the rendezvous scheduler, if one is configured
         _register_with_scheduler(port)
@@ -408,9 +409,7 @@ class PSServer:
             # deployments; "", "0" and "false" all mean loopback-only
             self._van_port = self._van.listen(
                 port,
-                bind_all=os.environ.get(
-                    "HETU_PS_VAN_BIND_ALL", "0").lower()
-                not in ("", "0", "false"))
+                bind_all=envvars.get_bool("HETU_PS_VAN_BIND_ALL"))
             self._van_keys = {}
         if keys is _AUTOSERVE:
             # every FUTURE qualifying table registers on creation
@@ -972,7 +971,7 @@ class Scheduler:
 
     @classmethod
     def serve_from_env(cls):
-        port = int(os.environ.get("HETU_SCHEDULER_PORT", "23454"))
+        port = envvars.get_int("HETU_SCHEDULER_PORT")
         cls().serve_tcp(port)
 
 
@@ -982,18 +981,18 @@ def _register_with_scheduler(port):
     liveness beats: register_server only SEEDS the health map — without
     beats every healthy server would read dead after the staleness
     window."""
-    sched = os.environ.get("HETU_SCHEDULER_ADDR")
+    sched = envvars.get_str("HETU_SCHEDULER_ADDR")
     if not sched:
         return
     from .client import _TCPTransport
     host, sport = sched.rsplit(":", 1)
     t = _TCPTransport(host, int(sport))
-    index = int(os.environ.get("HETU_PS_INDEX", "0"))
-    adv = os.environ.get("HETU_PS_ADVERTISE",
-                         f"{socket.gethostname()}:{port}")
+    index = envvars.get_int("HETU_PS_INDEX")
+    adv = envvars.get_str("HETU_PS_ADVERTISE") \
+        or f"{socket.gethostname()}:{port}"
     t.call("register_server", index, adv)
     t.close()
-    interval = float(os.environ.get("HETU_HEARTBEAT_INTERVAL", "5"))
+    interval = envvars.get_float("HETU_HEARTBEAT_INTERVAL")
     srv = PSServer.get()
     # stoppable + restart-safe: shutdown() must silence the beats (a
     # dead server that keeps beating defeats the liveness map), and a

@@ -62,8 +62,8 @@ REPLICA_PREFIX = "__rep__"
 
 
 def _env_replicate():
-    return os.environ.get("HETU_PS_REPLICATE", "0").lower() \
-        not in ("", "0", "false")
+    from .. import envvars
+    return envvars.get_bool("HETU_PS_REPLICATE")
 
 
 class _LocalServerTransport:
@@ -94,7 +94,8 @@ class ShardedPSClient:
         if servers is not None:
             transports = [_LocalServerTransport(s) for s in servers]
         else:
-            addrs = addrs or os.environ.get("HETU_PS_ADDRS", "").split(",")
+            from .. import envvars
+            addrs = addrs or envvars.get_list("HETU_PS_ADDRS")
             addrs = [a for a in addrs if a]
             if not addrs:
                 transports = [_LocalTransport()]
@@ -137,7 +138,8 @@ class ShardedPSClient:
 
     def _sched_health(self):
         """Best-effort scheduler liveness snapshot for event context."""
-        sched = os.environ.get("HETU_SCHEDULER_ADDR")
+        from .. import envvars
+        sched = envvars.get_str("HETU_SCHEDULER_ADDR")
         if not sched:
             return None
         try:

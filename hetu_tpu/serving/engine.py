@@ -79,6 +79,11 @@ class ServingEngine:
         dt_ = dtype or jnp.float32
         self.params = {k: _prep_param(v, dt_) for k, v in params.items()
                        if k.startswith(self._name + "_")}
+        # static checks (HETU_VALIDATE=1): params/config consistency
+        # validated BEFORE the cache allocation and jit compiles below
+        # (analysis/integration.py; no-op when validation is off)
+        from ..analysis import validate_serving
+        validate_serving(self.params, c, self._name)
         Dh = c.hidden_size // c.num_attention_heads
         want = int(max_seq_len or c.max_position_embeddings)
         self.kv = KVCacheManager(

@@ -17,6 +17,8 @@ import json
 import os
 import time
 
+from .. import envvars
+
 import numpy as np
 
 
@@ -27,7 +29,7 @@ def _pct(xs, q):
 class ServingMetrics:
     def __init__(self, log_path=None):
         self.log_path = (log_path if log_path is not None
-                         else os.environ.get("HETU_SERVE_LOG"))
+                         else envvars.get_path("HETU_SERVE_LOG"))
         self.events = []
         self.submitted = 0
         self.rejected = 0

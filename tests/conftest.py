@@ -13,6 +13,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# static checks default-ON for the whole suite: every Executor/
+# ServingEngine build runs the pre-trace verifier + parallelism checker
+# (hetu_tpu/analysis/), so a graph regression fails with the node named
+# instead of an XLA stack dump.  Explicit HETU_VALIDATE=0 still wins.
+os.environ.setdefault("HETU_VALIDATE", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

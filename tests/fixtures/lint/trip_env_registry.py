@@ -1,0 +1,6 @@
+"""Lint fixture: raw HETU_* environment reads (rule env-registry)."""
+import os
+
+mode = os.environ.get("HETU_SOME_KNOB", "0")
+addr = os.environ["HETU_OTHER_KNOB"]
+also = os.getenv("HETU_THIRD_KNOB")

@@ -1,0 +1,14 @@
+"""Lint fixture: hot-path jit without donation (rule jit-donate)."""
+import jax
+
+
+def decode_step(cache, tok):
+    return cache, tok
+
+
+def prefill_batch(cache, toks):
+    return cache, toks
+
+
+decode = jax.jit(decode_step)                       # missing donation
+prefill = jax.jit(prefill_batch, static_argnames=("n",))

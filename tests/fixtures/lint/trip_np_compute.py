@@ -1,0 +1,11 @@
+"""Lint fixture: host numpy call inside Op.compute (rule np-in-compute)."""
+import numpy as np
+
+
+class BadHostOp:
+    def compute(self, input_vals, tc):
+        x = np.asarray(input_vals[0])      # forces host materialization
+        return x
+
+    def jax_fn(self, x):
+        return np.clip(x, 0, 1)            # host call in the trace body

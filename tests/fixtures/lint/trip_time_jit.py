@@ -1,0 +1,24 @@
+"""Lint fixture: wall clock + host RNG inside jitted code (time-in-jit)."""
+import time
+
+import jax
+import numpy as np
+
+
+class BadClockOp:
+    def compute(self, input_vals, tc):
+        stamp = time.time()                # freezes at trace time
+        np.random.seed(0)                  # host RNG state in the trace
+        return input_vals[0] * stamp
+
+
+@jax.jit
+def decorated(x):
+    return x + time.perf_counter()
+
+
+def passed_by_name(x):
+    return x * time.monotonic()
+
+
+fn = jax.jit(passed_by_name, donate_argnums=(0,))

@@ -1,0 +1,516 @@
+"""Static-analysis subsystem (hetu_tpu/analysis/): graph verifier,
+parallelism checker, lint rules, and the HETU_VALIDATE wiring.
+
+The verifier's contract under test: a deliberately miswired graph —
+shape mismatch (one case per ops family), bad mesh axis, uneven pp
+stages — fails at BUILD time with the offending node named in the
+error, never as a jit traceback; structural defects (cycles, duplicate
+names, missing rng) and advisory findings (dead nodes, f32 creep in
+bf16 subgraphs) are detected on the same walk; and every validation
+emits JSONL records in the launcher's failure-log shape.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu import envvars
+from hetu_tpu.analysis import (
+    GraphVerifyError, ShardCheckError, check_collective_order_static,
+    check_cycles, check_divisibility, check_mesh_axes,
+    check_pipeline_stages, check_stage_assignment, collective_sequence,
+    verify_graph,
+)
+from hetu_tpu.analysis.lint import RULES, lint_paths, lint_source
+from hetu_tpu.graph import ops_comm
+from hetu_tpu.graph.node import ShapeInferenceError, SimpleOp
+from hetu_tpu.parallel.mesh import make_mesh
+from jax.sharding import PartitionSpec as P
+
+pytestmark = pytest.mark.smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def var(name, shape, dtype=np.float32):
+    return ht.Variable(name, value=np.zeros(shape, dtype))
+
+
+# --------------------------------------------------------------------- #
+# graph verifier: one deliberate mismatch per ops family
+# --------------------------------------------------------------------- #
+
+class TestVerifierMismatches:
+    def _expect(self, nodes, needle=None, **kw):
+        with pytest.raises(GraphVerifyError) as ei:
+            verify_graph(nodes, **kw)
+        msg = str(ei.value)
+        if needle:
+            assert needle in msg, msg
+        return ei.value
+
+    def test_math_family(self):
+        bad = ht.add_op(var("m_a", (4, 3)), var("m_b", (4, 4)))
+        err = self._expect([bad], needle=bad.name)
+        assert "float32(4, 3)" in str(err) and "float32(4, 4)" in str(err)
+        assert err.node is bad
+
+    def test_matmul_family(self):
+        bad = ht.matmul_op(var("mm_a", (4, 3)), var("mm_b", (5, 6)))
+        err = self._expect([bad], needle=bad.name)
+        # producers are named too
+        assert "mm_a" in str(err) and "mm_b" in str(err)
+
+    def test_conv_family(self):
+        bad = ht.conv2d_op(var("c_x", (2, 3, 8, 8)),
+                           var("c_w", (4, 7, 3, 3)))
+        self._expect([bad], needle=bad.name)
+
+    def test_attention_family(self):
+        from hetu_tpu.graph.ops_attention import flash_attention_op
+        bad = flash_attention_op(var("q", (1, 2, 8, 4)),
+                                 var("k", (1, 2, 8, 6)),
+                                 var("v", (1, 2, 8, 4)))
+        self._expect([bad], needle=bad.name)
+
+    def test_moe_family(self):
+        from hetu_tpu.graph.ops_moe import layout_transform_gradient_op
+        bad = layout_transform_gradient_op(
+            var("g", (8, 4)), var("idx", (8,), np.int32),
+            var("loc", (6,), np.int32), capacity=2)
+        self._expect([bad], needle=bad.name)
+
+    def test_comm_family_bad_axis(self):
+        mesh = make_mesh({"dp": 4})
+        bad = ops_comm.allgatherCommunicate_op(var("cm_x", (8, 4)),
+                                               axis="tp")
+        with pytest.raises(ShardCheckError) as ei:
+            check_mesh_axes([bad], mesh)
+        assert bad.name in str(ei.value) and "'tp'" in str(ei.value)
+
+    def test_good_graph_table(self):
+        y = ht.matmul_op(var("g_a", (4, 3)), var("g_b", (3, 2)))
+        loss = ht.reduce_mean_op(y, axes=0)
+        rep = verify_graph([loss])
+        assert rep.shape_of(y) == (4, 2)
+        assert rep.shape_of(loss) == (2,)
+        assert str(rep.dtype_of(y)) == "float32"
+
+
+class TestVerifierStructural:
+    def test_cycle_detected(self):
+        a = var("cy_a", (2, 2))
+        n1 = SimpleOp(lambda x, y: x + y, a, a, name="cy_n1")
+        n2 = SimpleOp(lambda x: x * 2.0, n1, name="cy_n2")
+        n1.inputs[1] = n2          # deliberate back edge
+        with pytest.raises(GraphVerifyError) as ei:
+            check_cycles([n2])
+        assert ei.value.kind == "cycle"
+        assert "cy_n1" in str(ei.value) and "cy_n2" in str(ei.value)
+
+    def test_duplicate_names(self):
+        a, b = var("dup_v", (2,)), var("dup_v", (2,))
+        bad = ht.add_op(a, b)
+        with pytest.raises(GraphVerifyError) as ei:
+            verify_graph([bad])
+        assert ei.value.kind == "duplicate_name"
+
+    def test_dead_node_finding(self):
+        live = ht.mul_byconst_op(var("dn_a", (2,)), 2.0)
+        dead = ht.mul_byconst_op(var("dn_b", (2,)), 3.0)
+        rep = verify_graph([live], all_nodes=[live, dead])
+        kinds = {(f["kind"], f["node"]) for f in rep.findings}
+        assert ("dead_node", dead.name) in kinds
+
+    def test_rng_missing(self):
+        drop = ht.dropout_op(var("rm_x", (4, 4)), 0.5)
+        out = ht.reduce_mean_op(drop, axes=0)
+        with pytest.raises(GraphVerifyError) as ei:
+            verify_graph([out], rng_available=False)
+        assert ei.value.kind == "rng_missing"
+        assert drop.name in str(ei.value)
+        # with an rng the same graph verifies and records the consumer
+        rep = verify_graph([out], rng_available=True)
+        assert drop.name in rep.rng_consumers
+
+    def test_dtype_creep_in_bf16(self):
+        x = var("cr_x", (4, 4))
+        crept = SimpleOp(lambda v: v.astype(np.float32), x,
+                         name="cr_upcast")
+        out = ht.mul_byconst_op(crept, 1.0)
+        rep = verify_graph([out], mixed_precision="bf16")
+        assert any(f["kind"] == "dtype_creep"
+                   and f["node"] == crept.name for f in rep.findings)
+        # without the policy there is nothing to creep from
+        rep2 = verify_graph([out])
+        assert not any(f["kind"] == "dtype_creep" for f in rep2.findings)
+
+    def test_unknown_feed_shapes_skip_downstream(self):
+        x = ht.placeholder_op("uf_x")     # shape unknown until fed
+        y = ht.matmul_op(x, var("uf_w", (3, 2)))
+        rep = verify_graph([y])           # must not raise
+        assert rep.shape_of(y) is None
+        # and with the feed shape supplied, mismatches surface
+        with pytest.raises(GraphVerifyError):
+            verify_graph([y], feed_shapes={"uf_x": (4, 5)})
+        rep = verify_graph([y], feed_shapes={"uf_x": (4, 3)})
+        assert rep.shape_of(y) == (4, 2)
+
+
+# --------------------------------------------------------------------- #
+# satellite: Op.infer_shape standalone error + override parity
+# --------------------------------------------------------------------- #
+
+class TestInferShape:
+    def test_base_error_names_node_and_inputs(self):
+        bad = ht.matmul_op(var("is_a", (4, 3)), var("is_b", (5, 6)))
+        with pytest.raises(ShapeInferenceError) as ei:
+            bad.infer_shape([(4, 3), (5, 6)])
+        msg = str(ei.value)
+        assert bad.name in msg and "float32(4, 3)" in msg \
+            and "float32(5, 6)" in msg
+        assert "is_a" in msg and "is_b" in msg
+
+    def test_base_path_still_returns_shape(self):
+        ok = ht.matmul_op(var("is_c", (4, 3)), var("is_d", (3, 2)))
+        assert tuple(ok.infer_shape([(4, 3), (3, 2)])) == (4, 2)
+
+    def test_placeholder_override_parity(self):
+        # the one hand-written override (graph/ops_misc.py): a
+        # placeholder's infer_shape is its declared shape, and the
+        # graph-wide verifier must agree with it
+        v = var("is_v", (7, 5))
+        assert tuple(v.infer_shape([])) == (7, 5)
+        rep = verify_graph([ht.mul_byconst_op(v, 2.0)])
+        assert rep.shape_of(v) == (7, 5)
+        unfed = ht.placeholder_op("is_unfed")
+        with pytest.raises(AssertionError):
+            unfed.infer_shape([])
+
+
+# --------------------------------------------------------------------- #
+# parallelism checker
+# --------------------------------------------------------------------- #
+
+class TestShardCheck:
+    def test_divisibility_accept(self):
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        w = var("sc_w", (6, 8))
+        w.sharding_spec = P(None, "tp")
+        out = ht.mul_byconst_op(w, 2.0)
+        assert check_divisibility([out], mesh) == []
+
+    def test_divisibility_reject_nondivisible(self):
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        w = var("sc_w2", (6, 9))          # 9 % tp(4) != 0
+        w.sharding_spec = P(None, "tp")
+        with pytest.raises(ShardCheckError) as ei:
+            check_divisibility([ht.mul_byconst_op(w, 2.0)], mesh)
+        assert "sc_w2" in str(ei.value) and ei.value.kind == "divisibility"
+
+    def test_divisibility_reject_missing_axis(self):
+        mesh = make_mesh({"dp": 8})
+        w = var("sc_w3", (8, 8))
+        w.sharding_spec = P("tp", None)
+        with pytest.raises(ShardCheckError):
+            check_divisibility([ht.mul_byconst_op(w, 2.0)], mesh)
+
+    def test_feed_divisibility_finding(self):
+        mesh = make_mesh({"dp": 8})
+        out = ht.mul_byconst_op(var("sc_x", (8, 2)), 1.0)
+        findings = check_divisibility([out], mesh,
+                                      feed_shapes={"batch_x": (12, 2)})
+        assert any(f["kind"] == "feed_not_dp_divisible"
+                   and f["node"] == "batch_x" for f in findings)
+
+    def test_mesh_axes_accept(self):
+        mesh = make_mesh({"dp": 2, "tp": 2, "pp": 2})
+        x = var("ma_x", (8, 4))
+        chain = ops_comm.pipeline_send_op(
+            ops_comm.reducescatterCommunicate_op(
+                ops_comm.allreduceCommunicate_op(x, axis="dp"),
+                axis="tp"))
+        assert len(check_mesh_axes([chain], mesh)) == 3
+
+    def _stacked_mlp(self, layers, hid=4):
+        # distinct entry projection so the repeated layers (not the
+        # input block) form the uniform body the partitioner detects
+        x = var("pp_x", (8, 6))
+        h = ht.relu_op(ht.matmul_op(
+            x, ht.init.xavier_uniform((6, hid), name="pp_w_in")))
+        for i in range(layers):
+            w = ht.init.xavier_uniform((hid, hid), name=f"pp_l{i}_w")
+            h = ht.relu_op(ht.matmul_op(h, w))
+        return ht.reduce_mean_op(h, axes=0)
+
+    def test_pipeline_accept_even(self):
+        loss = self._stacked_mlp(4)
+        assert check_pipeline_stages(loss, 2) == []
+
+    def test_pipeline_reject_uneven(self):
+        loss = self._stacked_mlp(3)
+        with pytest.raises(ShardCheckError) as ei:
+            check_pipeline_stages(loss, 2)
+        assert ei.value.kind == "pipeline"
+        assert "3" in str(ei.value) and "2" in str(ei.value)
+
+    def test_pipeline_fallback_finding(self):
+        # no uniform body at all: advisory, not fatal (the microbatch
+        # scan fallback is trajectory-correct)
+        loss = ht.reduce_mean_op(
+            ht.matmul_op(var("pf_a", (4, 3)), var("pf_b", (3, 2))),
+            axes=0)
+        findings = check_pipeline_stages(loss, 2)
+        assert any(f["kind"] == "pipeline_no_uniform_body"
+                   for f in findings)
+
+    def test_stage_assignment_accept(self):
+        a = var("sa_a", (4, 4))
+        h0 = ht.relu_op(a)
+        snd = ops_comm.pipeline_send_op(h0)
+        rcv = ops_comm.pipeline_receive_op(snd)
+        h1 = ht.relu_op(rcv)
+        stages = {a.name: 0, h0.name: 0, snd.name: 0,
+                  rcv.name: 1, h1.name: 1}
+        check_stage_assignment([h1], stages, num_stages=2)
+
+    def test_stage_assignment_reject_bypass(self):
+        a = var("sb_a", (4, 4))
+        h0 = ht.relu_op(a)
+        h1 = ht.relu_op(h0)               # crosses 0 -> 1 with no comm op
+        with pytest.raises(ShardCheckError) as ei:
+            check_stage_assignment(
+                [h1], {a.name: 0, h0.name: 0, h1.name: 1}, num_stages=2)
+        assert ei.value.kind == "stage_assignment"
+
+    def test_stage_assignment_reject_backward(self):
+        a = var("sm_a", (4, 4))
+        h0 = ht.relu_op(a)
+        with pytest.raises(ShardCheckError) as ei:
+            check_stage_assignment(
+                [h0], {a.name: 1, h0.name: 0}, num_stages=2)
+        assert "monotone" in str(ei.value)
+
+    def test_stage_assignment_reject_gap(self):
+        a = var("sg_a", (4, 4))
+        h0 = ht.relu_op(a)
+        with pytest.raises(ShardCheckError) as ei:
+            check_stage_assignment([h0], {a.name: 0, h0.name: 0},
+                                   num_stages=3)
+        assert "contiguous" in str(ei.value)
+
+    def test_collective_order_static(self):
+        def seq(axis_then):
+            x = var(f"co_{axis_then}", (8, 4))
+            return [ops_comm.reducescatterCommunicate_op(
+                ops_comm.allreduceCommunicate_op(x, axis="dp"),
+                axis=axis_then)]
+        ok = check_collective_order_static(
+            {"g0": seq("tp"), "g1": seq("tp")})
+        assert [op for op, _ in ok] == ["AllReduceCommunicateOp",
+                                       "ReduceScatterCommunicateOp"]
+        with pytest.raises(ShardCheckError) as ei:
+            check_collective_order_static(
+                {"g0": seq("tp"), "g1": seq("dp")})
+        assert ei.value.kind == "collective_order"
+
+    def test_collective_sequence_records_axes(self):
+        x = var("cs_x", (8, 4))
+        n = ops_comm.allgatherCommunicate_op(x, axis="tp")
+        assert collective_sequence([n]) == [("AllGatherCommunicateOp",
+                                            "tp")]
+
+
+# --------------------------------------------------------------------- #
+# executor + serving wiring (HETU_VALIDATE=1; conftest defaults it on)
+# --------------------------------------------------------------------- #
+
+class TestExecutorWiring:
+    def test_build_time_shape_mismatch_named(self):
+        bad = ht.matmul_op(var("ew_a", (4, 3)), var("ew_b", (5, 6)))
+        loss = ht.reduce_mean_op(bad, axes=0)
+        with pytest.raises(GraphVerifyError) as ei:
+            ht.Executor({"train": [loss]})
+        assert bad.name in str(ei.value)
+
+    def test_feed_time_mismatch_named_before_trace(self):
+        x = ht.placeholder_op("ew_x")     # unshaped until fed
+        w = var("ew_w", (3, 2))
+        out = ht.matmul_op(x, w)
+        ex = ht.Executor({"eval": [out]})  # builds fine (shape unknown)
+        with pytest.raises(GraphVerifyError) as ei:
+            ex.run("eval", feed_dict={x: np.zeros((4, 5), np.float32)})
+        assert out.name in str(ei.value)
+
+    def test_bad_mesh_axis_fails_at_build(self):
+        mesh = make_mesh({"dp": 4})
+        x = var("ew_mx", (8, 4))
+        ar = ops_comm.allreduceCommunicate_op(x, axis="tp")
+        loss = ht.reduce_mean_op(ar, axes=0)
+        with pytest.raises(ShardCheckError):
+            ht.Executor({"train": [loss]}, mesh=mesh)
+
+    def test_validate_off_skips(self, monkeypatch):
+        monkeypatch.setenv("HETU_VALIDATE", "0")
+        bad = ht.matmul_op(var("off_a", (4, 3)), var("off_b", (5, 6)))
+        loss = ht.reduce_mean_op(bad, axes=0)
+        ht.Executor({"train": [loss]})    # no build-time error
+
+    def test_jsonl_report_record_shape(self, tmp_path, monkeypatch):
+        # the event-log contract is uniform with PR 1's failure log:
+        # every line is {"t": <float>, "event": <str>, **fields}
+        log = tmp_path / "validate.jsonl"
+        monkeypatch.setenv("HETU_VALIDATE_LOG", str(log))
+        y = ht.matmul_op(var("rl_a", (4, 3)), var("rl_b", (3, 2)))
+        ht.Executor({"eval": [ht.reduce_mean_op(y, axes=0)]})
+        recs = [json.loads(line) for line in log.read_text().splitlines()]
+        assert recs, "no validation records written"
+        for rec in recs:
+            assert isinstance(rec["t"], float) and isinstance(
+                rec["event"], str)
+        assert any(r["event"] == "graph_verified" for r in recs)
+
+    def test_training_graph_verifies(self):
+        # full forward+backward+optimizer graph walks clean
+        x = ht.placeholder_op("tr_x")
+        w = ht.init.xavier_uniform((6, 4), name="tr_w")
+        loss = ht.reduce_mean_op(ht.matmul_op(x, w), axes=0)
+        loss = ht.reduce_mean_op(loss, axes=0)
+        train = ht.optim.SGDOptimizer(learning_rate=0.1).minimize(loss)
+        ex = ht.Executor({"train": [loss, train]})
+        out = ex.run("train",
+                     feed_dict={x: np.ones((8, 6), np.float32)})
+        assert np.isfinite(float(np.asarray(out[0])))
+
+
+class TestServingWiring:
+    def _params(self, name="sv", hd=16, V=32, S=16):
+        rng = np.random.RandomState(0)
+        return {f"{name}_wte_table": rng.randn(V, hd).astype(np.float32),
+                f"{name}_wpe": rng.randn(S, hd).astype(np.float32)}
+
+    def test_heads_divisibility_rejected(self):
+        from hetu_tpu.analysis import validate_serving
+        from hetu_tpu.models import GPTConfig
+        cfg = GPTConfig(vocab_size=32, hidden_size=16,
+                        num_hidden_layers=1, num_attention_heads=3,
+                        max_position_embeddings=16, seq_len=16)
+        with pytest.raises(ShardCheckError):
+            validate_serving(self._params(), cfg, "sv")
+
+    def test_param_shape_mismatch_rejected(self):
+        from hetu_tpu.analysis import validate_serving
+        from hetu_tpu.models import GPTConfig
+        cfg = GPTConfig(vocab_size=32, hidden_size=24,
+                        num_hidden_layers=1, num_attention_heads=2,
+                        max_position_embeddings=16, seq_len=16)
+        with pytest.raises(GraphVerifyError) as ei:
+            validate_serving(self._params(hd=16), cfg, "sv")
+        assert "wte_table" in str(ei.value)
+
+    def test_consistent_params_accepted(self, tmp_path, monkeypatch):
+        from hetu_tpu.analysis import validate_serving
+        from hetu_tpu.models import GPTConfig
+        log = tmp_path / "serve_validate.jsonl"
+        monkeypatch.setenv("HETU_VALIDATE_LOG", str(log))
+        cfg = GPTConfig(vocab_size=32, hidden_size=16,
+                        num_hidden_layers=1, num_attention_heads=2,
+                        max_position_embeddings=16, seq_len=16)
+        validate_serving(self._params(), cfg, "sv")
+        recs = [json.loads(line) for line in log.read_text().splitlines()]
+        assert recs[-1]["event"] == "serving_verified"
+
+
+# --------------------------------------------------------------------- #
+# lint rules: every rule must trip on its fixture and stay quiet on
+# clean code
+# --------------------------------------------------------------------- #
+
+class TestLint:
+    def _rules_hit(self, fname):
+        findings = lint_paths([os.path.join(FIXTURES, fname)])
+        return {f.rule for f in findings}
+
+    def test_fixture_env_registry(self):
+        assert "env-registry" in self._rules_hit("trip_env_registry.py")
+
+    def test_fixture_np_in_compute(self):
+        assert "np-in-compute" in self._rules_hit("trip_np_compute.py")
+
+    def test_fixture_time_in_jit(self):
+        assert "time-in-jit" in self._rules_hit("trip_time_jit.py")
+
+    def test_fixture_jit_donate(self):
+        assert "jit-donate" in self._rules_hit("trip_jit_donate.py")
+
+    def test_clean_fixture_quiet(self):
+        assert self._rules_hit("clean.py") == set()
+
+    def test_env_writes_allowed(self):
+        src = 'import os\nos.environ["HETU_VALIDATE"] = "1"\n' \
+              'os.environ.pop("HETU_VALIDATE", None)\n'
+        assert lint_source(src) == []
+
+    def test_unregistered_getter_flagged(self):
+        src = 'from hetu_tpu import envvars\n' \
+              'x = envvars.get_str("HETU_NOT_A_REAL_KNOB")\n'
+        assert any(f.rule == "env-registry" for f in lint_source(src))
+
+    def test_np_static_helpers_allowed(self):
+        src = ('class AOp:\n'
+               '    def compute(self, input_vals, tc):\n'
+               '        n = np.prod((2, 3))\n'
+               '        return input_vals[0]\n')
+        assert lint_source(src) == []
+
+    def test_rule_subset_selection(self):
+        path = os.path.join(FIXTURES, "trip_env_registry.py")
+        only = lint_paths([path], rules=("jit-donate",))
+        assert only == []
+
+    def test_all_rules_have_fixtures(self):
+        # keep the fixture battery in sync with the rule list
+        fixture_rules = set()
+        for f in sorted(os.listdir(FIXTURES)):
+            if f.startswith("trip_"):
+                fixture_rules |= {x.rule for x in lint_paths(
+                    [os.path.join(FIXTURES, f)])}
+        assert set(RULES) <= fixture_rules
+
+
+# --------------------------------------------------------------------- #
+# env registry
+# --------------------------------------------------------------------- #
+
+class TestEnvVars:
+    def test_unregistered_read_raises(self):
+        with pytest.raises(KeyError):
+            envvars.get_str("HETU_NOT_A_REAL_KNOB")
+
+    def test_bool_parsing(self, monkeypatch):
+        for raw, want in [("1", True), ("true", True), ("on", True),
+                          ("0", False), ("false", False), ("off", False),
+                          ("", False)]:
+            monkeypatch.setenv("HETU_VALIDATE", raw)
+            assert envvars.get_bool("HETU_VALIDATE") is want
+        monkeypatch.delenv("HETU_VALIDATE", raising=False)
+        assert envvars.get_bool("HETU_VALIDATE") is False
+
+    def test_typed_defaults(self, monkeypatch):
+        monkeypatch.delenv("HETU_PS_TIMEOUT", raising=False)
+        assert envvars.get_float("HETU_PS_TIMEOUT") == 60.0
+        monkeypatch.setenv("HETU_PS_TIMEOUT", "2.5")
+        assert envvars.get_float("HETU_PS_TIMEOUT") == 2.5
+        monkeypatch.setenv("HETU_PS_ADDRS", "a:1, b:2,")
+        assert envvars.get_list("HETU_PS_ADDRS") == ["a:1", "b:2"]
+
+    def test_env_table_covers_registry(self):
+        table = envvars.env_table()
+        for name in envvars.REGISTRY:
+            assert f"`{name}`" in table
+        # every registered var documents itself
+        assert all(v.help for v in envvars.REGISTRY.values())

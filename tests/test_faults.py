@@ -602,3 +602,67 @@ class TestExecutorOutageBacklog:
         assert ex._ps_push_backlog == []
         assert not np.allclose(
             np.asarray(srv.pull("fo_emb_table")), before)
+
+
+class TestValidatorEventLogContract:
+    """The verifier's JSONL report (HETU_VALIDATE_LOG) shares the
+    failure log's record shape, keeping PR 1's event-log contract
+    uniform: one ``tail | jq 'select(.event == ...)'`` pipeline reads
+    launcher failures, serving telemetry, and validation reports."""
+
+    def _record_shape_ok(self, rec):
+        return isinstance(rec.get("t"), float) \
+            and isinstance(rec.get("event"), str)
+
+    def test_verifier_records_match_failure_log_shape(self, tmp_path,
+                                                      monkeypatch):
+        import hetu_tpu as ht
+        log = tmp_path / "validate.jsonl"
+        monkeypatch.setenv("HETU_VALIDATE", "1")
+        monkeypatch.setenv("HETU_VALIDATE_LOG", str(log))
+        a = ht.Variable("vc_a", value=np.ones((4, 3), np.float32))
+        b = ht.Variable("vc_b", value=np.ones((3, 2), np.float32))
+        ht.Executor({"eval": [ht.reduce_mean_op(
+            ht.matmul_op(a, b), axes=0)]})
+        recs = [json.loads(line)
+                for line in log.read_text().splitlines()]
+        assert recs and all(self._record_shape_ok(r) for r in recs)
+        assert {r["event"] for r in recs} <= {
+            "graph_verified", "graph_verify_error"}
+
+    def test_verify_error_record_lands_like_a_failure_event(
+            self, tmp_path, monkeypatch):
+        import hetu_tpu as ht
+        from hetu_tpu.analysis import GraphVerifyError
+        log = tmp_path / "validate.jsonl"
+        monkeypatch.setenv("HETU_VALIDATE", "1")
+        monkeypatch.setenv("HETU_VALIDATE_LOG", str(log))
+        a = ht.Variable("vc_c", value=np.ones((4, 3), np.float32))
+        b = ht.Variable("vc_d", value=np.ones((5, 2), np.float32))
+        bad = ht.matmul_op(a, b)
+        with pytest.raises(GraphVerifyError):
+            ht.Executor({"eval": [bad]})
+        recs = [json.loads(line)
+                for line in log.read_text().splitlines()]
+        err = [r for r in recs if r["event"] == "graph_verify_error"]
+        assert err and self._record_shape_ok(err[0])
+        # the record carries the same attribution the exception does
+        assert err[0]["node"] == bad.name
+        assert err[0]["kind"] == "shape"
+
+    def test_uniform_with_launcher_failure_records(self, tmp_path,
+                                                   monkeypatch):
+        # one merged stream: a launcher failure event and a verifier
+        # record filter through the same (t, event) pipeline
+        from hetu_tpu.analysis.report import emit_records, make_record
+        log = tmp_path / "merged.jsonl"
+        launcher_rec = {"t": round(time.time(), 3),
+                        "event": "worker_exit", "rank": 0, "code": -9}
+        with open(log, "a") as f:
+            f.write(json.dumps(launcher_rec) + "\n")
+        emit_records([make_record("graph_verified", subgraph="train",
+                                  nodes=12)], path=str(log))
+        recs = [json.loads(line)
+                for line in log.read_text().splitlines()]
+        assert len(recs) == 2
+        assert all(self._record_shape_ok(r) for r in recs)

@@ -1,0 +1,61 @@
+"""The repo itself must pass its own lint gate (tier-1 guard).
+
+``bin/hetu_lint.py hetu_tpu/ bench.py bin/`` exiting 0 is an acceptance
+criterion of the static-analysis subsystem: the env-registry rule is
+what KEEPS the 60-raw-read migration from regressing, and the
+trace-body rules keep JAX footguns out of ``Op.compute``.  Runs the
+rules in-process (no subprocess jax startup) plus one CLI smoke pass.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hetu_tpu.analysis.lint import RULES, lint_paths
+
+pytestmark = pytest.mark.smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = [os.path.join(REPO, "hetu_tpu"),
+           os.path.join(REPO, "bench.py"),
+           os.path.join(REPO, "bin")]
+
+
+def test_repo_lints_clean():
+    findings = lint_paths(TARGETS)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetu_lint.py"),
+         *TARGETS], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exits_nonzero_on_fixture():
+    fixture = os.path.join(REPO, "tests", "fixtures", "lint",
+                           "trip_env_registry.py")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetu_lint.py"),
+         fixture], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "env-registry" in proc.stdout
+
+
+def test_cli_env_table():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hetu_lint.py"),
+         "--env-table"], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "`HETU_VALIDATE`" in proc.stdout
+    assert "| Variable | Type | Default | Description |" in proc.stdout
+
+
+def test_every_rule_documented():
+    # the CLI help names each rule's purpose via the module docstring
+    from hetu_tpu.analysis import lint as lint_mod
+    for rule in RULES:
+        assert f"``{rule}``" in lint_mod.__doc__
