@@ -281,6 +281,13 @@ def _bench_lm(platform, reduced, *, layers_n, seq, per_chip_batch,
         out["flash_forced"] = True
     if flash_err:
         out["flash_fallback"] = flash_err
+    # physics ceiling: a row claiming more than the silicon can do is a
+    # measurement defect, not a result (telemetry/health.py)
+    from hetu_tpu.telemetry import health as _health
+    ceiling = _health.check_physics_ceiling(
+        mfu=mfu, tflops_chip=tflops_chip, platform=platform)
+    if not ceiling["ok"]:
+        out["health_violation"] = ceiling["violations"]
     return out
 
 
@@ -316,6 +323,22 @@ def _run_probe(src, deadline, timeout_cap=900.0, min_left=60.0):
         return "probe timed out (tunnel degraded?)"
     except Exception as e:
         return f"{type(e).__name__}"[:60]
+
+
+def _probe_health(numeric):
+    """Telemetry health gate over the batch-probe readings (VERDICT
+    next-#1's banking rule): a probe >2x below the median of its
+    siblings is a wedged tunnel reading, not a slow batch size.  The
+    wedged entries are REMOVED from ``numeric`` (they can neither win
+    nor veto), and the verdict dict lands in the artifact so a
+    degraded window is visible in the record, never silently banked."""
+    if len(numeric) < 2:
+        return None
+    from hetu_tpu.telemetry import health
+    verdict = health.check_sibling_consistency(numeric)
+    for b in list(verdict["wedged"]):
+        numeric.pop(int(b), None)
+    return verdict
 
 
 def _record_retry_probe(probes, numeric, b, first, retry):
@@ -380,10 +403,22 @@ def bench_bert_base(platform, reduced):
         # matrix record an error instead of hanging on an unprotected
         # in-process measurement.
         raise RuntimeError(f"all batch probes failed: {probes}")
+    # health gate: a probe still >2x off its siblings AFTER the retry
+    # is a degraded window — exclude it from winner selection and say
+    # so in the artifact (the Aug-2 64.6 reading was banked silently)
+    health = _probe_health(numeric)
     best = max(numeric, key=numeric.get) if numeric else 32
     out = _bench_lm(platform, reduced, layers_n=12, seq=512,
                     per_chip_batch=best, iters=10)
     out["batch_probe_samples_per_sec"] = probes
+    if health is not None:
+        out["probe_health"] = health
+        if not health["ok"]:
+            out["health_warning"] = (
+                "degraded measurement window: probe(s) "
+                f"{sorted(health['wedged'])} wedged (>2x off siblings) "
+                "even after re-probe; row measured from the surviving "
+                "batches — treat with suspicion")
     return out
 
 
@@ -1429,6 +1464,34 @@ def _enable_compile_cache():
         pass          # older jax without the knob: run uncached
 
 
+def _provenance_fields(results, ran, head_name, run_platform,
+                       prev_platform=None):
+    """Live-vs-banked accounting for the ONE headline record (VERDICT
+    weak #4): ``platform`` is the platform of the HEADLINE ROW actually
+    measured — a cpu-fallback driver run re-emitting banked on-chip
+    values now says ``platform: tpu, headline_provenance: banked`` with
+    the bring-up platform preserved separately as ``run_platform`` —
+    and every row is explicitly listed under ``rows_live`` or
+    ``rows_banked`` (banked rows keep their own ``measured_at``)."""
+    head = results.get(head_name, {})
+    live = sorted(n for n in results if n in ran)
+    banked = {n: {"measured_at": results[n].get("measured_at"),
+                  "platform": results[n].get("platform")
+                  or prev_platform or "unknown"}
+              for n in sorted(results) if n not in ran}
+    if head_name in ran:
+        head_platform = head.get("platform") or run_platform
+    else:
+        head_platform = head.get("platform") or prev_platform or "unknown"
+    return {
+        "platform": head_platform,
+        "run_platform": run_platform,
+        "headline_provenance": "live" if head_name in ran else "banked",
+        "rows_live": live,
+        "rows_banked": banked,
+    }
+
+
 def main():
     platform, bringup_err = _bring_up_backend()
     _enable_compile_cache()
@@ -1522,6 +1585,9 @@ def main():
             matrix = json.load(f)
     except (OSError, ValueError):
         pass
+    # the previous capture's platform is the provenance fallback for
+    # merged rows that predate per-row platform stamps
+    prev_platform = matrix.get("platform")
     results = dict(matrix.get("configs", {}))
     if reduced and any(
             not r.get("reduced_scale") and "error" not in r
@@ -1547,16 +1613,26 @@ def main():
         "top-level measured_at.")
     if bringup_err:
         matrix["bringup_retried"] = bringup_err
+    ran = set()
     for name in names:
         try:
             results[name] = _CONFIGS[name](platform, reduced)
         except Exception as e:
             results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        ran.add(name)
         # per-row stamp: merge keeps rows from older runs/platforms, so
         # the top-level measured_at says nothing about THIS row (the
         # tpu_watchdog's fresh-capture check keys on bert_base's own)
+        # and the platform must travel WITH the row it describes
         results[name]["measured_at"] = time.strftime(
             "%Y-%m-%d %H:%M UTC", time.gmtime())
+        results[name]["platform"] = platform
+        from hetu_tpu import telemetry
+        telemetry.emit("bench_row", config=name, platform=platform,
+                       value=results[name].get("value"),
+                       mfu=results[name].get("mfu"),
+                       **({"error": results[name]["error"]}
+                          if "error" in results[name] else {}))
         matrix["configs"] = results
         try:
             # atomic: a stage timeout mid-dump must not truncate the
@@ -1581,6 +1657,7 @@ def main():
     target = 100.0      # driver-defined north star, samples/sec/chip
     value = head.get("value")
     head_reduced = head.get("reduced_scale", reduced)
+    from hetu_tpu.telemetry.health import stamp_provenance
     out = {
         "metric": ("bert_base_seq512_train_throughput"
                    if not head_reduced and head_name == "bert_base"
@@ -1591,17 +1668,27 @@ def main():
         "vs_baseline": (round(value / target, 3)
                         if value and not head_reduced
                         and head_name == "bert_base" else None),
-        "platform": platform,
+        # platform = the headline ROW's platform; rows_live/rows_banked
+        # make every row's provenance explicit (VERDICT weak #4: no
+        # more "cpu-fallback" wrapped around on-chip values)
+        **_provenance_fields(results, ran, head_name, platform,
+                             prev_platform),
         "mfu": head.get("mfu"),
         "device_kind": head.get("device_kind"),
-        "matrix": {n: {"value": r.get("value"), "unit": r.get("unit"),
-                       "mfu": r.get("mfu"),
-                       **({"error": r["error"]} if "error" in r else {})}
-                   for n, r in results.items()},
+        "matrix": {n: stamp_provenance(
+            {"value": r.get("value"), "unit": r.get("unit"),
+             "mfu": r.get("mfu"),
+             **({"error": r["error"]} if "error" in r else {})},
+            live=n in ran, measured_at=r.get("measured_at"))
+            for n, r in results.items()},
         "matrix_file": os.path.basename(_MATRIX_FILE),
     }
     if "error" in head:
         out["headline_error"] = head["error"]
+    if "health_warning" in head:
+        # the probe gate's degraded-window flag must surface on the
+        # headline, not just deep in the matrix row
+        out["headline_health"] = head["health_warning"]
     if bringup_err:
         out["bringup_retried"] = bringup_err
     if platform == "cpu-fallback" and os.path.exists(_TPU_LAST_FILE):

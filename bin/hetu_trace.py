@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""CLI for the telemetry streams: merge/tail JSONL, export Perfetto
+traces, check the event contract.  Logic lives in
+hetu_tpu/telemetry/trace.py; see its docstring for the format."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from hetu_tpu.telemetry.trace import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,51 @@ if ! grep -q 'verify gate OK' "$LOG/verify.log"; then
   exit 1
 fi
 
+# 00b. telemetry gate: one instrumented CPU train step + the event
+#      pipeline end to end — spans land in the merged JSONL, the
+#      contract checks clean, and bin/hetu_trace.py exports a loadable
+#      Perfetto trace.  Measurement plumbing is proven BEFORE any chip
+#      time; the exported trace is the window's first artifact.
+run telemetry 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/telemetry.jsonl" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np, hetu_tpu as ht
+x = ht.placeholder_op("x")
+w = ht.init.xavier_uniform((64, 64), name="tg_w")
+h = ht.relu_op(ht.matmul_op(x, w))
+loss = ht.reduce_mean_op(ht.reduce_mean_op(h, axes=1), axes=0)
+train = ht.optim.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+ex = ht.Executor({"train": [loss, train]})
+for _ in range(3):
+    ex.run("train", feed_dict={x: np.ones((8, 64), np.float32)})
+from hetu_tpu import telemetry
+snap = telemetry.snapshot()
+assert snap["counters"].get("exec.steps") == 3, snap["counters"]
+print("telemetry gate OK")
+PYEOF
+if ! grep -q 'telemetry gate OK' "$LOG/telemetry.log"; then
+  echo "telemetry gate FAILED — see $LOG/telemetry.log" >&2
+  exit 1
+fi
+run trace_export 300 python bin/hetu_trace.py "$LOG/telemetry.jsonl" \
+    --export "$LOG/trace.json"
+if ! python -c "
+import json
+t = json.load(open('$LOG/trace.json'))
+spans = [e for e in t['traceEvents'] if e.get('ph') == 'X']
+assert spans, 'exported trace has no duration events'
+print('trace artifact OK:', len(t['traceEvents']), 'events,',
+      len(spans), 'spans')
+"; then
+  echo "trace-artifact sanity check FAILED — see $LOG/trace.json" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/telemetry.jsonl" --check \
+    > "$LOG/trace_contract.log" || {
+  echo "event-contract check FAILED — see $LOG/trace_contract.log" >&2
+  exit 1
+}
+
 # 0. the rows a mid-capture wedge has previously cost us: the Aug-2
 #    recovery window measured bert_base/bert4l/gpt/resnet18 fresh, then
 #    the tunnel wedged INSIDE ctr_hybrid — so a fresh window banks the

@@ -22,6 +22,12 @@ Four rules, each born from a real regression class in this codebase:
   carry caches or optimizer state) must declare donation; without it
   every call copies the whole carried buffer (measured 320x on the
   serving cache scatter).
+- ``event-emit`` — JSONL event emission (``f.write(json.dumps(...) +
+  "\\n")``) outside ``hetu_tpu/telemetry/`` is an error: the repo once
+  grew FOUR independent emitters that merely happened to share a
+  record shape; ``telemetry.emit()`` is the one pipeline, and this
+  rule keeps it that way the same way ``env-registry`` keeps the env
+  registry authoritative.
 
 ``bin/hetu_lint.py`` is the CLI; ``tests/test_lint_clean.py`` keeps the
 repo itself clean, making the gate permanent tier-1.
@@ -33,7 +39,8 @@ import ast
 import os
 from dataclasses import dataclass
 
-RULES = ("env-registry", "np-in-compute", "time-in-jit", "jit-donate")
+RULES = ("env-registry", "np-in-compute", "time-in-jit", "jit-donate",
+         "event-emit")
 
 # trace-safe static/metadata helpers: run on python ints at trace time
 _NP_ALLOWED = frozenset({
@@ -256,6 +263,38 @@ def _check_jit_donate(tree, path, findings):
 
 
 # --------------------------------------------------------------------- #
+# rule: event-emit
+# --------------------------------------------------------------------- #
+
+def _check_event_emit(tree, path, findings):
+    # the telemetry sink is the ONE place allowed to write JSONL events
+    norm = path.replace(os.sep, "/")
+    if "/telemetry/" in norm or norm.startswith("telemetry/"):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain or chain[-1] != "write" or not node.args:
+            continue
+        arg = node.args[0]
+        has_dumps = any(
+            isinstance(x, ast.Call)
+            and (_attr_chain(x.func) or [])[-2:] in (["json", "dumps"],
+                                                     ["dumps"])
+            for x in ast.walk(arg))
+        has_newline = any(
+            isinstance(x, ast.Constant) and isinstance(x.value, str)
+            and "\n" in x.value for x in ast.walk(arg))
+        if has_dumps and has_newline:
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "event-emit",
+                "JSONL event emission outside hetu_tpu/telemetry/: "
+                "route records through telemetry.emit() (one pipeline, "
+                "one contract) instead of writing json lines directly"))
+
+
+# --------------------------------------------------------------------- #
 # driver
 # --------------------------------------------------------------------- #
 
@@ -264,6 +303,7 @@ _RULE_FNS = {
     "np-in-compute": _check_trace_bodies,   # shares a walker with
     "time-in-jit": _check_trace_bodies,     # time-in-jit
     "jit-donate": _check_jit_donate,
+    "event-emit": _check_event_emit,
 }
 
 

@@ -1,18 +1,17 @@
-"""JSONL reporting for the static checks, in the launcher's record shape.
+"""JSONL reporting for the static checks — a thin stream adapter over
+the ONE telemetry sink (telemetry/events.py).
 
-One contract across the repo (PR 1's event-log convention,
-``launcher.py _event`` / ``serving/metrics.py event``): every record is
-``{"t": <epoch seconds, 3 decimals>, "event": <kind>, **fields}``
-appended as one JSON line, so the same ``tail -f | jq`` pipeline reads
-failure events, serving telemetry, and (now) verifier reports.
+Contract unchanged since PR 1: every record is ``{"t": <epoch seconds,
+3 decimals>, "event": <kind>, **fields}`` appended as one JSON line.
+Records belong to the ``validate`` stream, so they land in
+``$HETU_VALIDATE_LOG`` (legacy path — the same ``tail -f | jq``
+pipeline as the failure log) plus the merged ``$HETU_TELEMETRY_LOG``.
 """
 
 from __future__ import annotations
 
-import json
-import time
-
 from .. import envvars
+from ..telemetry import events as _events
 
 
 def validation_log_path():
@@ -21,21 +20,16 @@ def validation_log_path():
 
 
 def make_record(event, **fields):
-    """One launcher-shaped record: {"t": ..., "event": event, **fields}."""
-    return {"t": round(time.time(), 3), "event": event, **fields}
+    """One contract-shaped record: {"t": ..., "event": event, **fields}."""
+    return _events.make_record(event, **fields)
 
 
 def emit_records(records, path=None):
-    """Append records (dicts from :func:`make_record`) to ``path`` or
-    ``$HETU_VALIDATE_LOG``.  Best-effort: an unwritable log must never
+    """Route records (dicts from :func:`make_record`) through the
+    telemetry sink's ``validate`` stream (``path`` overrides the
+    stream's env-var sink).  Best-effort: an unwritable log must never
     take down a build that validated fine."""
-    path = path if path is not None else validation_log_path()
-    if not path or not records:
+    if not records:
         return records
-    try:
-        with open(path, "a") as f:
-            for rec in records:
-                f.write(json.dumps(rec, default=str) + "\n")
-    except OSError:
-        pass
-    return records
+    return _events.get_sink().emit_prebuilt(records, stream="validate",
+                                            path=path)

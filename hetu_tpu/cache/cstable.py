@@ -37,7 +37,7 @@ import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
-from .. import envvars
+from .. import envvars, telemetry
 
 import numpy as np
 
@@ -79,6 +79,8 @@ class CacheSparseTable:
         self.num_stale_served = 0
         self.num_zero_served = 0
         self.num_replayed_rows = 0
+        self._evictions_seen = 0    # telemetry delta base (clean
+        # evictions don't surface through insert's dirty write-back)
 
     # ---------------- outage machinery ---------------- #
 
@@ -109,6 +111,7 @@ class CacheSparseTable:
                          np.zeros((0, self.width), np.float32))
         self.num_replayed_rows += len(bids)
         self.num_pushed_rows += len(bids)
+        telemetry.inc("cache.writeback_rows", len(bids))
         self._outage = 0
 
     def _push_or_buffer(self, ids, grads):
@@ -121,6 +124,7 @@ class CacheSparseTable:
             try:
                 self.comm.push_embedding(self.key, ids, grads)
                 self.num_pushed_rows += len(ids)
+                telemetry.inc("cache.writeback_rows", len(ids))
                 self._outage = 0
                 return
             except ConnectionError as e:
@@ -148,6 +152,11 @@ class CacheSparseTable:
         self.num_rows_looked += len(uniq)
 
         rows, hit = self.cache.lookup(uniq)
+        # process-wide cache accounting (telemetry registry) on top of
+        # the per-table instance counters below
+        n_hit = int(hit.sum())
+        telemetry.inc("cache.hits", n_hit)
+        telemetry.inc("cache.misses", len(uniq) - n_hit)
 
         # bounded-staleness re-sync of hits.  Locally-dirty lines are
         # excluded from the refresh: overwriting them would drop our own
@@ -193,6 +202,10 @@ class CacheSparseTable:
                 self._outage = 0
                 ev_ids, ev_grads = self.cache.insert(miss_ids, pulled,
                                                      vers)
+                ev_total = self.cache.counters()["evictions"]
+                telemetry.inc("cache.evictions",
+                              ev_total - self._evictions_seen)
+                self._evictions_seen = ev_total
                 self._push_or_buffer(ev_ids, ev_grads)
                 self.num_pulled_rows += len(miss_ids)
                 rows[~hit] = pulled

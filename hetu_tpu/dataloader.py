@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 
 import numpy as np
 
@@ -68,6 +69,21 @@ class _PrefetchRing:
                 raise self.error
 
     def get(self):
+        from . import telemetry
+        if telemetry.enabled():
+            # ring health: how long the trainer blocked on the producer
+            # (wait > 0 means the host pipeline, not the chip, paces the
+            # step) and how full the lookahead ran after the pop
+            t0 = time.perf_counter()
+            self._wait_nonempty()
+            telemetry.observe("dataloader.wait_ms",
+                              (time.perf_counter() - t0) * 1e3)
+            with self.cv:
+                item = self.buf.popleft()
+                telemetry.set_gauge("dataloader.ring_depth",
+                                    len(self.buf))
+                self.cv.notify_all()
+            return item
         self._wait_nonempty()
         with self.cv:
             item = self.buf.popleft()

@@ -56,6 +56,23 @@ _reg("HETU_VALIDATE_LOG", "path", None,
      "failure-log record shape ({t, event, ...}).", "validate")
 
 # --------------------------------------------------------------------- #
+# telemetry (hetu_tpu/telemetry/)
+# --------------------------------------------------------------------- #
+_reg("HETU_TELEMETRY", "bool", True,
+     "Master switch for telemetry spans + metric instrumentation "
+     "(executor step phases, PS RPC, cache, dataloader ring, serving). "
+     "0 = no-op spans and no metric recording; the explicit event "
+     "streams (failure/serve/validate) still flow.", "telemetry")
+_reg("HETU_TELEMETRY_LOG", "path", None,
+     "Merged run-wide JSONL sink: EVERY stream's records (failure/"
+     "serve/validate + telemetry spans) also append here — the one "
+     "file bin/hetu_trace.py merges, tails, and exports to a "
+     "Chrome/Perfetto trace.", "telemetry")
+_reg("HETU_TELEMETRY_BUFFER", "int", 4096,
+     "In-memory event-ring capacity behind telemetry.snapshot().",
+     "telemetry")
+
+# --------------------------------------------------------------------- #
 # multi-process / TPU bring-up
 # --------------------------------------------------------------------- #
 _reg("HETU_TPU_COORDINATOR", "str", None,

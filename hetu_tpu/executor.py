@@ -476,37 +476,52 @@ class SubExecutor:
         return min(nums) if nums else None
 
     def run(self, feed_dict, convert_to_numpy_ret_vals=False):
+        from . import telemetry
         ex = self.executor
         feeds = gather_feeds(self, feed_dict)
         # read-your-writes: the previous step's async push must land in
         # the cache/PS before this step's lookups
         ex.join_ps_push()
-        ps_ids = self._ps_phase_a(feeds)
+        with telemetry.span("exec.phase_a", subgraph=self.name):
+            ps_ids = self._ps_phase_a(feeds)
         feed_sig = tuple(sorted(
             (k, tuple(v.shape), str(v.dtype)) for k, v in feeds.items()))
-        if feed_sig not in self._compiled:
+        compiled_now = feed_sig not in self._compiled
+        if compiled_now:
             # pre-trace validation with the concrete feed shapes: a
             # miswired graph fails HERE with the node named, not as an
             # XLA stack dump out of the compile below (HETU_VALIDATE=1)
-            from .analysis import validate_subgraph_feeds
-            validate_subgraph_feeds(ex, self, feeds)
-            self._compiled[feed_sig] = self._compile(feed_sig)
+            telemetry.inc("exec.compile_cache_miss")
+            with telemetry.span("exec.compile", subgraph=self.name):
+                from .analysis import validate_subgraph_feeds
+                validate_subgraph_feeds(ex, self, feeds)
+                self._compiled[feed_sig] = self._compile(feed_sig)
         fn = self._compiled[feed_sig]
         if ex.mesh is not None:
             feeds = {k: ex.device_put_feed(k, v) for k, v in feeds.items()}
-        ex.var_values, ex.opt_states, ex.step, ex.rng, outputs, side = fn(
-            ex.var_values, ex.opt_states, ex.step, ex.rng, feeds)
+        # dispatch covers trace+compile on a cache-miss step (jax.jit is
+        # lazy — the first call lowers); `compiled` marks those spans so
+        # the trace attributes the fat step correctly
+        with telemetry.span("exec.dispatch", subgraph=self.name,
+                            compiled=compiled_now):
+            ex.var_values, ex.opt_states, ex.step, ex.rng, outputs, side \
+                = fn(ex.var_values, ex.opt_states, ex.step, ex.rng, feeds)
+        telemetry.inc("exec.steps")
         if self.ps_var_names and self.training:
             if self._phase_b_pool is not None:
                 # the worker blocks on the grads' D2H, pushes, THEN
                 # prefetches (so the prefetched rows see the update);
                 # the main thread returns to the training loop
                 def _push():
-                    self._ps_phase_b(side, ps_ids)
+                    with telemetry.span("exec.phase_b",
+                                        subgraph=self.name, mode="async"):
+                        self._ps_phase_b(side, ps_ids)
                     self._ps_prefetch()
                 ex._ps_push_future = self._phase_b_pool.submit(_push)
             else:
-                self._ps_phase_b(side, ps_ids)
+                with telemetry.span("exec.phase_b", subgraph=self.name,
+                                    mode="sync"):
+                    self._ps_phase_b(side, ps_ids)
                 self._ps_prefetch()
         else:
             self._ps_prefetch()

@@ -21,7 +21,6 @@ same way hetu_cache_test.py:11-34 uses it).
 
 from __future__ import annotations
 
-import json
 import os
 import signal
 import socket
@@ -189,17 +188,14 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655,
     _procs.clear()
     global last_failure_events
     events = last_failure_events = []
-    log_path = envvars.get_path("HETU_FAILURE_LOG")
 
     def _event(kind, **fields):
-        rec = {"t": round(time.time(), 3), "event": kind, **fields}
+        # ONE emitter repo-wide (telemetry/events.py): the sink appends
+        # to $HETU_FAILURE_LOG (legacy stream path) and the merged
+        # $HETU_TELEMETRY_LOG in the same {t, event, ...} shape
+        from .telemetry import emit
+        rec = emit(kind, _stream="failure", **fields)
         events.append(rec)
-        if log_path:
-            try:
-                with open(log_path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-            except OSError:
-                pass
         print(f"[heturun] {kind}: {fields}", flush=True)
 
     if supervise is None:

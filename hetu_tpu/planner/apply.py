@@ -97,5 +97,17 @@ def plan_to_json(plan):
             hasattr(plan.cluster, "assumed_constants"):
         # which cost-model constants ranked this plan WITHOUT a
         # measurement (ICI/DCN bandwidth can't be measured on one chip)
-        out["assumed_constants"] = plan.cluster.assumed_constants()
+        assumed = plan.cluster.assumed_constants()
+        out["assumed_constants"] = assumed
+        if assumed:
+            # prominent honesty banner (VERDICT next #6): a consumer
+            # reading only the top of the artifact must see that this
+            # ranking trusts spec sheets, not measurements
+            out["WARNING"] = (
+                "cost-model constants unvalidated on hardware: "
+                + ", ".join(f"{k} ({v['provenance']})"
+                            for k, v in sorted(assumed.items()))
+                + " — plan ranking is spec-assumed where marked; run "
+                  "hetu_tpu.planner.env_profile on a real multi-chip "
+                  "mesh to measure")
     return out

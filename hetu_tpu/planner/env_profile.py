@@ -140,14 +140,30 @@ def _axis_overlap(mesh, axis, compute_dim=1024, size_mb=4):
     }
 
 
-def profile_env(axes=None, size_mb=8, compute_dim=1024):
+def profile_env(axes=None, size_mb=8, compute_dim=1024, claim=None):
     """Full environment profile for a mesh of ``axes`` (default: one
-    'dp' axis over every visible device)."""
+    'dp' axis over every visible device).
+
+    ``claim``: what the caller intends the numbers to characterize
+    ("chip" or "host").  A CPU-platform run REFUSES a "chip" claim
+    (VERDICT next #6: the virtual-mesh numbers characterize the host,
+    and single-chip calibration cannot fix ICI/DCN) — the artifact
+    always carries an explicit ``characterizes`` field plus a banner
+    when it is not chip-grade."""
     if not axes:
         axes = {"dp": jax.device_count()}
+    platform = jax.default_backend()
+    characterizes = "chip" if platform in ("tpu", "gpu") else "host"
+    if claim == "chip" and characterizes != "chip":
+        raise ValueError(
+            f"refusing to label a {platform}-platform profile as "
+            f"chip-characterizing: the virtual mesh measures the HOST "
+            f"(collective bandwidth over shared memory, not ICI/DCN); "
+            f"run on real multi-chip hardware for a chip claim")
     mesh = make_mesh(axes)
     art = {
-        "platform": jax.default_backend(),
+        "platform": platform,
+        "characterizes": characterizes,
         "device_kind": jax.devices()[0].device_kind,
         "measured_at": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
         "mesh_axes": dict(mesh.shape),
@@ -157,6 +173,12 @@ def profile_env(axes=None, size_mb=8, compute_dim=1024):
             profile_matmul_throughput(dim=compute_dim) / 1e12, 6),
         "axes": {},
     }
+    if characterizes != "chip":
+        art["WARNING"] = (
+            "cpu-platform profile: these numbers characterize the HOST "
+            "(virtual mesh over shared memory); they are NOT measured "
+            "ICI/DCN bandwidths and must not be fed to a chip cost "
+            "model as measurements")
     for axis in mesh.shape:
         if mesh.shape[axis] <= 1:
             continue
@@ -190,6 +212,8 @@ def main():
     atomic_json_dump(args.out, art)
     print(json.dumps({
         "platform": art["platform"],
+        "characterizes": art["characterizes"],
+        **({"WARNING": art["WARNING"]} if "WARNING" in art else {}),
         "matmul_tflops_bf16": art["matmul_tflops_bf16"],
         "axes": {a: {"allreduce_GBps": round(
             v["collectives"]["allreduce_bytes_per_s"] / 1e9, 3),

@@ -124,18 +124,29 @@ class ParallelPlan:
                                       self.mesh_axes()["pp"])
 
     def describe(self):
-        lines = [f"total cost {self.cost * 1e3:.3f} ms/step; "
-                 f"mesh {self.mesh_axes()}"]
-        for l, s in zip(self.layers, self.strategies):
-            lines.append(f"  {l.name}: {s}")
+        assumed = {}
         if self.cluster is not None and \
                 hasattr(self.cluster, "assumed_constants"):
             assumed = self.cluster.assumed_constants()
-            if assumed:
-                lines.append(
-                    "  [cost-model constants NOT from measurement: "
-                    + ", ".join(f"{k} ({v['provenance']})"
-                                for k, v in assumed.items()) + "]")
+        lines = []
+        if assumed:
+            # banner FIRST (VERDICT next #6): the reader must hit the
+            # honesty disclaimer before the cost/layout it qualifies
+            lines.append(
+                "*** WARNING: cost-model constants unvalidated on "
+                "hardware — "
+                + ", ".join(f"{k} ({v['provenance']})"
+                            for k, v in sorted(assumed.items()))
+                + " ***")
+        lines.append(f"total cost {self.cost * 1e3:.3f} ms/step; "
+                     f"mesh {self.mesh_axes()}")
+        for l, s in zip(self.layers, self.strategies):
+            lines.append(f"  {l.name}: {s}")
+        if assumed:
+            lines.append(
+                "  [cost-model constants NOT from measurement: "
+                + ", ".join(f"{k} ({v['provenance']})"
+                            for k, v in assumed.items()) + "]")
         return "\n".join(lines)
 
 

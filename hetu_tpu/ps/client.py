@@ -81,12 +81,16 @@ class _TCPTransport:
         return s
 
     def call(self, method, *args, **kwargs):
+        from .. import telemetry
         st = self._state()
         st.seq += 1
         payload = wire.dumps(
             ("__req2__", st.client_id, st.seq, method, args, kwargs))
         chaos = faults.plan_from_env()
         last_err = None
+        tel = telemetry.enabled()
+        shard = f"{self.host}:{self.port}"
+        t_call = time.perf_counter() if tel else 0.0
         for attempt in range(self.retries):
             # chaos seam (HETU_CHAOS): one decision per ATTEMPT, so an
             # injected loss exercises exactly the reconnect/resend path
@@ -122,10 +126,25 @@ class _TCPTransport:
                         "chaos: response dropped after apply")
                 if fault is not None and fault.kind == "slow":
                     time.sleep(fault.seconds)
+                if tel:
+                    # per-shard RPC accounting (PS client half of the
+                    # reference NCCLProfiler's comm visibility)
+                    telemetry.observe(
+                        "ps.rpc_ms." + method,
+                        (time.perf_counter() - t_call) * 1e3)
+                    telemetry.inc(f"ps.rpc.calls[{shard}]")
+                    telemetry.inc("ps.rpc.bytes_sent", len(payload))
+                    telemetry.inc("ps.rpc.bytes_recv", len(raw))
+                    if attempt:
+                        telemetry.inc("ps.rpc.recovered")
                 return result
             except (OSError, ConnectionError, socket.timeout, EOFError,
                     wire.WireError) as e:
                 last_err = e
+                if tel:
+                    telemetry.inc(f"ps.rpc.retries[{shard}]")
+                    if isinstance(e, socket.timeout):
+                        telemetry.inc(f"ps.rpc.timeouts[{shard}]")
                 if st.sock is not None:
                     try:
                         st.sock.close()
@@ -137,6 +156,8 @@ class _TCPTransport:
                     # no backoff for synthetic losses: chaos runs model
                     # packet loss, not congestion
                     time.sleep(min(2.0, 0.2 * (attempt + 1)))
+        if tel:
+            telemetry.inc(f"ps.rpc.failures[{shard}]")
         raise PSConnectionError(
             f"PS request {method!r} to {self.host}:{self.port} failed "
             f"after {self.retries} attempts (last: "
@@ -155,9 +176,20 @@ def _local_chaos_call(server, method, args, kwargs):
     so losses retry immediately; ``dup`` cannot double-apply in-process
     (a returned response cannot be lost) and degrades to a no-op
     decision; ``kill`` and the latency kinds behave as on the wire."""
+    from .. import telemetry
+    tel = telemetry.enabled()
+    t_call = time.perf_counter() if tel else 0.0
+
+    def _done(result):
+        if tel:
+            telemetry.observe("ps.rpc_ms." + method,
+                              (time.perf_counter() - t_call) * 1e3)
+            telemetry.inc("ps.rpc.calls[local]")
+        return result
+
     chaos = faults.plan_from_env()
     if chaos is None:
-        return getattr(server, method)(*args, **kwargs)
+        return _done(getattr(server, method)(*args, **kwargs))
     last = None
     for _ in range(3):
         fault = chaos.draw(method)
@@ -165,11 +197,15 @@ def _local_chaos_call(server, method, args, kwargs):
             time.sleep(fault.seconds)
         elif fault.kind in ("drop", "reset"):
             last = faults.InjectedFault(f"chaos: {fault.kind} (local)")
+            if tel:
+                telemetry.inc("ps.rpc.retries[local]")
             continue
         result = getattr(server, method)(*args, **kwargs)
         if fault.kind == "slow":
             time.sleep(fault.seconds)
-        return result
+        return _done(result)
+    if tel:
+        telemetry.inc("ps.rpc.failures[local]")
     raise PSConnectionError(
         f"local PS call {method!r} dropped by chaos 3 times") from last
 

@@ -868,6 +868,9 @@ def _serve_object_tcp(obj, port, block=True):
                                       kinds=("kill", "slow", "delay"))
                         if f.kind in ("slow", "delay"):
                             time.sleep(f.seconds)
+                    from .. import telemetry
+                    tel = telemetry.enabled()
+                    t_handle = time.perf_counter() if tel else 0.0
                     try:
                         if method.startswith("_"):
                             raise AttributeError(
@@ -876,6 +879,18 @@ def _serve_object_tcp(obj, port, block=True):
                         payload = wire.dumps((True, result))
                     except Exception as e:  # noqa: BLE001
                         payload = wire.dumps((False, repr(e)))
+                        if tel:
+                            telemetry.inc("ps.server.errors")
+                    if tel:
+                        # server half of the RPC accounting: apply time
+                        # + request/response bytes per verb
+                        telemetry.observe(
+                            "ps.server.handle_ms." + str(method),
+                            (time.perf_counter() - t_handle) * 1e3)
+                        telemetry.inc("ps.server.requests")
+                        telemetry.inc("ps.server.bytes_in", len(raw))
+                        telemetry.inc("ps.server.bytes_out",
+                                      len(payload))
                     if cid is not None:
                         with replay_cv:
                             replay[cid] = (seq, payload)

@@ -132,7 +132,10 @@ class ShardedPSClient:
     # ------------------------------------------------------------------ #
 
     def _event(self, kind, **fields):
-        rec = {"t": round(time.time(), 3), "event": kind, **fields}
+        # failover/resync records ride the failure stream of the one
+        # telemetry sink (merged JSONL + in-memory list, same shape)
+        from .. import telemetry
+        rec = telemetry.emit(kind, _stream="failure", **fields)
         self.failure_events.append(rec)
         print(f"[ps-client] {kind}: {fields}", flush=True)
 

@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..models.gpt_decode import (
     _infer_name, _prep_param, _pow2, _resolve_fast, serve_decode_fn,
     serve_prefill_batch_fn, serve_prefill_fn,
@@ -164,6 +165,7 @@ class ServingEngine:
                                                   len(req.prompt))))
             if not admits:
                 break
+            telemetry.inc("serve.admission_waves")
             groups = {}
             for req, slot in admits:
                 pb = self.kv.bucket_prompt(len(req.prompt))

@@ -1,10 +1,10 @@
 """Serving telemetry: per-request latency aggregates + engine gauges.
 
-Structured events follow the launcher's convention (launcher.py
-``_event``): ``{"t": <epoch>, "event": <kind>, **fields}`` records kept
-in memory and, when a log path is set (argument or ``$HETU_SERVE_LOG``),
-appended as JSONL — the same shape ``$HETU_FAILURE_LOG`` uses, so one
-tail/jq pipeline reads both streams.
+Structured events flow through the ONE telemetry sink
+(telemetry/events.py): ``{"t": <epoch>, "event": <kind>, **fields}``
+records kept in memory and appended as JSONL to the ``serve`` stream —
+``$HETU_SERVE_LOG`` (legacy path, one tail/jq pipeline with the failure
+log) plus the merged ``$HETU_TELEMETRY_LOG``.
 
 Aggregates answer the serving questions: TTFT percentiles (queue wait
 included — measured from submit to first token), decode tokens/s, mean
@@ -13,11 +13,9 @@ batch occupancy (how full the fused step ran), queue depth.
 
 from __future__ import annotations
 
-import json
-import os
 import time
 
-from .. import envvars
+from .. import envvars, telemetry
 
 import numpy as np
 
@@ -51,14 +49,9 @@ class ServingMetrics:
     # ------------------------------------------------------------- #
 
     def event(self, kind, **fields):
-        rec = {"t": round(time.time(), 3), "event": kind, **fields}
+        rec = telemetry.emit(kind, _stream="serve", _path=self.log_path,
+                             **fields)
         self.events.append(rec)
-        if self.log_path:
-            try:
-                with open(self.log_path, "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-            except OSError:
-                pass
         return rec
 
     def _mark(self):

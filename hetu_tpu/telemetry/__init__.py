@@ -1,0 +1,34 @@
+"""Unified telemetry: run-wide spans, a metrics registry, ONE event
+pipeline, and measurement health gates.
+
+Every layer emits into this subsystem and every tool reads from it:
+
+- :mod:`.events` — the single ``emit()`` every JSONL record flows
+  through (streams: failure/serve/validate/telemetry; legacy
+  ``HETU_FAILURE_LOG``-style sinks plus the merged
+  ``$HETU_TELEMETRY_LOG``), ``span()`` context managers, and the
+  event-shape contract.
+- :mod:`.metrics` — thread-safe counters/gauges/histograms behind
+  ``snapshot()``.
+- :mod:`.health` — banking gates: sibling-consistency, physics
+  ceiling, live-vs-banked provenance stamps (bench.py wires them).
+- :mod:`.trace` — merge/tail the streams, export Perfetto traces
+  (``bin/hetu_trace.py``).
+
+``HETU_TELEMETRY=0`` turns spans and metric recording into no-ops.
+"""
+
+from . import health, metrics, trace  # noqa: F401  (submodule surface)
+from .events import (  # noqa: F401
+    REQUIRED_FIELDS, STREAMS, TelemetrySink, counter, emit, enabled,
+    gauge, get_sink, histogram, inc, make_record, observe, reset,
+    set_gauge, snapshot, span, validate_record,
+)
+from .metrics import REGISTRY  # noqa: F401
+
+__all__ = [
+    "REQUIRED_FIELDS", "STREAMS", "REGISTRY", "TelemetrySink",
+    "counter", "emit", "enabled", "gauge", "get_sink", "health",
+    "histogram", "inc", "make_record", "metrics", "observe", "reset",
+    "set_gauge", "snapshot", "span", "trace", "validate_record",
+]

@@ -1,0 +1,183 @@
+"""Merge/tail the JSONL telemetry streams; export a Perfetto trace.
+
+``bin/hetu_trace.py`` is the CLI.  Input is any number of
+contract-shaped JSONL files (``{"t", "event", ...}`` — the merged
+``$HETU_TELEMETRY_LOG`` or the per-stream legacy logs); with no paths
+given, every stream log currently configured in the environment is
+read.  Output:
+
+- default: the merged, time-sorted stream as JSONL on stdout (the
+  one ``tail | jq`` pipeline, now across all streams at once);
+- ``--export trace.json``: a Chrome/Perfetto-loadable trace —
+  duration-carrying records (``span``/``serve_step``/``serve_prefill``)
+  become ``"X"`` complete events laid out per pid/thread track,
+  everything else an ``"i"`` instant — plus a one-line summary on
+  stdout.
+
+Durations: a ``span`` record's ``t`` is its START epoch and ``ms`` its
+length (events.py writes them that way); serving step/prefill records
+timestamp the END of the phase, so the exporter backdates their start
+by the duration field.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from .. import envvars
+from .events import STREAMS, validate_record
+
+# kind -> (duration field in ms, track name); t marks the end for the
+# serving kinds (their emitter stamps after the phase completes)
+_DUR_FIELDS = {
+    "span": ("ms", None),              # name comes from the record
+    "serve_prefill": ("prefill_ms", "serve.prefill"),
+    "serve_step": ("decode_ms", "serve.decode"),
+}
+_T_IS_END = ("serve_prefill", "serve_step")
+
+
+def configured_logs():
+    """Every stream log path currently set in the environment."""
+    paths = []
+    for env in list(STREAMS.values()) + ["HETU_TELEMETRY_LOG"]:
+        if env:
+            p = envvars.get_path(env)
+            if p and p not in paths:
+                paths.append(p)
+    return paths
+
+
+def read_events(paths, strict=False):
+    """Parse + merge JSONL files, time-sorted.  Bad lines are counted,
+    not fatal (a crashed writer may leave a torn tail) unless
+    ``strict``."""
+    events, bad = [], 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for ln in lines:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                bad += 1
+                if strict:
+                    raise
+                continue
+            if isinstance(rec, dict) and "t" in rec and "event" in rec:
+                rec["_src"] = os.path.basename(path)
+                events.append(rec)
+            else:
+                bad += 1
+    events.sort(key=lambda r: (r.get("t", 0.0)))
+    return events, bad
+
+
+def to_chrome_trace(events):
+    """Chrome trace-event JSON (Perfetto-loadable): spans as complete
+    ("X") events, point events as instants ("i"), with thread-name
+    metadata so tracks read as the emitting thread."""
+    out = []
+    tids = {}
+
+    def tid_for(pid, name):
+        key = (pid, name)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tids[key], "args": {"name": str(name)}})
+        return tids[key]
+
+    n_spans = 0
+    for rec in events:
+        kind = rec.get("event")
+        pid = int(rec.get("pid", 0))
+        tid = tid_for(pid, rec.get("tid", rec.get("_src", "events")))
+        ts_us = float(rec.get("t", 0.0)) * 1e6
+        args = {k: v for k, v in rec.items()
+                if k not in ("t", "event", "pid", "tid", "_src")
+                and isinstance(v, (int, float, str, bool))}
+        dur_spec = _DUR_FIELDS.get(kind)
+        dur_ms = (rec.get(dur_spec[0])
+                  if dur_spec is not None else None)
+        if isinstance(dur_ms, (int, float)):
+            dur_us = float(dur_ms) * 1e3
+            if kind in _T_IS_END:
+                ts_us -= dur_us
+            name = rec.get("name") or dur_spec[1] or kind
+            out.append({"name": str(name), "cat": kind, "ph": "X",
+                        "ts": ts_us, "dur": dur_us, "pid": pid,
+                        "tid": tid, "args": args})
+            n_spans += 1
+        else:
+            out.append({"name": str(kind), "cat": "event", "ph": "i",
+                        "s": "t", "ts": ts_us, "pid": pid, "tid": tid,
+                        "args": args})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}, n_spans
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hetu_trace",
+        description="Merge the telemetry JSONL streams; optionally "
+                    "export a Chrome/Perfetto trace of the spans.")
+    ap.add_argument("paths", nargs="*",
+                    help="JSONL files (default: every HETU_*_LOG / "
+                         "HETU_TELEMETRY_LOG set in the environment)")
+    ap.add_argument("--export", metavar="TRACE_JSON",
+                    help="write a Perfetto-loadable trace.json and "
+                         "print a summary line instead of the stream")
+    ap.add_argument("--last", type=int, default=None, metavar="N",
+                    help="only the newest N records (tail semantics)")
+    ap.add_argument("--events", default=None,
+                    help="comma-separated kind filter "
+                         "(e.g. span,serve_step)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate every record against the event "
+                         "contract; exit 1 on violations")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or configured_logs()
+    if not paths:
+        ap.error("no paths given and no HETU_*_LOG configured")
+    events, bad = read_events(paths)
+    if args.events:
+        kinds = {k.strip() for k in args.events.split(",") if k.strip()}
+        events = [e for e in events if e.get("event") in kinds]
+    if args.last:
+        events = events[-args.last:]
+
+    if args.check:
+        problems = []
+        for rec in events:
+            for p in validate_record(rec):
+                problems.append(f"{rec.get('_src')}: {p}: "
+                                f"{json.dumps(rec)[:160]}")
+        for p in problems:
+            print(p)
+        print(json.dumps({"records": len(events), "bad_lines": bad,
+                          "contract_violations": len(problems)}))
+        return 1 if problems or bad else 0
+
+    if args.export:
+        trace, n_spans = to_chrome_trace(events)
+        with open(args.export, "w") as f:
+            json.dump(trace, f)
+        print(json.dumps({
+            "records": len(events), "bad_lines": bad,
+            "spans": n_spans,
+            "trace_events": len(trace["traceEvents"]),
+            "out": args.export}))
+        return 0
+
+    for rec in events:
+        print(json.dumps(rec))
+    return 0

@@ -1,0 +1,11 @@
+"""Lint fixture: trips the ``event-emit`` rule — JSONL event emission
+outside hetu_tpu/telemetry/ (the pre-subsystem pattern every emitter
+used; telemetry.emit() is the one pipeline now)."""
+
+import json
+
+
+def log_event(path, kind, **fields):
+    rec = {"t": 0.0, "event": kind, **fields}
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")    # <- finding: event-emit

@@ -447,6 +447,26 @@ class TestLint:
     def test_fixture_jit_donate(self):
         assert "jit-donate" in self._rules_hit("trip_jit_donate.py")
 
+    def test_fixture_event_emit(self):
+        assert "event-emit" in self._rules_hit("trip_event_emit.py")
+
+    def test_event_emit_allowed_inside_telemetry(self):
+        # the sink itself is the one legal JSONL writer
+        src = ('import json\n'
+               'def w(f, rec):\n'
+               '    f.write(json.dumps(rec) + "\\n")\n')
+        flagged = lint_source(src, path="hetu_tpu/other/mod.py")
+        assert any(f.rule == "event-emit" for f in flagged)
+        assert lint_source(src, path="hetu_tpu/telemetry/events.py") == []
+
+    def test_event_emit_ignores_plain_json_writes(self):
+        # whole-file json dumps (artifacts) are not JSONL event streams
+        src = ('import json\n'
+               'def save(path, obj):\n'
+               '    with open(path, "w") as f:\n'
+               '        f.write(json.dumps(obj))\n')
+        assert lint_source(src) == []
+
     def test_clean_fixture_quiet(self):
         assert self._rules_hit("clean.py") == set()
 

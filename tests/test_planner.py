@@ -499,6 +499,14 @@ class TestPlanAssumedConstants:
         assert j["assumed_constants"]["ici_bandwidth"]["provenance"] == \
             "spec-assumed"
         assert "NOT from measurement" in plan.describe()
+        # planner honesty (VERDICT next #6): the banner is PROMINENT —
+        # a top-level WARNING key in the json, the FIRST line of
+        # describe() — not a footnote
+        assert "unvalidated on hardware" in j["WARNING"]
+        assert "ici_bandwidth" in j["WARNING"]
+        desc = plan.describe()
+        assert desc.splitlines()[0].startswith("*** WARNING")
+        assert "unvalidated on hardware" in desc.splitlines()[0]
 
 
 class TestEnvProfiler:
@@ -518,6 +526,19 @@ class TestEnvProfiler:
             ov = art["axes"][ax]["overlap"]
             assert 0.0 <= ov["overlap"] <= 1.0
         assert art["matmul_tflops_bf16"] > 0
+
+    def test_cpu_profile_refuses_chip_label(self):
+        """Planner honesty (VERDICT next #6): a CPU-platform profile is
+        host-characterizing — labeled so in the artifact with a WARNING
+        banner, and a 'chip' claim is refused outright."""
+        from hetu_tpu.planner.env_profile import profile_env
+        art = profile_env({"dp": 1}, size_mb=1, compute_dim=64)
+        assert art["platform"] == "cpu"
+        assert art["characterizes"] == "host"
+        assert "characterize the HOST" in art["WARNING"]
+        with pytest.raises(ValueError, match="refusing to label"):
+            profile_env({"dp": 1}, size_mb=1, compute_dim=64,
+                        claim="chip")
 
     def test_cli_writes_artifact(self, tmp_path):
         import json
