@@ -1210,6 +1210,8 @@ def bench_serve(platform, reduced):
                         if heavy["masked"]["tokens_per_sec"] else None)
 
     phase_ab = _serve_phase_ab(params, cfg, dt_, reduced)
+    paged_ab = _serve_paged_ab(params, cfg, dt_, slots, s_max, vocab,
+                               n_req)
 
     art = {
         "platform": platform,
@@ -1235,6 +1237,7 @@ def bench_serve(platform, reduced):
         "fast_path_ab": ab,
         "prefill_heavy": heavy,
         "phase_ab": phase_ab,
+        "paged_ab": paged_ab,
         "trace": {"seed": 1234, "n_requests": n_req,
                   "prompt_len": "4..16", "short_new_tokens": "8..32",
                   "straggler_every": 8, "straggler_new_tokens": straggle,
@@ -1250,6 +1253,94 @@ def bench_serve(platform, reduced):
     }
     _persist_artifact(_SERVE_FILE, art, reduced, has_data=True)
     return art
+
+
+def _serve_paged_ab(params, cfg, dt_, slots, s_max, vocab, n_req):
+    """Paged-vs-contiguous KV at EQUAL cache bytes on a prefix-heavy
+    trace (every request shares one long system prompt, deliberately
+    NOT block-aligned so copy-on-write forks are exercised).  The
+    contiguous layout pays slots * S_max tokens no matter what; the
+    paged pool holds the same bytes as blocks, stores the shared prefix
+    ONCE, and reserves only each request's actual span — so it holds
+    more concurrent sequences per HBM byte, which is the occupancy
+    number that turns into tok/s on chip.  Records
+    peak_concurrent_slots and hbm_bytes_per_slot for both layouts plus
+    the pool's sharing/COW counters; greedy outputs must be identical
+    (this is suite stage 4c's A/B of record alongside masked-vs-ragged).
+    """
+    from hetu_tpu.serving import Request, ServingEngine
+
+    rng = np.random.RandomState(777)
+    block = 16
+    prefix = rng.randint(0, vocab, s_max // 4 + 1).astype(np.int32)
+    trace = []
+    for _ in range(n_req - max(2, n_req // 8)):
+        tail = rng.randint(0, vocab,
+                           int(rng.randint(4, 9))).astype(np.int32)
+        trace.append((np.concatenate([prefix, tail]),
+                      int(rng.randint(8, 17))))
+    # follow-up turns: extend an earlier request's FULL prompt verbatim
+    # (multi-turn shape) — these match a full-length prefix entry
+    # mid-block and exercise the copy-on-write fork
+    for i in range(max(2, n_req // 8)):
+        ext = rng.randint(0, vocab,
+                          int(rng.randint(4, 9))).astype(np.int32)
+        trace.append((np.concatenate([trace[i][0], ext]),
+                      int(rng.randint(8, 17))))
+    useful = sum(g for _, g in trace)
+    # equal bytes: the contiguous pair is slots * S_max tokens; the
+    # pool gets the same token count in blocks (+ the scratch block)
+    pool = slots * (s_max // block) + 1
+
+    def run(paged):
+        if paged:
+            kw = dict(paged=True, kv_block=block, pool_blocks=pool,
+                      slots=min(slots * 8, 64), prefix_share=True)
+        else:
+            kw = dict(paged=False, slots=slots)
+        mk = lambda: [Request(prompt=p, max_new_tokens=g)
+                      for p, g in trace]
+        warm = ServingEngine(params, cfg, queue_limit=n_req, dtype=dt_,
+                             **kw)
+        warm.run(mk())
+        e = ServingEngine(params, cfg, queue_limit=n_req, dtype=dt_,
+                          **kw)
+        t0 = time.perf_counter()
+        res = e.run(mk())
+        wall = time.perf_counter() - t0
+        bytes_ = int(e.kv.cache_k.nbytes + e.kv.cache_v.nbytes)
+        peak = max(e.peak_live, 1)
+        row = {
+            "tokens_per_sec": round(useful / wall, 1),
+            "wall_s": round(wall, 3),
+            "peak_concurrent_slots": e.peak_live,
+            "cache_bytes": bytes_,
+            "hbm_bytes_per_slot": int(bytes_ / peak),
+        }
+        if paged:
+            row["kv"] = e.kv.stats()
+            row["prefill_chunks"] = e.prefill_chunks
+        return row, sorted(r.tokens.tolist() for r in res.values())
+
+    cont, out_c = run(False)
+    pg, out_p = run(True)
+    return {
+        "trace": {"seed": 777, "n_requests": n_req,
+                  "shared_prefix_len": int(len(prefix)),
+                  "tail_len": "4..8", "new_tokens": "8..16",
+                  "followup_turns": max(2, n_req // 8),
+                  "useful_tokens": useful},
+        "block": block,
+        "pool_blocks": pool,
+        "contiguous": cont,
+        "paged": pg,
+        "greedy_identical": out_c == out_p,
+        "slot_capacity_ratio": round(
+            pg["peak_concurrent_slots"]
+            / max(cont["peak_concurrent_slots"], 1), 2),
+        "note": "equal cache bytes (+1 scratch block); paged stores "
+                "the shared prefix once and reserves actual spans",
+    }
 
 
 def _serve_phase_ab(params, cfg, dt_, reduced):

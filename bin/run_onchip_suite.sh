@@ -134,8 +134,12 @@ HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 #     prefill scan-vs-flash at P=128) — all in one invocation
 #     (BENCH_SERVE.json fast_path_ab / prefill_heavy / phase_ab; this
 #     on-chip run is the A/B of record — the CPU harness emulates the
-#     kernels in interpret mode).  Runs after decode so the scan
-#     compile is already in the shared compilation cache.
+#     kernels in interpret mode), PLUS the paged-vs-contiguous KV A/B
+#     of record (paged_ab: prefix-heavy trace at equal cache bytes —
+#     block-table pool + prefix sharing vs slot rows; on chip the
+#     block-table decode kernel runs native and HETU_KV_BLOCK=auto
+#     selects paged).  Runs after decode so the scan compile is
+#     already in the shared compilation cache.
 HETU_BENCH_SERVE=1 run serve 3600 python bench.py
 
 # 5. long-context tile tuning: A/B a couple of block shapes at 32k

@@ -198,6 +198,21 @@ _reg("HETU_SERVE_FAST", "str", "auto",
 _reg("HETU_SERVE_LOG", "path", None,
      "JSONL sink for serving engine events (same record shape as "
      "HETU_FAILURE_LOG).", "serving")
+_reg("HETU_KV_BLOCK", "str", "auto",
+     "Paged KV cache: an integer enables the block-table paged "
+     "allocator at that block size (tokens per block), 0 pins the "
+     "slot-contiguous layout, auto = paged with block 16 on TPU, "
+     "contiguous elsewhere.", "serving")
+_reg("HETU_KV_PREFIX_SHARE", "bool", True,
+     "Paged KV: refcounted copy-on-write sharing of common prompt "
+     "prefixes — N requests with the same system prompt store its KV "
+     "blocks once (registered prefixes are LRU-evicted under pool "
+     "pressure).", "serving")
+_reg("HETU_KV_CHUNK", "int", 0,
+     "Paged KV chunked prefill: prompts fill their blocks in chunks of "
+     "this many tokens interleaved with decode waves, so a long prompt "
+     "does not stall running generations (0 = whole prompt in one "
+     "pass).", "serving")
 
 # --------------------------------------------------------------------- #
 # graph/ops knobs

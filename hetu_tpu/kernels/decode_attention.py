@@ -149,6 +149,90 @@ def paged_decode_attention(q, k, v, lengths, *, block_k=128,
     return out[:, 0]
 
 
+def _block_decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, scale, bk, n_kv):
+    """Block-table twin of ``_decode_kernel``: same online-softmax body
+    (the extra scalar-prefetch ref is the block table, consumed only by
+    the index maps — kv positions are still ``j * bk + iota`` because
+    table entry j holds the sequence's j-th block)."""
+    del bt_ref
+    _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, scale=scale, bk=bk, n_kv=n_kv)
+
+
+def paged_block_decode_attention(q, pool_k, pool_v, lengths,
+                                 block_tables, *, interpret=None):
+    """One decode position per slot over a BLOCK-TABLE paged KV pool.
+
+    q: [B, H, Dh]; pool_k, pool_v: [N_blocks, bs, H, Dh] — the SHARED
+    block pool (one layer's ``cache_k[i]``), where a sequence's KV
+    lives in the pool blocks its table names; block_tables: [B, T]
+    int32 — entry (b, j) is the pool block holding slot b's positions
+    [j*bs, (j+1)*bs); lengths: [B] int32 filled counts (0 = inert slot,
+    returns zeros).  Dead table entries may hold any valid pool index
+    (the engine points them at scratch block 0).
+
+    Grid (slots, table entries), both scalar-prefetched: the kv index
+    map reads ``block_tables[b, j]`` so each slot DMAs exactly its own
+    ``ceil(lengths[b]/bs)`` live blocks from the pool — entries past
+    the filled length revisit the last live block (repeated index =
+    DMA skipped) and their compute is skipped with ``@pl.when``.
+    Shared prefix blocks are fetched per-slot but STORED once in HBM,
+    which is the capacity win this kernel exists for.  f32
+    online-softmax over bf16 pools, matching ``paged_decode_attention``.
+    """
+    B, H, Dh = q.shape
+    bs = pool_k.shape[1]
+    T = block_tables.shape[1]
+    scale = Dh ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+
+    def kv_idx(b, j, lens_ref, bt_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bs
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, T),
+        in_specs=[
+            pl.BlockSpec((1, 1, H, Dh),
+                         lambda b, j, lens, bt: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H, Dh),
+                               lambda b, j, lens, bt: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, _LANES), jnp.float32),
+            pltpu.VMEM((H, _LANES), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_block_decode_kernel, scale=scale, bk=bs,
+                          n_kv=T),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, Dh), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
+      q[:, None], pool_k, pool_v)
+    return out[:, 0]
+
+
+def paged_block_decode_reference(q, pool_k, pool_v, lengths,
+                                 block_tables):
+    """Gather-then-mask oracle for the block-table kernel: materialize
+    each slot's logical [T*bs] KV from the pool and run the contiguous
+    masked reference over it."""
+    B = q.shape[0]
+    bs = pool_k.shape[1]
+    T = block_tables.shape[1]
+    k = pool_k[block_tables].reshape(B, T * bs, *pool_k.shape[2:])
+    v = pool_v[block_tables].reshape(B, T * bs, *pool_v.shape[2:])
+    return masked_decode_reference(q, k, v, lengths)
+
+
 def masked_decode_reference(q, k, v, lengths):
     """Exact masked-``S_max`` oracle (f32) for the parity suite: the
     same arithmetic ``_decode_step``'s einsum path runs, minus the

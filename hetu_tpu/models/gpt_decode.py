@@ -92,7 +92,7 @@ def _gelu_tanh(x):
 
 
 def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
-                 attn="masked"):
+                 attn="masked", block_tables=None, live_mask=None):
     """One incremental position: token [B] int32 at position ``pos``.
     Returns (logits [B, V], new cache_k, new cache_v).
 
@@ -103,20 +103,49 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
     slot and mask attention per slot.
 
     ``attn`` (static) picks the attention implementation: "masked"
-    streams the full padded S_max and masks (the reference), "ragged"
-    runs the paged decode kernel — each slot fetches only
-    ceil(filled/block_k) KV blocks (kernels/decode_attention.py)."""
+    streams and masks (the reference), "ragged" runs the paged Pallas
+    decode kernel so each slot fetches only its live KV blocks
+    (kernels/decode_attention.py).
+
+    ``block_tables`` (traced [B, T] int32, serving only) switches the
+    CACHE LAYOUT to block-table paged: ``cache_k``/``cache_v`` are the
+    shared ``[L, N_blocks, bs, H, Dh]`` pool, this position's k/v
+    scatters into block ``block_tables[b, pos[b]//bs]`` at offset
+    ``pos[b] % bs``, and attention reads each slot's blocks through its
+    table ("masked" gathers + masks, "ragged" is the block-table
+    kernel).  ``live_mask`` ([B] bool) redirects inert slots' ride-along
+    writes to scratch block 0 and zeroes their attention span — a slot
+    mid-chunked-prefill must not have its freshly written prompt KV
+    clobbered by the frozen-position write the contiguous layout could
+    shrug off.  Offline ``generate_fast`` and the serving engine share
+    this one core; the layout is a parameter, not a fork."""
     name, L, H, Dh, S_max = cfg_tuple
     B = token.shape[0]
     hdim = H * Dh
     per_slot = jnp.ndim(pos) > 0
+    paged = block_tables is not None
     h = params[f"{name}_wte_table"][token] + params[f"{name}_wpe"][pos]
 
-    if attn == "ragged":
-        from ..kernels.decode_attention import paged_decode_attention
+    if attn == "ragged" or paged:
+        from ..kernels.decode_attention import (
+            paged_block_decode_attention, paged_decode_attention,
+        )
         lens = ((pos + 1).astype(jnp.int32) if per_slot
                 else jnp.full((B,), pos + 1, jnp.int32))
-    if per_slot:
+    if paged:
+        bs_blk = cache_k.shape[2]
+        T = block_tables.shape[1]
+        bidx = jnp.arange(B)
+        wblk = block_tables[bidx, pos // bs_blk]
+        woff = pos % bs_blk
+        if live_mask is not None:
+            lens = jnp.where(live_mask, lens, 0)
+            wblk = jnp.where(live_mask, wblk, 0)
+        # masked gather path: a fully-dead slot still needs one live
+        # score to keep its (discarded) softmax row finite
+        live = (jnp.arange(T * bs_blk)[None, None, :]
+                < jnp.maximum(lens, 1)[:, None, None])
+    elif per_slot:
         live = jnp.arange(S_max)[None, None, :] <= pos[:, None, None]
         bidx = jnp.arange(B)
     else:
@@ -131,7 +160,10 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
         k = k.reshape(B, H, Dh)
         v = v.reshape(B, H, Dh)
         # write this position's k/v into the cache
-        if per_slot:
+        if paged:
+            cache_k = cache_k.at[i, wblk, woff].set(k)
+            cache_v = cache_v.at[i, wblk, woff].set(v)
+        elif per_slot:
             cache_k = cache_k.at[i, bidx, pos].set(k)
             cache_v = cache_v.at[i, bidx, pos].set(v)
         else:
@@ -139,9 +171,19 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
                 cache_k, k[None, :, None], (i, 0, pos, 0, 0))
             cache_v = jax.lax.dynamic_update_slice(
                 cache_v, v[None, :, None], (i, 0, pos, 0, 0))
-        ks = cache_k[i]                                    # [B,S,H,Dh]
+        ks = cache_k[i]                    # [B,S,H,Dh] | [N,bs,H,Dh]
         vs = cache_v[i]
-        if attn == "ragged":
+        if paged and attn == "ragged":
+            o = paged_block_decode_attention(
+                q, ks, vs, lens, block_tables).reshape(B, hdim)
+        elif paged:
+            kg = ks[block_tables].reshape(B, T * bs_blk, H, Dh)
+            vg = vs[block_tables].reshape(B, T * bs_blk, H, Dh)
+            s = jnp.einsum("bhd,bshd->bhs", q, kg) * (Dh ** -0.5)
+            s = jnp.where(live, s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhs,bshd->bhd", p, vg).reshape(B, hdim)
+        elif attn == "ragged":
             o = paged_decode_attention(q, ks, vs, lens).reshape(B, hdim)
         else:
             s = jnp.einsum("bhd,bshd->bhs", q, ks) * (Dh ** -0.5)
@@ -464,6 +506,114 @@ def _serve_decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
     return sampled, cache_k, cache_v, new_keys
 
 
+def _serve_decode_paged(params, cfg_tuple, cache_k, cache_v, tables,
+                        pos, live, token, temperature, top_k, rng_keys,
+                        attn="masked"):
+    """``_serve_decode_step`` over the block-table paged pool: same
+    fused step, but the cache pair is the shared block pool, ``tables``
+    [B, T] routes each slot's reads/writes, and ``live`` [B] bool marks
+    the slots actually decoding this wave (admitted, prompt fully
+    prefilled) — inert slots ride along with their writes pointed at
+    scratch block 0 and their sampled token discarded by the host."""
+    logits, cache_k, cache_v = _decode_step(
+        params, cfg_tuple, cache_k, cache_v, pos, token, attn=attn,
+        block_tables=tables, live_mask=live)
+    splits = jax.vmap(jax.random.split)(rng_keys)          # [B,2,2]
+    new_keys, subs = splits[:, 0], splits[:, 1]
+    sampled = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
+    return sampled, cache_k, cache_v, new_keys
+
+
+def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
+                         tokens, pos_off, n_tok, temperature, top_k,
+                         rng_key, wblk, woff):
+    """One CHUNK of a prompt into one slot's blocks: forward ``tokens``
+    [C_b] (positions ``pos_off .. pos_off+n_tok-1``; the rest pad)
+    attending to the slot's already-written context (gathered from the
+    pool through ``table_row`` [T]) plus the chunk's own causal prefix,
+    then scatter the chunk's K/V into blocks ``wblk``/``woff`` [C_b]
+    (pad positions target scratch block 0).  This is both the chunked-
+    prefill engine (long prompts fill block by block between decode
+    waves) and the prefix-share tail pass (a prompt whose first
+    ``pos_off`` positions came from shared blocks forwards only the
+    remainder).  Returns (first_token, cache_k, cache_v, new_rng_key) —
+    the sample is meaningful only on the final chunk, and the HOST
+    applies new_rng_key only then, so the request's rng stream is
+    split exactly once, same as the unchunked paths."""
+    name, L, H, Dh, S_max = cfg_tuple
+    C_b = tokens.shape[0]
+    T = table_row.shape[0]
+    bs_blk = cache_k.shape[2]
+    hdim = H * Dh
+    wpe = params[f"{name}_wpe"]
+    posns = pos_off + jnp.arange(C_b)
+    h = params[f"{name}_wte_table"][tokens] \
+        + wpe[jnp.clip(posns, 0, wpe.shape[0] - 1)]        # [C_b, hd]
+    # context positions valid strictly below pos_off; chunk causal mask
+    ctx_live = (jnp.arange(T * bs_blk)[None, :] < pos_off)
+    ii = jnp.arange(C_b)
+    self_live = (ii[None, :] <= ii[:, None]) & (ii[None, :] < n_tok)
+    scale = Dh ** -0.5
+    for i in range(L):
+        us = f"{name}_h{i}"
+        x = _ln(h, params[f"{us}_ln1_scale"], params[f"{us}_ln1_bias"])
+        q = (x @ params[f"{us}_attn_q_weight"]
+             + params[f"{us}_attn_q_bias"]).reshape(C_b, H, Dh)
+        k = (x @ params[f"{us}_attn_k_weight"]
+             + params[f"{us}_attn_k_bias"]).reshape(C_b, H, Dh)
+        v = (x @ params[f"{us}_attn_v_weight"]
+             + params[f"{us}_attn_v_bias"]).reshape(C_b, H, Dh)
+        kc = cache_k[i][table_row].reshape(T * bs_blk, H, Dh)
+        vc = cache_v[i][table_row].reshape(T * bs_blk, H, Dh)
+        s1 = jnp.einsum("chd,shd->chs", q, kc) * scale
+        s1 = jnp.where(ctx_live[:, None, :], s1, NEG_INF)
+        s2 = jnp.einsum("chd,jhd->chj", q, k) * scale
+        s2 = jnp.where(self_live[:, None, :], s2, NEG_INF)
+        s = jnp.concatenate([s1, s2], axis=-1)
+        p = jax.nn.softmax(s, axis=-1)
+        o = (jnp.einsum("chs,shd->chd", p[..., :T * bs_blk], vc)
+             + jnp.einsum("chj,jhd->chd", p[..., T * bs_blk:], v))
+        o = o.reshape(C_b, hdim) @ params[f"{us}_attn_proj_weight"] \
+            + params[f"{us}_attn_proj_bias"]
+        h = h + o
+        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
+                       + params[f"{us}_ffn_wi_bias"])
+        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
+        h = h + f
+        cdtype = cache_k.dtype
+        cache_k = cache_k.at[i, wblk, woff].set(k.astype(cdtype))
+        cache_v = cache_v.at[i, wblk, woff].set(v.astype(cdtype))
+    hf = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
+    last = hf[jnp.maximum(n_tok - 1, 0)]
+    logits = (last @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
+        + params.get(f"{name}_head_bias", 0.0)
+    rng_key, sub = jax.random.split(rng_key)
+    first = _sample_slot(logits, temperature, top_k, sub)
+    return first, cache_k, cache_v, rng_key
+
+
+def _serve_prefill_batch_paged(params, cfg_tuple, cache_k, cache_v,
+                               prompts, prompt_lens, temperature, top_k,
+                               rng_keys, wblk, woff):
+    """Flash prefill of an admission group scattered into BLOCKS: the
+    same one-dispatch ``_prefill_forward`` as the contiguous fast path,
+    but every (request, position)'s K/V lands in the pool block the
+    host-built ``wblk``/``woff`` [N, P_b] maps name (pad positions and
+    replicated pad rows target scratch block 0 / duplicate identical
+    writes — order-safe).  Returns (first_tokens [N], cache_k, cache_v,
+    new_rng_keys)."""
+    logits, ks, vs = _prefill_forward(params, cfg_tuple, prompts,
+                                      prompt_lens)
+    cdtype = cache_k.dtype
+    cache_k = cache_k.at[:, wblk, woff].set(ks.astype(cdtype))
+    cache_v = cache_v.at[:, wblk, woff].set(vs.astype(cdtype))
+    splits = jax.vmap(jax.random.split)(rng_keys)          # [N,2,2]
+    new_keys, subs = splits[:, 0], splits[:, 1]
+    first = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
+    return first, cache_k, cache_v, new_keys
+
+
 @functools.lru_cache(maxsize=None)
 def serve_prefill_fn(donate=True):
     """Jitted ``_serve_prefill``; ``donate=True`` donates the cache pair
@@ -496,6 +646,40 @@ def serve_decode_fn(donate=True, attn="masked"):
         kw["donate_argnums"] = (2, 3)
     fn = jax.jit(_serve_decode_step, **kw)
     return functools.partial(fn, attn=attn)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_decode_paged_fn(donate=True, attn="masked"):
+    """Jitted ``_serve_decode_paged`` — the block-table fused step (see
+    ``serve_prefill_fn`` for the donation rationale; donating the POOL
+    pair matters even more here, since it is the engine's entire KV
+    memory)."""
+    kw = {"static_argnames": ("cfg_tuple", "attn")}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    fn = jax.jit(_serve_decode_paged, **kw)
+    return functools.partial(fn, attn=attn)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_prefill_chunk_fn(donate=True):
+    """Jitted ``_serve_prefill_chunk``; compiles per (chunk bucket,
+    table width) — the engine pads chunks to one fixed pow2 bucket, so
+    the ladder stays bounded."""
+    kw = {"static_argnames": ("cfg_tuple",)}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    return jax.jit(_serve_prefill_chunk, **kw)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_prefill_batch_paged_fn(donate=True):
+    """Jitted ``_serve_prefill_batch_paged`` — the paged engine's
+    batched-admission flash dispatch."""
+    kw = {"static_argnames": ("cfg_tuple",)}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    return jax.jit(_serve_prefill_batch_paged, **kw)
 
 
 def _infer_name(params, name=None):

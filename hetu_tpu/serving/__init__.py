@@ -13,7 +13,10 @@ compiled arithmetic — with the offline path.
                   per-step admit -> prefill -> fused-decode -> retire loop
     kv_manager.py KVCacheManager: free-slot allocation + per-slot filled
                   lengths over one preallocated [L, B_slots, S_max, H, Dh]
-                  cache pair, pow2-bucketed shapes
+                  cache pair, pow2-bucketed shapes; PagedKVManager: the
+                  block-table paged pool (free-list block allocator,
+                  refcounted copy-on-write prefix sharing, chunked
+                  prefill support) — paged=/$HETU_KV_BLOCK selects it
     request.py    Request / Result dataclasses
     metrics.py    ServingMetrics: TTFT, tok/s, occupancy; JSONL events
                   (per-step prefill_ms/decode_ms attribution)
@@ -35,11 +38,14 @@ Quickstart (greedy results are token-identical to ``generate_fast``):
 """
 
 from .request import Request, Result
-from .kv_manager import KVCacheManager, round_up_pow2
+from .kv_manager import (
+    KVCacheManager, PagedKVManager, resolve_kv_block, round_up_pow2,
+)
 from .metrics import ServingMetrics
 from .engine import ServingEngine, QueueFull
 
 __all__ = [
     "ServingEngine", "QueueFull", "Request", "Result",
-    "KVCacheManager", "ServingMetrics", "round_up_pow2",
+    "KVCacheManager", "PagedKVManager", "ServingMetrics",
+    "resolve_kv_block", "round_up_pow2",
 ]

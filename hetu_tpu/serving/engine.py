@@ -30,12 +30,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import telemetry
+from .. import envvars, telemetry
 from ..models.gpt_decode import (
     _infer_name, _prep_param, _pow2, _resolve_fast, serve_decode_fn,
-    serve_prefill_batch_fn, serve_prefill_fn,
+    serve_decode_paged_fn, serve_prefill_batch_fn,
+    serve_prefill_batch_paged_fn, serve_prefill_chunk_fn,
+    serve_prefill_fn,
 )
-from .kv_manager import KVCacheManager
+from .kv_manager import KVCacheManager, PagedKVManager, resolve_kv_block
 from .metrics import ServingMetrics
 from .request import Request, Result
 
@@ -74,7 +76,8 @@ class ServingEngine:
 
     def __init__(self, params, config, *, slots=8, queue_limit=64,
                  max_seq_len=None, name=None, dtype=None, log_path=None,
-                 donate=True, fast_path=None):
+                 donate=True, fast_path=None, paged=None, kv_block=None,
+                 pool_blocks=None, prefix_share=None, prefill_chunk=None):
         c = config
         self._name = _infer_name(params, name)
         dt_ = dtype or jnp.float32
@@ -87,22 +90,44 @@ class ServingEngine:
         validate_serving(self.params, c, self._name)
         Dh = c.hidden_size // c.num_attention_heads
         want = int(max_seq_len or c.max_position_embeddings)
-        self.kv = KVCacheManager(
-            layers=c.num_hidden_layers, heads=c.num_attention_heads,
-            head_dim=Dh, slots=slots, max_seq_len=want,
-            pos_cap=c.max_position_embeddings,
-            dtype=self.params[f"{self._name}_wte_table"].dtype)
+        cdtype = self.params[f"{self._name}_wte_table"].dtype
+        block = resolve_kv_block(paged, kv_block)
+        self.paged = block > 0
+        self.fast_path = _resolve_fast(fast_path)
+        if self.paged:
+            self.kv = PagedKVManager(
+                layers=c.num_hidden_layers, heads=c.num_attention_heads,
+                head_dim=Dh, slots=slots, max_seq_len=want,
+                pos_cap=c.max_position_embeddings, dtype=cdtype,
+                block=block, pool_blocks=pool_blocks,
+                prefix_share=prefix_share)
+            chunk = (prefill_chunk if prefill_chunk is not None
+                     else envvars.get_int("HETU_KV_CHUNK"))
+            self.chunk = max(int(chunk or 0), 0)
+            self._prefill = None
+            self._prefill_chunk = serve_prefill_chunk_fn(donate)
+            self._prefill_batch = (serve_prefill_batch_paged_fn(donate)
+                                   if self.fast_path else None)
+            self._decode = serve_decode_paged_fn(
+                donate, "ragged" if self.fast_path else "masked")
+        else:
+            self.kv = KVCacheManager(
+                layers=c.num_hidden_layers, heads=c.num_attention_heads,
+                head_dim=Dh, slots=slots, max_seq_len=want,
+                pos_cap=c.max_position_embeddings, dtype=cdtype)
+            self.chunk = 0
+            self._prefill = serve_prefill_fn(donate)
+            self._prefill_batch = (serve_prefill_batch_fn(donate)
+                                   if self.fast_path else None)
+            self._decode = serve_decode_fn(
+                donate, "ragged" if self.fast_path else "masked")
         self.cfg_tuple = (self._name, c.num_hidden_layers,
                           c.num_attention_heads, Dh, self.kv.s_max)
-        self.fast_path = _resolve_fast(fast_path)
-        self._prefill = serve_prefill_fn(donate)
-        self._prefill_batch = (serve_prefill_batch_fn(donate)
-                               if self.fast_path else None)
-        self._decode = serve_decode_fn(
-            donate, "ragged" if self.fast_path else "masked")
         self.prefill_dispatches = 0   # jitted prefill calls (the
         # batched-admission win: a burst of k same-bucket arrivals on
         # the fast path costs ONE dispatch, not k)
+        self.prefill_chunks = 0       # chunked-prefill dispatches (paged)
+        self.peak_live = 0            # max concurrent admitted slots
         self.queue_limit = int(queue_limit)
         self._queue = collections.deque()
         self.metrics = ServingMetrics(log_path)
@@ -114,6 +139,8 @@ class ServingEngine:
         self._keys = np.zeros((B, 2), np.uint32)
         self._reqs = [None] * B
         self._gen = [None] * B               # generated ids per slot
+        self._prefill_off = np.zeros(B, np.int32)  # paged: next prompt
+        self._prompt_arr = [None] * B              # position to prefill
         self.steps = 0
 
     # ------------------------------------------------------------- #
@@ -128,6 +155,11 @@ class ServingEngine:
             raise ValueError(
                 f"prompt + max_new_tokens = {total} exceeds the "
                 f"engine's S_max {self.kv.s_max}")
+        if self.paged and \
+                self.kv.blocks_needed(total) > self.kv.capacity_blocks:
+            raise ValueError(
+                f"request needs {self.kv.blocks_needed(total)} KV "
+                f"blocks; the pool holds {self.kv.capacity_blocks}")
         if len(self._queue) >= self.queue_limit:
             self.metrics.record_reject(req.request_id, len(self._queue))
             raise QueueFull(
@@ -155,6 +187,8 @@ class ServingEngine:
         group per jitted dispatch (fast path — the masked reference
         keeps its per-request scan); a request that finishes AT prefill
         frees its slot for the next wave of the same step."""
+        if self.paged:
+            return self._step_paged()
         done = []
         prefill_s = 0.0
         while True:
@@ -200,6 +234,7 @@ class ServingEngine:
                         done.append(r)   # frees the slot: next wave
         # ---- one fused decode step over all live slots ---- #
         live = self.kv.live()
+        self.peak_live = max(self.peak_live, len(live))
         if live:
             t0 = time.perf_counter()
             sampled, ck, cv, keys = self._decode(
@@ -287,6 +322,277 @@ class ServingEngine:
         self.prefill_dispatches += 1
         first = np.asarray(first)
         new_keys = np.array(new_keys, np.uint32)
+        return ([int(first[i]) for i in range(n)],
+                [new_keys[i] for i in range(n)])
+
+    # ------------------------------------------------------------- #
+    # paged scheduler
+    # ------------------------------------------------------------- #
+
+    def _step_paged(self):
+        """One paged scheduler iteration: admit into block tables,
+        advance every mid-prefill slot by one chunk (long prompts fill
+        their blocks INTERLEAVED with decode waves instead of stalling
+        them), then one fused block-table decode step over the slots
+        whose prompts are fully written.  A request finishing at
+        prefill frees capacity for another admission wave within the
+        same step."""
+        done = []
+        prefill_s = 0.0
+        while True:
+            self._admit_paged()
+            fin, dt = self._prefill_wave_paged()
+            prefill_s += dt
+            done.extend(fin)
+            if not fin:
+                break   # nothing retired at prefill -> no freed
+                # capacity -> no further admissions this step: decode
+        # a request deferred for a prefix that REGISTERED this step can
+        # claim its (shared) blocks now and prefill next step
+        self._admit_paged()
+        # ---- fused decode over fully-prefilled slots; mid-prefill
+        # slots ride along pointed at the scratch block ---- #
+        live = self.kv.live()
+        decoding = [s for s in live if self._gen[s] is not None]
+        self.peak_live = max(self.peak_live, len(live))
+        if decoding:
+            B = self.kv.n_slots
+            mask = np.zeros(B, bool)
+            mask[decoding] = True
+            t0 = time.perf_counter()
+            sampled, ck, cv, keys = self._decode(
+                self.params, self.cfg_tuple,
+                self.kv.cache_k, self.kv.cache_v,
+                self.kv.tables.copy(), self._pos, mask, self._tok,
+                self._temp, self._topk, self._keys)
+            self.kv.cache_k, self.kv.cache_v = ck, cv
+            sampled = np.asarray(sampled)
+            new_keys = np.array(keys, np.uint32)
+            # ONLY decoding slots consumed their rng stream: a slot
+            # mid-prefill splits its key exactly once, at its final
+            # prefill chunk — restore the ride-along splits
+            new_keys[~mask] = self._keys[~mask]
+            self._keys = new_keys
+            dt = time.perf_counter() - t0
+            for slot in decoding:
+                req = self._reqs[slot]
+                t = int(sampled[slot])
+                self._pos[slot] += 1
+                self._tok[slot] = t
+                self._gen[slot].append(t)
+                self.kv.advance(slot)
+                if req.stream_cb:
+                    req.stream_cb(req, t)
+                r = self._maybe_finish(slot, t)
+                if r:
+                    done.append(r)
+            self.steps += 1
+            self.metrics.record_step(
+                live=len(decoding), slots=self.kv.n_slots,
+                queue_depth=len(self._queue), dt_s=dt,
+                new_tokens=len(decoding), prefill_s=prefill_s)
+        return done
+
+    def _admit_paged(self):
+        """Claim slots + block tables for queued requests, FIFO, until
+        slots or pool blocks run short (the head request then waits —
+        backpressure, not loss).  Prefix sharing happens here: a prompt
+        starting with a registered prefix attaches those blocks
+        refcounted and only prefills the tail."""
+        admitted = []
+        with telemetry.span("serve.kv_alloc", queue=len(self._queue)):
+            while self._queue:
+                req = self._queue[0]
+                if self._defer_for_prefix(req):
+                    break
+                slot, cached = self.kv.alloc(
+                    req.request_id, req.prompt,
+                    len(req.prompt) + req.max_new_tokens)
+                if slot is None:
+                    break
+                self._queue.popleft()
+                self._reqs[slot] = req
+                self._gen[slot] = None
+                self._prompt_arr[slot] = np.asarray(req.prompt, np.int32)
+                self._prefill_off[slot] = cached
+                self._pos[slot] = 0
+                self._tok[slot] = 0
+                self._temp[slot] = req.temperature
+                self._topk[slot] = req.top_k
+                self._keys[slot] = np.asarray(
+                    jax.random.PRNGKey(req.seed), np.uint32)
+                admitted.append(slot)
+        if admitted:
+            telemetry.inc("serve.admission_waves")
+        return admitted
+
+    def _defer_for_prefix(self, req):
+        """True when ``req`` should WAIT one step rather than duplicate
+        work: its first KV block of prompt matches a prompt another slot
+        is prefilling right now, and no registered prefix covers it yet
+        — once that prefill registers, this request admits with the
+        blocks attached instead of recomputing them (this is what makes
+        a BURST of same-system-prompt requests store the prefix once)."""
+        if not self.kv.prefix_share:
+            return False
+        bs = self.kv.block
+        pr = [int(t) for t in req.prompt]
+        if len(pr) <= bs:
+            return False
+        _, cached = self.kv.match_prefix(pr)
+        if cached >= bs:
+            return False
+        head = pr[:bs]
+        for s in self.kv.live():
+            if self._gen[s] is None and self._prompt_arr[s] is not None \
+                    and len(self._prompt_arr[s]) >= bs \
+                    and [int(t) for t in self._prompt_arr[s][:bs]] == head:
+                telemetry.inc("serve.prefix_deferrals")
+                return True
+        return False
+
+    def _prefill_wave_paged(self):
+        """Advance every mid-prefill slot: fresh whole-prompt slots go
+        through the batched flash dispatch on the fast path (grouped by
+        prompt bucket, K/V scattered straight into their blocks); slots
+        with a shared-prefix tail or a chunked long prompt advance one
+        chunk through the chunk kernel.  Returns (finished Results,
+        prefill seconds)."""
+        t_all = time.perf_counter()
+        fin = []
+        pre = [s for s in self.kv.live() if self._gen[s] is None]
+        if not pre:
+            return fin, 0.0
+        flash, chunked = [], []
+        for s in pre:
+            P = len(self._prompt_arr[s])
+            whole = self.chunk == 0 or P <= self.chunk
+            if (self.fast_path and self._prefill_off[s] == 0 and whole):
+                flash.append(s)
+            else:
+                chunked.append(s)
+        groups = {}
+        for s in flash:
+            pb = self.kv.bucket_prompt(len(self._prompt_arr[s]))
+            groups.setdefault(pb, []).append(s)
+        for pb, group in sorted(groups.items()):
+            t0 = time.perf_counter()
+            firsts, keys = self._flash_group_paged(pb, group)
+            self.metrics.record_prefill(
+                len(group), pb, time.perf_counter() - t0, batched=True)
+            for s, tok0, key in zip(group, firsts, keys):
+                r = self._finish_prefill(s, tok0, key)
+                if r:
+                    fin.append(r)
+        for s in chunked:
+            out = self._chunk_advance(s)
+            if out is not None:
+                r = self._finish_prefill(s, out[0], out[1])
+                if r:
+                    fin.append(r)
+        return fin, time.perf_counter() - t_all
+
+    def _finish_prefill(self, slot, tok0, key):
+        """Prompt fully written: the slot joins the decode wave (or
+        retires right here on max_new_tokens=1/instant EOS).  Registers
+        the prompt's blocks for prefix sharing."""
+        req = self._reqs[slot]
+        now = time.perf_counter()
+        req.first_token_at = now
+        P = len(self._prompt_arr[slot])
+        self._pos[slot] = P
+        self._tok[slot] = tok0
+        self._keys[slot] = key
+        self._gen[slot] = [tok0]
+        self.kv.register_prefix(self._prompt_arr[slot], slot)
+        self.metrics.record_admit(
+            req.request_id, slot, now - req.submitted_at,
+            now - req.submitted_at)
+        if req.stream_cb:
+            req.stream_cb(req, tok0)
+        return self._maybe_finish(slot, tok0)
+
+    def _chunk_advance(self, slot):
+        """One prefill chunk for one slot; returns (first_token,
+        new_key) when this chunk completed the prompt, else None."""
+        req = self._reqs[slot]
+        prompt = self._prompt_arr[slot]
+        P = len(prompt)
+        off = int(self._prefill_off[slot])
+        if self.chunk > 0:
+            C_b = min(_pow2(self.chunk, floor=8), self.kv.s_max)
+            take = min(self.chunk, C_b, P - off)
+        else:
+            C_b = self.kv.bucket_prompt(P - off)
+            take = P - off
+        tokens = np.zeros(C_b, np.int32)
+        tokens[:take] = prompt[off:off + take]
+        bs = self.kv.block
+        wblk = np.zeros(C_b, np.int32)
+        woff = np.zeros(C_b, np.int32)
+        for j in range(take):
+            p = off + j
+            wblk[j] = self.kv.tables[slot, p // bs]
+            woff[j] = p % bs
+        t0 = time.perf_counter()
+        first, ck, cv, nk = self._prefill_chunk(
+            self.params, self.cfg_tuple,
+            self.kv.cache_k, self.kv.cache_v,
+            self.kv.tables[slot].copy(), tokens, np.int32(off),
+            np.int32(take), np.float32(req.temperature),
+            np.int32(req.top_k), self._keys[slot].copy(), wblk, woff)
+        self.kv.cache_k, self.kv.cache_v = ck, cv
+        self.prefill_dispatches += 1
+        self.prefill_chunks += 1
+        telemetry.inc("serve.prefill_chunks")
+        self.kv.advance(slot, take)
+        self._prefill_off[slot] = off + take
+        self.metrics.record_prefill(1, C_b, time.perf_counter() - t0,
+                                    batched=False)
+        if off + take >= P:
+            return int(first), np.asarray(nk, np.uint32)
+        return None
+
+    def _flash_group_paged(self, pb, group):
+        """Batched flash prefill into BLOCKS: one dispatch for the
+        whole same-bucket group, pow2-padded by replicating entry 0
+        (identical duplicate block writes — order-safe), with host-built
+        (block, offset) scatter maps routing each position's K/V into
+        its slot's table (pad tails hit scratch block 0)."""
+        n = len(group)
+        nb = min(_pow2(n), self.kv.n_slots)
+        rows = list(range(n)) + [0] * (nb - n)
+        prompts = np.zeros((nb, pb), np.int32)
+        lens = np.zeros(nb, np.int32)
+        temps = np.zeros(nb, np.float32)
+        topks = np.zeros(nb, np.int32)
+        keys = np.zeros((nb, 2), np.uint32)
+        wblk = np.zeros((nb, pb), np.int32)
+        woff = np.zeros((nb, pb), np.int32)
+        bs = self.kv.block
+        for row, i in enumerate(rows):
+            slot = group[i]
+            req = self._reqs[slot]
+            P = len(self._prompt_arr[slot])
+            prompts[row, :P] = self._prompt_arr[slot]
+            lens[row] = P
+            temps[row] = req.temperature
+            topks[row] = req.top_k
+            keys[row] = self._keys[slot]
+            for j in range(P):
+                wblk[row, j] = self.kv.tables[slot, j // bs]
+                woff[row, j] = j % bs
+        first, ck, cv, new_keys = self._prefill_batch(
+            self.params, self.cfg_tuple,
+            self.kv.cache_k, self.kv.cache_v,
+            prompts, lens, temps, topks, keys, wblk, woff)
+        self.kv.cache_k, self.kv.cache_v = ck, cv
+        self.prefill_dispatches += 1
+        first = np.asarray(first)
+        new_keys = np.array(new_keys, np.uint32)
+        for slot in group:
+            self.kv.advance(slot, len(self._prompt_arr[slot]))
+            self._prefill_off[slot] = len(self._prompt_arr[slot])
         return ([int(first[i]) for i in range(n)],
                 [new_keys[i] for i in range(n)])
 

@@ -1,12 +1,21 @@
-"""Slot-structured KV-cache management for continuous batching.
+"""KV-cache management for continuous batching: the slot-contiguous
+reference layout and the block-table paged layout.
 
-One preallocated ``[L, B_slots, S_max, H, Dh]`` cache pair (k and v)
-lives on device for the engine's lifetime; this manager owns the pair
-plus the host-side slot bookkeeping: a free list, per-slot filled
-lengths, and the owner map.  Slots are the unit of admission — a
-sequence holds one row from prefill to retirement, then the row is
-recycled (numerically safe: attention masks to each slot's own filled
-prefix, and every position is rewritten before the mask admits it).
+``KVCacheManager`` is the original design: one preallocated
+``[L, B_slots, S_max, H, Dh]`` cache pair, one contiguous row per
+admitted sequence — every sequence pays for ``S_max`` positions no
+matter how short it is, and identical system prompts are stored once
+PER SLOT.  It remains the off-TPU reference (and the layout offline
+``generate_fast`` uses).
+
+``PagedKVManager`` is the production layout: a fixed pool of
+``[L, N_blocks, block, H, Dh]`` KV blocks with a free list, a
+per-request BLOCK TABLE mapping sequence positions to pool blocks, and
+refcounted copy-on-write prefix sharing keyed by a prompt-prefix hash —
+N requests with the same system prompt reference its KV blocks once.
+Concurrent sequences per HBM byte become a function of *actual* tokens
+held (prompt + generation, shared prefixes amortized) instead of the
+worst-case ``S_max``, which is the number that caps serving occupancy.
 
 Shapes are BUCKETED to powers of two (``B_slots`` and ``S_max``
 independently) so engines configured for nearby workloads land on the
@@ -17,13 +26,51 @@ not by the number of distinct deployment configs.
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
+
+from .. import envvars, telemetry
 
 
 def round_up_pow2(n, floor=1):
     """Smallest power of two >= max(n, floor)."""
     n = max(int(n), int(floor))
     return 1 << (n - 1).bit_length()
+
+
+def _bucket_prompt(p, s_max, pos_cap):
+    """Prompt-length bucket for prefill compiles: pow2, floor 8, capped
+    at BOTH ``s_max`` and the model's position table ``pos_cap``.  The
+    pos_cap clamp is load-bearing: when ``s_max`` was capped to a
+    non-pow2 position-table size, the pow2 round-up alone could pad a
+    prompt past the positions the wpe table can index (silent clamp =
+    wrong embeddings), so the bucket must never exceed the cap."""
+    b = min(round_up_pow2(p, floor=8), int(s_max))
+    if pos_cap is not None:
+        b = min(b, int(pos_cap))
+    return b
+
+
+def resolve_kv_block(paged=None, block=None):
+    """Paged-layout selection shared by the engine and bench: returns
+    the block size in tokens (0 = slot-contiguous layout).  An explicit
+    ``block`` wins; else ``$HETU_KV_BLOCK`` ("0" pins contiguous, an
+    integer enables paging at that block size, "auto" = paged with
+    block 16 on TPU, contiguous elsewhere — mirroring the
+    ``$HETU_SERVE_FAST`` convention).  ``paged=True`` forces paging
+    (default block 16), ``paged=False`` forces contiguous."""
+    if paged is False:
+        return 0
+    if block is None:
+        raw = str(envvars.get_str("HETU_KV_BLOCK") or "auto").strip().lower()
+        if raw in ("auto", ""):
+            block = 16 if (paged or jax.default_backend() == "tpu") else 0
+        else:
+            block = int(raw)
+    block = int(block)
+    if paged and block <= 0:
+        block = 16
+    return max(block, 0)
 
 
 class KVCacheManager:
@@ -52,6 +99,7 @@ class KVCacheManager:
                 f"cap {pos_cap}")
         self.n_slots = int(slots)
         self.s_max = int(s)
+        self.pos_cap = int(pos_cap) if pos_cap is not None else self.s_max
         self.cache_k = jnp.zeros(
             (layers, self.n_slots, self.s_max, heads, head_dim), dtype)
         self.cache_v = jnp.zeros_like(self.cache_k)
@@ -74,9 +122,12 @@ class KVCacheManager:
 
     def bucket_prompt(self, p):
         """Prompt-length bucket for the prefill scan: pow2, floor 8,
-        capped at S_max — a handful of prefill compiles serves every
-        prompt length."""
-        return min(round_up_pow2(p, floor=8), self.s_max)
+        capped at S_max AND the position-table cap — a handful of
+        prefill compiles serves every prompt length, and the bucket can
+        never index past the wpe table (regression: the pow2 round-up
+        used to consult only s_max, which is safe solely because s_max
+        itself is capped — the explicit clamp pins the contract)."""
+        return _bucket_prompt(p, self.s_max, self.pos_cap)
 
     def alloc(self, owner, length):
         """Claim a free slot for ``owner`` whose prompt fills ``length``
@@ -104,3 +155,305 @@ class KVCacheManager:
         self.owner[slot] = None
         self.lengths[slot] = 0
         self._free.append(slot)
+
+
+class _PrefixEntry:
+    """One registered prompt prefix: the tokens (collision-proof key
+    verification), the pool blocks holding its KV (each refcounted on
+    behalf of the cache so they outlive the registering request), and
+    an LRU stamp for eviction under pool pressure."""
+
+    __slots__ = ("tokens", "blocks", "length", "used")
+
+    def __init__(self, tokens, blocks, length, used):
+        self.tokens = tokens
+        self.blocks = blocks
+        self.length = length
+        self.used = used
+
+
+class PagedKVManager:
+    """Block-pool allocator with per-request block tables.
+
+    The cache pair is ``[L, N_blocks, block, H, Dh]``; a request holds
+    ``ceil(tokens / block)`` blocks listed in its slot's block-table
+    row, so pool bytes bound the TOKENS held, not slots * S_max.  Block
+    id 0 is a permanent scratch block: dead table entries point at it
+    and inert slots' ride-along decode writes land in it, so nothing a
+    mask admits is ever clobbered.
+
+    Admission RESERVES the request's whole span (prompt +
+    max_new_tokens, minus shared prefix blocks) up front, so decode
+    waves never allocate and never preempt — the engine requeues an
+    admission the pool cannot hold yet (backpressure), and ``submit``
+    rejects one it can never hold.
+
+    Prefix sharing (``prefix_share``): completed prompts register their
+    blocks keyed by the prompt-token hash; a later request whose prompt
+    starts with a registered prefix attaches those blocks refcounted
+    instead of recomputing them.  A shared block whose tail the new
+    request must overwrite (the prefix ends mid-block) is COPY-ON-WRITE
+    forked at admission.  Retirement decrements refcounts and returns a
+    block to the free list only at zero; registered prefixes are
+    LRU-evicted when the pool runs short.
+    """
+
+    def __init__(self, *, layers, heads, head_dim, slots, max_seq_len,
+                 pos_cap=None, dtype=jnp.float32, bucket=True,
+                 block=16, pool_blocks=None, prefix_share=None):
+        if bucket:
+            slots = round_up_pow2(slots)
+            s = round_up_pow2(max_seq_len, floor=16)
+        else:
+            s = int(max_seq_len)
+        if pos_cap is not None:
+            s = min(s, int(pos_cap))
+        if s < max_seq_len:
+            raise ValueError(
+                f"max_seq_len={max_seq_len} exceeds the position-table "
+                f"cap {pos_cap}")
+        self.n_slots = int(slots)
+        self.s_max = int(s)
+        self.pos_cap = int(pos_cap) if pos_cap is not None else self.s_max
+        self.block = int(block)
+        if self.block < 1:
+            raise ValueError(f"block size must be >= 1, got {block}")
+        # table width: blocks needed for a brim-full sequence
+        self.table_width = -(-self.s_max // self.block)
+        if pool_blocks is None:
+            # contiguous-equivalent capacity (+1 for the scratch block)
+            pool_blocks = self.n_slots * self.table_width + 1
+        self.n_blocks = int(pool_blocks)
+        if self.n_blocks < 2:
+            raise ValueError("pool needs at least 2 blocks "
+                             "(scratch + one allocatable)")
+        if prefix_share is None:
+            prefix_share = envvars.get_bool("HETU_KV_PREFIX_SHARE")
+        self.prefix_share = bool(prefix_share)
+        self.cache_k = jnp.zeros(
+            (layers, self.n_blocks, self.block, heads, head_dim), dtype)
+        self.cache_v = jnp.zeros_like(self.cache_k)
+        self._free = list(range(1, self.n_blocks))   # 0 = scratch
+        self.ref = np.zeros(self.n_blocks, np.int32)
+        self.tables = np.zeros((self.n_slots, self.table_width), np.int32)
+        self.n_table = np.zeros(self.n_slots, np.int32)
+        self.lengths = np.zeros(self.n_slots, np.int32)
+        self.owner = [None] * self.n_slots
+        self._free_slots = list(range(self.n_slots))
+        self._prefix = {}                            # tokens -> entry
+        self._clock = 0
+        self.total_allocs = 0
+        self.cow_copies = 0
+        self.prefix_hits = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------- #
+
+    @property
+    def capacity_blocks(self):
+        """Blocks a single request could ever hold (pool minus scratch)."""
+        return self.n_blocks - 1
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def free_slots(self):
+        return len(self._free_slots)
+
+    @property
+    def blocks_shared(self):
+        """Blocks referenced by more than one holder (requests and/or
+        the prefix cache)."""
+        return int(np.sum(self.ref > 1))
+
+    @property
+    def occupancy(self):
+        return 1.0 - len(self._free_slots) / self.n_slots
+
+    def live(self):
+        return [i for i in range(self.n_slots) if self.owner[i] is not None]
+
+    def blocks_needed(self, tokens):
+        return -(-int(tokens) // self.block)
+
+    def bucket_prompt(self, p):
+        """Same contract as ``KVCacheManager.bucket_prompt`` (pos_cap
+        clamp included)."""
+        return _bucket_prompt(p, self.s_max, self.pos_cap)
+
+    def _gauges(self):
+        telemetry.set_gauge("serve.blocks_free", self.free_blocks)
+        telemetry.set_gauge("serve.blocks_shared", self.blocks_shared)
+        telemetry.set_gauge("serve.prefix_entries", len(self._prefix))
+
+    # ------------------------------------------------------------- #
+    # prefix cache
+    # ------------------------------------------------------------- #
+
+    def match_prefix(self, prompt):
+        """Longest registered prefix of ``prompt`` (token-verified, so
+        a hash collision can never attach wrong KV); returns
+        (entry, usable_len) or (None, 0).  ``usable_len`` is capped at
+        len(prompt) - 1: the LAST prompt position is always recomputed,
+        because sampling the first token needs its logits (KV alone is
+        not enough)."""
+        if not self.prefix_share:
+            return None, 0
+        p = tuple(int(t) for t in prompt)
+        best, best_len = None, 0
+        for key, e in self._prefix.items():
+            if e.length <= len(p) - 1 and e.length > best_len \
+                    and key == p[:e.length]:
+                best, best_len = e, e.length
+        if best is not None:
+            self._clock += 1
+            best.used = self._clock
+        return best, best_len
+
+    def register_prefix(self, prompt, slot):
+        """Register ``slot``'s prompt blocks for future sharing (called
+        once the prompt's KV is fully written).  An entry is keyed at
+        EVERY full-block boundary of the prompt plus its full length —
+        a later prompt sharing only the system-prompt head still finds
+        the longest common block run, and one extending this prompt
+        verbatim attaches its partial tail block too (COW-forked at
+        admission).  The cache takes its own refcount on each block so
+        the blocks survive the registering request's retirement."""
+        if not self.prefix_share:
+            return
+        p = tuple(int(t) for t in prompt)
+        cuts = {k * self.block
+                for k in range(1, len(p) // self.block + 1)}
+        cuts.add(len(p))
+        for n in sorted(cuts):
+            key = p[:n]
+            if key in self._prefix:
+                self._clock += 1
+                self._prefix[key].used = self._clock
+                continue
+            blocks = [int(b)
+                      for b in self.tables[slot, :self.blocks_needed(n)]]
+            for b in blocks:
+                self.ref[b] += 1
+            self._clock += 1
+            self._prefix[key] = _PrefixEntry(key, blocks, n, self._clock)
+        self._gauges()
+
+    def _evict_for(self, need, keep=None):
+        """LRU-drop registered prefixes until ``need`` blocks are free
+        (blocks still referenced by live requests stay allocated)."""
+        while len(self._free) < need and self._prefix:
+            candidates = [(e.used, k) for k, e in self._prefix.items()
+                          if e is not keep]
+            if not candidates:
+                break
+            _, key = min(candidates)
+            e = self._prefix.pop(key)
+            for b in e.blocks:
+                self.ref[b] -= 1
+                if self.ref[b] == 0:
+                    self._free.append(b)
+            self.evictions += 1
+            telemetry.inc("serve.prefix_evictions")
+
+    # ------------------------------------------------------------- #
+    # alloc / fork / release
+    # ------------------------------------------------------------- #
+
+    def alloc(self, owner, prompt, reserve):
+        """Claim a slot plus blocks for a request reserving ``reserve``
+        total positions (prompt + max_new_tokens).  Attaches the longest
+        registered prefix refcounted, COW-forks a mid-block prefix tail,
+        and materializes fresh blocks for the rest of the span.  Returns
+        (slot, cached_len) — cached_len prompt positions already hold
+        valid KV — or (None, 0) when slots or blocks are short (the
+        engine requeues: backpressure, not failure)."""
+        if reserve > self.s_max:
+            raise ValueError(
+                f"sequence length {reserve} exceeds S_max {self.s_max}")
+        if not self._free_slots:
+            return None, 0
+        entry, cached = self.match_prefix(prompt)
+        n_shared = cached // self.block          # full shared blocks
+        straddle = cached % self.block != 0      # mid-block tail -> COW
+        total = self.blocks_needed(reserve)
+        need = total - n_shared                  # fork counts as fresh
+        if len(self._free) < need:
+            self._evict_for(need, keep=entry)
+            # eviction may have dropped the matched entry's blocks to
+            # ref 0 only if it was not kept — `keep` pins it
+            if len(self._free) < need:
+                return None, 0
+        slot = self._free_slots.pop()
+        row = []
+        for j in range(n_shared):
+            b = entry.blocks[j]
+            self.ref[b] += 1
+            row.append(b)
+        if straddle:
+            src = entry.blocks[n_shared]
+            dst = self._free.pop()
+            self.ref[dst] = 1
+            # device-side block copy: the forked block starts as an
+            # exact copy of the shared one, then takes private writes
+            self.cache_k = self.cache_k.at[:, dst].set(self.cache_k[:, src])
+            self.cache_v = self.cache_v.at[:, dst].set(self.cache_v[:, src])
+            row.append(dst)
+            self.cow_copies += 1
+            telemetry.inc("serve.cow_copies")
+        for _ in range(total - len(row)):
+            b = self._free.pop()
+            self.ref[b] = 1
+            row.append(b)
+        self.tables[slot, :] = 0
+        self.tables[slot, :len(row)] = row
+        self.n_table[slot] = len(row)
+        self.owner[slot] = owner
+        self.lengths[slot] = cached
+        self.total_allocs += 1
+        if cached:
+            self.prefix_hits += 1
+            telemetry.inc("serve.prefix_hits")
+        self._gauges()
+        return slot, cached
+
+    def advance(self, slot, n=1):
+        """Record ``n`` more filled positions (blocks were reserved at
+        admission — nothing to allocate)."""
+        self.lengths[slot] += n
+
+    def release(self, slot):
+        """Retire a sequence: decrement each held block's refcount and
+        free it only at zero — blocks shared with other requests or the
+        prefix cache stay resident."""
+        if self.owner[slot] is None:
+            raise ValueError(f"slot {slot} is already free")
+        for j in range(int(self.n_table[slot])):
+            b = int(self.tables[slot, j])
+            self.ref[b] -= 1
+            if self.ref[b] == 0:
+                self._free.append(b)
+        self.tables[slot, :] = 0
+        self.n_table[slot] = 0
+        self.owner[slot] = None
+        self.lengths[slot] = 0
+        self._free_slots.append(slot)
+        self._gauges()
+
+    # ------------------------------------------------------------- #
+
+    def stats(self):
+        """JSON-able pool view (bench/telemetry surface)."""
+        return {
+            "block": self.block,
+            "n_blocks": self.n_blocks,
+            "blocks_free": self.free_blocks,
+            "blocks_shared": self.blocks_shared,
+            "prefix_entries": len(self._prefix),
+            "prefix_hits": self.prefix_hits,
+            "cow_copies": self.cow_copies,
+            "evictions": self.evictions,
+            "cache_bytes": int(self.cache_k.nbytes + self.cache_v.nbytes),
+        }

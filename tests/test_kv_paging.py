@@ -1,0 +1,446 @@
+"""Block-table paged KV cache: the block-pool allocator (free list,
+refcounts, copy-on-write prefix sharing, LRU eviction), the block-table
+decode kernel against the contiguous oracle, chunked prefill, and the
+paged engine's end-to-end greedy parity with the contiguous reference —
+each contract pinned separately.
+
+The load-bearing claims:
+- allocator: blocks free only at refcount zero; a shared prefix is
+  stored ONCE; a mid-block shared tail is COW-forked; exhaustion is
+  backpressure (requeue/QueueFull), never corruption;
+- kernel: ``paged_block_decode_attention`` over an arbitrarily permuted
+  block pool equals the contiguous masked oracle;
+- engine: greedy outputs are token-identical across contiguous vs paged,
+  shared vs unshared prefix, chunked vs whole prefill, fast vs masked —
+  and to offline ``generate_fast``.
+
+Everything runs on the CPU harness (kernels in interpret mode) —
+``smoke`` tier.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import hetu_tpu as ht  # noqa: F401  (platform forcing + compat shims)
+from hetu_tpu import telemetry
+from hetu_tpu.kernels.decode_attention import (
+    masked_decode_reference, paged_block_decode_attention,
+    paged_block_decode_reference,
+)
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.models.gpt_decode import generate_fast
+from hetu_tpu.serving import (
+    KVCacheManager, PagedKVManager, QueueFull, Request, ServingEngine,
+    resolve_kv_block,
+)
+
+
+def _rand_gpt(name="pg", L=2, H=2, Dh=8, V=61, S=32, seed=0):
+    """Deterministic random params in generate_fast's naming contract
+    (mirrors test_serving's helper; kept local so the files stay
+    independently runnable)."""
+    rng = np.random.RandomState(seed)
+    hd = H * Dh
+    p = {f"{name}_wte_table": rng.randn(V, hd) * 0.05,
+         f"{name}_wpe": rng.randn(S, hd) * 0.05,
+         f"{name}_ln_f_scale": np.ones(hd),
+         f"{name}_ln_f_bias": np.zeros(hd)}
+    for i in range(L):
+        us = f"{name}_h{i}"
+        for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+                       ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+                       ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+            p[f"{us}_{w}_weight"] = rng.randn(*shp) * 0.05
+            p[f"{us}_{w}_bias"] = np.zeros(shp[1])
+        for ln in ("ln1", "ln2"):
+            p[f"{us}_{ln}_scale"] = np.ones(hd)
+            p[f"{us}_{ln}_bias"] = np.zeros(hd)
+    cfg = GPTConfig(vocab_size=V, hidden_size=hd, num_hidden_layers=L,
+                    num_attention_heads=H, max_position_embeddings=S,
+                    batch_size=1, seq_len=S, dropout_rate=0.0)
+    return p, cfg
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _rand_gpt()
+
+
+def _mgr(**kw):
+    base = dict(layers=1, heads=1, head_dim=4, slots=2, max_seq_len=32,
+                block=8)
+    base.update(kw)
+    return PagedKVManager(**base)
+
+
+@pytest.mark.smoke
+class TestPagedAllocator:
+    def test_alloc_release_refcount_cycle(self):
+        m = _mgr(prefix_share=False)
+        assert m.table_width == 4 and m.n_blocks == 2 * 4 + 1
+        cap0 = m.free_blocks
+        slot, cached = m.alloc("r0", [1, 2, 3], 3 + 9)     # 2 blocks
+        assert slot is not None and cached == 0
+        assert m.free_blocks == cap0 - 2
+        assert all(m.ref[int(b)] == 1 for b in m.tables[slot, :2])
+        assert int(m.n_table[slot]) == 2
+        m.advance(slot, 3)
+        assert m.lengths[slot] == 3
+        m.release(slot)
+        assert m.free_blocks == cap0 and m.owner[slot] is None
+        with pytest.raises(ValueError):
+            m.release(slot)                                # double free
+        with pytest.raises(ValueError):
+            m.alloc("r1", [1], 99)                         # > S_max
+
+    def test_scratch_block_never_allocated(self):
+        m = _mgr(prefix_share=False)
+        seen = set()
+        while True:
+            slot, _ = m.alloc("r", [1] * 8, 32)
+            if slot is None:
+                break
+            seen.update(int(b) for b in m.tables[slot, :4])
+        assert 0 not in seen
+
+    def test_prefix_share_stores_blocks_once(self):
+        m = _mgr(prefix_share=True)
+        p16 = list(range(1, 17))                           # block-aligned
+        slot, cached = m.alloc("a", p16 + [40], 20)
+        assert cached == 0
+        m.register_prefix(p16 + [40], slot)
+        free_before = m.free_blocks
+        slot2, cached2 = m.alloc("b", p16 + [41], 20)
+        # 16 shared tokens = 2 full blocks attached, NOT recomputed:
+        # only the private remainder (1 block for tokens 17..20) is new
+        assert cached2 == 16
+        assert m.free_blocks == free_before - 1
+        assert m.prefix_hits == 1
+        # shared blocks are the same physical ids
+        assert list(m.tables[slot, :2]) == list(m.tables[slot2, :2])
+        # retiring the ORIGINAL leaves the shared blocks resident
+        m.release(slot)
+        assert all(m.ref[int(b)] > 0 for b in m.tables[slot2, :2])
+
+    def test_cow_fork_on_midblock_tail(self):
+        m = _mgr(prefix_share=True)
+        p17 = list(range(1, 18))                           # 17 = 2*8 + 1
+        slot, _ = m.alloc("a", p17, 20)
+        m.register_prefix(p17, slot)
+        slot2, cached2 = m.alloc("b", p17 + [50, 51], 24)
+        assert cached2 == 17
+        assert m.cow_copies == 1
+        # full blocks shared, the straddle block forked private
+        assert list(m.tables[slot, :2]) == list(m.tables[slot2, :2])
+        assert int(m.tables[slot, 2]) != int(m.tables[slot2, 2])
+        assert m.ref[int(m.tables[slot2, 2])] == 1
+
+    def test_exhaustion_and_lru_eviction(self):
+        m = _mgr(slots=4, pool_blocks=5, prefix_share=True)  # 4 usable
+        p8 = list(range(1, 9))
+        slot, _ = m.alloc("a", p8, 16)                     # 2 blocks
+        m.register_prefix(p8, slot)
+        m.release(slot)                   # cache still holds 1 block
+        assert m.free_blocks == 3
+        # a full-pool request forces the registered prefix out
+        slot2, _ = m.alloc("b", [9] * 8, 32)               # 4 blocks
+        assert slot2 is not None and m.evictions >= 1
+        assert not m._prefix
+        # now truly exhausted: next alloc must refuse, not corrupt
+        assert m.alloc("c", [1], 8) == (None, 0)
+        m.release(slot2)
+        assert m.alloc("c", [1], 8)[0] is not None
+
+    def test_full_prompt_reuse_recomputes_last_position(self):
+        """An identical full prompt hits the cache but keeps its final
+        position to recompute — sampling needs the logits there."""
+        m = _mgr(prefix_share=True)
+        p10 = list(range(1, 11))
+        slot, _ = m.alloc("a", p10, 16)
+        m.register_prefix(p10, slot)
+        _, cached = m.alloc("b", p10, 16)
+        assert cached < len(p10)
+
+
+@pytest.mark.smoke
+class TestBucketPromptPosCap:
+    def test_bucket_clamped_to_pos_cap(self):
+        """Regression: pow2 bucketing must never pad a prompt past the
+        position-table cap when s_max was capped to a non-pow2 size."""
+        m = KVCacheManager(layers=1, heads=1, head_dim=4, slots=2,
+                           max_seq_len=20, pos_cap=24)
+        assert m.s_max == 24                  # capped, non-pow2
+        assert m.bucket_prompt(17) <= 24      # pow2 round-up alone -> 32
+        assert m.bucket_prompt(3) == 8
+        pm = PagedKVManager(layers=1, heads=1, head_dim=4, slots=2,
+                            max_seq_len=20, pos_cap=24, block=8)
+        assert pm.bucket_prompt(17) <= 24
+        assert pm.bucket_prompt(23) <= 24
+
+    def test_resolve_kv_block(self, monkeypatch):
+        assert resolve_kv_block(False) == 0
+        assert resolve_kv_block(True) > 0
+        assert resolve_kv_block(None, 8) == 8
+        monkeypatch.setenv("HETU_KV_BLOCK", "32")
+        assert resolve_kv_block(None) == 32
+        monkeypatch.setenv("HETU_KV_BLOCK", "0")
+        assert resolve_kv_block(None) == 0
+        assert resolve_kv_block(True) == 16   # paged forced: 0 invalid
+        monkeypatch.setenv("HETU_KV_BLOCK", "auto")
+        want = 16 if jax.default_backend() == "tpu" else 0
+        assert resolve_kv_block(None) == want
+
+
+@pytest.mark.smoke
+class TestBlockTableKernel:
+    def _permuted_pool(self, B, S, H, Dh, bs, seed=0, dtype=jnp.float32):
+        """A logical [B, S] cache scattered into a permuted block pool:
+        the kernel must reassemble it through the tables."""
+        rng = np.random.RandomState(seed)
+        T = S // bs
+        N = B * T + 1
+        perm = rng.permutation(N - 1)[:B * T] + 1
+        tables = perm.reshape(B, T)
+        k_log = rng.randn(B, S, H, Dh).astype(np.float32)
+        v_log = rng.randn(B, S, H, Dh).astype(np.float32)
+        pool_k = np.zeros((N, bs, H, Dh), np.float32)
+        pool_v = np.zeros((N, bs, H, Dh), np.float32)
+        for b in range(B):
+            for j in range(T):
+                pool_k[tables[b, j]] = k_log[b, j * bs:(j + 1) * bs]
+                pool_v[tables[b, j]] = v_log[b, j * bs:(j + 1) * bs]
+        q = jnp.asarray(rng.randn(B, H, Dh), dtype)
+        return (q, jnp.asarray(pool_k, dtype), jnp.asarray(pool_v, dtype),
+                jnp.asarray(tables, jnp.int32),
+                jnp.asarray(k_log), jnp.asarray(v_log))
+
+    def test_parity_contiguous_vs_block_table(self):
+        B, S, H, Dh, bs = 4, 64, 2, 8, 16
+        q, pk, pv, tables, k_log, v_log = self._permuted_pool(
+            B, S, H, Dh, bs)
+        for lens in ([1, 17, 33, 64], [16, 16, 5, 48]):
+            lens = jnp.asarray(lens, jnp.int32)
+            got = paged_block_decode_attention(q, pk, pv, lens, tables)
+            want = masked_decode_reference(q, k_log, v_log, lens)
+            ref = paged_block_decode_reference(q, pk, pv, lens, tables)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(ref), np.asarray(want),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_zero_length_slot_returns_zeros(self):
+        B, S, H, Dh, bs = 2, 32, 2, 8, 8
+        q, pk, pv, tables, k_log, v_log = self._permuted_pool(
+            B, S, H, Dh, bs, seed=3)
+        lens = jnp.asarray([0, 9], jnp.int32)
+        got = np.asarray(paged_block_decode_attention(q, pk, pv, lens,
+                                                      tables))
+        assert np.all(got[0] == 0.0) and np.all(np.isfinite(got))
+        want = masked_decode_reference(q, k_log, v_log, lens)
+        np.testing.assert_allclose(got, np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bf16_accumulates_f32(self):
+        B, S, H, Dh, bs = 4, 64, 2, 8, 16
+        q, pk, pv, tables, k_log, v_log = self._permuted_pool(
+            B, S, H, Dh, bs, seed=5, dtype=jnp.bfloat16)
+        lens = jnp.asarray([3, 17, 40, 64], jnp.int32)
+        got = paged_block_decode_attention(q, pk, pv, lens, tables)
+        assert got.dtype == jnp.bfloat16
+        want = masked_decode_reference(
+            q.astype(jnp.float32), k_log, v_log, lens)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want), rtol=0.06, atol=0.06)
+
+    def test_under_jit(self):
+        B, S, H, Dh, bs = 2, 32, 2, 8, 8
+        q, pk, pv, tables, k_log, v_log = self._permuted_pool(
+            B, S, H, Dh, bs, seed=7)
+        lens = jnp.asarray([5, 30], jnp.int32)
+        got = jax.jit(paged_block_decode_attention)(q, pk, pv, lens,
+                                                    tables)
+        want = masked_decode_reference(q, k_log, v_log, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+TRACE = [([7, 8, 9], 6), ([3, 4], 11), ([1, 2, 3, 4, 5], 4),
+         ([11], 7), ([20, 21, 22, 23], 9), ([40], 3)]
+
+
+def _run(p, cfg, trace, **kw):
+    eng = ServingEngine(p, cfg, queue_limit=32, **kw)
+    reqs = [Request(prompt=pr, max_new_tokens=n) for pr, n in trace]
+    res = eng.run(reqs)
+    return eng, {tuple(r.prompt): res[r.request_id].tokens.tolist()
+                 for r in reqs}
+
+
+@pytest.mark.smoke
+class TestPagedEngineParity:
+    def test_greedy_identical_to_contiguous_and_offline(self, model):
+        """Acceptance: mixed-length greedy trace, paged == contiguous ==
+        offline, token for token — across block sizes, slot counts, and
+        both attention paths."""
+        p, cfg = model
+        _, ref = _run(p, cfg, TRACE, slots=4, paged=False)
+        for kw in (dict(kv_block=16), dict(kv_block=8),
+                   dict(kv_block=8, slots=2),
+                   dict(kv_block=8, fast_path=True),
+                   dict(kv_block=8, fast_path=False)):
+            eng, got = _run(p, cfg, TRACE, slots=kw.pop("slots", 4),
+                            paged=True, **kw)
+            assert eng.paged and got == ref, kw
+        for pr, n in TRACE:
+            want = generate_fast(p, cfg, [pr], num_tokens=n,
+                                 prefill="scan")[0]
+            assert ref[tuple(pr)] == want.tolist()
+
+    def test_shared_vs_unshared_prefix_identical(self, model):
+        """Prefix sharing is a MEMORY optimization: greedy outputs are
+        bit-identical with it on or off, while the shared run stores
+        the common blocks once (and COW-forks the straddle)."""
+        p, cfg = model
+        sysp = list(np.arange(1, 18) % 60)        # 17 tokens: straddle
+        trace = [(sysp + [30 + i], 6) for i in range(4)]
+        trace.append((sysp + [30, 31, 32], 5))    # extends a full prompt
+        eng_s, shared = _run(p, cfg, trace, slots=4, paged=True,
+                             kv_block=8, prefix_share=True)
+        eng_u, unshared = _run(p, cfg, trace, slots=4, paged=True,
+                               kv_block=8, prefix_share=False)
+        assert shared == unshared
+        st = eng_s.kv.stats()
+        assert st["prefix_hits"] >= 3, st
+        assert st["cow_copies"] >= 1, st
+        assert eng_u.kv.stats()["prefix_hits"] == 0
+
+    def test_chunked_vs_whole_prefill_identical(self, model):
+        p, cfg = model
+        trace = [(list(range(1, 20)), 5), ([3, 4], 6),
+                 (list(range(5, 29)), 4)]
+        _, whole = _run(p, cfg, trace, slots=4, paged=True, kv_block=8,
+                        prefill_chunk=0)
+        for chunk in (4, 8, 16):
+            eng, got = _run(p, cfg, trace, slots=4, paged=True,
+                            kv_block=8, prefill_chunk=chunk)
+            assert got == whole, chunk
+            assert eng.prefill_chunks >= sum(
+                -(-len(pr) // chunk) for pr, _ in trace) - 1
+
+    def test_chunked_prefill_interleaves_with_decode(self, model):
+        """A long prompt filling chunk by chunk must NOT stall running
+        generations: short requests keep producing tokens while the
+        straggler's prompt is still being written."""
+        p, cfg = model
+        long_prompt = list(range(1, 25))          # 24 tokens, chunk 4
+        eng = ServingEngine(p, cfg, slots=4, queue_limit=16, paged=True,
+                            kv_block=8, prefill_chunk=4,
+                            prefix_share=False)
+        short = Request(prompt=[7, 8], max_new_tokens=8)
+        eng.submit(short)
+        eng.step()                                # short is decoding
+        lng = Request(prompt=long_prompt, max_new_tokens=3)
+        eng.submit(lng)
+        eng.step()                                # one chunk + decode
+        slot = [s for s in eng.kv.live()
+                if eng._reqs[s] is lng][0]
+        assert eng._gen[slot] is None             # still prefilling...
+        assert len(eng._gen[[s for s in eng.kv.live()
+                             if eng._reqs[s] is short][0]]) >= 2
+        out = eng.run()                           # ...and both finish
+        assert len(out) == 2
+        want = generate_fast(p, cfg, [long_prompt], num_tokens=3)[0]
+        assert out[lng.request_id].tokens.tolist() == want.tolist()
+
+    def test_bf16_and_sampling_compose(self, model):
+        p, cfg = model
+        _, ref = _run(p, cfg, TRACE, slots=4, paged=False,
+                      dtype=jnp.bfloat16)
+        _, got = _run(p, cfg, TRACE, slots=4, paged=True, kv_block=8,
+                      dtype=jnp.bfloat16)
+        assert got == ref
+        # per-request rng streams survive the paged scheduler: sampled
+        # outputs identical across layouts
+        reqs = lambda: [Request(prompt=[3, 4], max_new_tokens=6,
+                                temperature=0.9, top_k=5, seed=11),
+                        Request(prompt=[7, 8, 9], max_new_tokens=5,
+                                temperature=0.7, top_k=3, seed=22)]
+        a = ServingEngine(p, cfg, slots=2, paged=False).run(reqs())
+        b = ServingEngine(p, cfg, slots=2, paged=True,
+                          kv_block=8).run(reqs())
+        assert sorted(r.tokens.tolist() for r in a.values()) == \
+            sorted(r.tokens.tolist() for r in b.values())
+
+
+@pytest.mark.smoke
+class TestPoolBackpressure:
+    def test_exhaustion_queuefull_then_drain(self, model):
+        p, cfg = model
+        eng = ServingEngine(p, cfg, slots=4, queue_limit=2, paged=True,
+                            kv_block=8, pool_blocks=4,
+                            prefix_share=False)       # 3 usable blocks
+        eng.submit(Request(prompt=list(range(1, 11)), max_new_tokens=12))
+        eng.submit(Request(prompt=[5] * 9, max_new_tokens=10))
+        with pytest.raises(QueueFull):
+            eng.submit(Request(prompt=[6] * 9, max_new_tokens=10))
+        assert eng.metrics.rejected == 1
+        # a request that can NEVER fit the pool is rejected outright
+        with pytest.raises(ValueError):
+            eng.submit(Request(prompt=[1] * 20, max_new_tokens=12))
+        out = eng.run()
+        assert len(out) == 2 and eng.metrics.finished == 2
+        assert eng.kv.free_blocks == eng.kv.capacity_blocks
+
+    def test_more_slots_than_contiguous_at_equal_bytes(self, model):
+        """The capacity claim, engine-level: at a pool sized to the
+        CONTIGUOUS layout's bytes, the paged engine holds every short
+        request concurrently while contiguous is capped at its slot
+        count."""
+        p, cfg = model
+        sysp = list(np.arange(1, 10) % 60)        # 9 shared tokens
+        trace = [(sysp + [20 + i], 4) for i in range(8)]
+        # contiguous: 2 slots x S_max=32 tokens = 64 token-slots
+        eng_c, ref = _run(p, cfg, trace, slots=2, paged=False)
+        # paged, same bytes: 64 tokens / block 8 = 8 blocks (+ scratch)
+        eng_p, got = _run(p, cfg, trace, slots=16, paged=True,
+                          kv_block=8, pool_blocks=9)
+        assert got == ref
+        assert eng_c.peak_live <= 2
+        assert eng_p.peak_live >= 2 * eng_c.peak_live
+
+
+@pytest.mark.smoke
+class TestPagedTelemetry:
+    def test_pool_metrics_and_kv_alloc_span(self, model, tmp_path,
+                                            monkeypatch):
+        import json
+        p, cfg = model
+        tlog = str(tmp_path / "telemetry.jsonl")
+        monkeypatch.setenv("HETU_TELEMETRY_LOG", tlog)
+        telemetry.get_sink()  # sink re-reads env per emit; just ensure up
+        sysp = list(np.arange(1, 18) % 60)
+        log = str(tmp_path / "serve.jsonl")
+        eng = ServingEngine(p, cfg, slots=4, queue_limit=16, paged=True,
+                            kv_block=8, prefill_chunk=4, log_path=log)
+        eng.run([Request(prompt=sysp + [30 + i], max_new_tokens=4)
+                 for i in range(3)])
+        snap = telemetry.snapshot()
+        assert snap["gauges"].get("serve.blocks_free") is not None
+        assert snap["gauges"].get("serve.blocks_shared") is not None
+        assert snap["counters"].get("serve.prefill_chunks", 0) >= 1
+        assert "span.serve.kv_alloc" in snap["histograms"]
+        # the span records land in the merged stream for --export
+        with open(tlog) as f:
+            recs = [json.loads(line) for line in f]
+        spans = [r for r in recs if r.get("event") == "span"
+                 and r.get("name") == "serve.kv_alloc"]
+        assert spans, "kv_alloc span missing from merged telemetry log"
+        # serve-stream records stay contract-conforming on the paged path
+        with open(log) as f:
+            serve = [json.loads(line) for line in f]
+        assert serve
+        for r in serve:
+            assert telemetry.validate_record(r) == [], r

@@ -347,6 +347,17 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     assert len(art["phase_ab"]["decode"]) == 2
     for row in art["phase_ab"]["decode"]:
         assert row["masked_ms"] > 0 and row["ragged_ms"] > 0
+    # paged-vs-contiguous at equal cache bytes on the prefix-heavy
+    # trace: identical greedy outputs, and the paged pool holds >= 2x
+    # the concurrent slots (the shared system prompt is stored once and
+    # requests reserve actual spans, not S_max)
+    pg = art["paged_ab"]
+    assert pg["greedy_identical"] is True
+    assert pg["slot_capacity_ratio"] >= 2.0, pg
+    assert pg["paged"]["hbm_bytes_per_slot"] * 2 <= \
+        pg["contiguous"]["hbm_bytes_per_slot"], pg
+    assert pg["paged"]["kv"]["prefix_hits"] > 0
+    assert pg["paged"]["kv"]["cow_copies"] > 0
     with open(tmp_path / "BENCH_SERVE.json") as f:
         on_disk = json.load(f)
     assert on_disk["continuous"]["tokens_per_sec"] == cont
