@@ -1133,6 +1133,18 @@ def bench_serve(platform, reduced):
     wall_c = time.perf_counter() - t0
     assert len(res) == n_req
     snap = eng.metrics.snapshot()
+    # request-lifecycle observability (ISSUE 7): the same trace-replay
+    # run now carries its tail decomposition — which component owns the
+    # p99 TTFT — plus the SLO state, into the artifact of record
+    tail = eng.metrics.explain_tail()
+    observability = {
+        "explain_tail": tail,
+        "components": snap["components"],
+        "ttft_p95_s": snap["ttft_p95_s"],
+        "tpot_p50_s": snap["tpot_p50_s"],
+        "slo": eng.slo.snapshot(),
+        "health": eng.health(),
+    }
 
     # ---- static baseline: batches in arrival order, pad-to-longest,
     # no early exit (the offline scan's whole-batch contract) ---- #
@@ -1234,6 +1246,7 @@ def bench_serve(platform, reduced):
             "note": "generate_fast, pad-to-longest, no early exit",
         },
         "speedup": round(tps_c / tps_s, 3) if tps_s else None,
+        "observability": observability,
         "fast_path_ab": ab,
         "prefill_heavy": heavy,
         "phase_ab": phase_ab,

@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Live terminal dashboard for the telemetry streams: occupancy,
+queue depth, KV pool, TTFT/TPOT percentiles, SLO health.  Logic lives
+in hetu_tpu/telemetry/top.py; see its docstring for the panels."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from hetu_tpu.telemetry.top import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

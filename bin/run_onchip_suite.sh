@@ -103,6 +103,54 @@ python bin/hetu_trace.py "$LOG/telemetry.jsonl" --check \
   exit 1
 }
 
+# 00c. request-observability gate: a tiny CPU serving trace-replay must
+#      produce a balanced request stream (every admit has its retire —
+#      hetu_trace --check's span-balance rule), per-request lifecycle
+#      spans, and an exportable trace with request tracks, BEFORE any
+#      chip-time serving stage trusts those records.
+run serve_trace 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/serve_trace.jsonl" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.serving import Request, ServingEngine
+
+rng, hd = np.random.RandomState(0), 16
+p = {"og_wte_table": rng.randn(61, hd) * 0.05,
+     "og_wpe": rng.randn(32, hd) * 0.05,
+     "og_ln_f_scale": np.ones(hd), "og_ln_f_bias": np.zeros(hd)}
+for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+               ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+               ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+    p[f"og_h0_{w}_weight"] = rng.randn(*shp) * 0.05
+    p[f"og_h0_{w}_bias"] = np.zeros(shp[1])
+for ln in ("ln1", "ln2"):
+    p[f"og_h0_{ln}_scale"] = np.ones(hd)
+    p[f"og_h0_{ln}_bias"] = np.zeros(hd)
+cfg = GPTConfig(vocab_size=61, hidden_size=hd, num_hidden_layers=1,
+                num_attention_heads=2, max_position_embeddings=32,
+                batch_size=1, seq_len=32, dropout_rate=0.0)
+eng = ServingEngine(p, cfg, slots=2, fast_path=False)
+res = eng.run([Request(prompt=[7, 8, 9], max_new_tokens=4, seed=s)
+               for s in range(3)])
+assert len(res) == 3
+assert eng.metrics.explain_tail() is not None
+print("serve trace gate OK")
+PYEOF
+if ! grep -q 'serve trace gate OK' "$LOG/serve_trace.log"; then
+  echo "serving trace gate FAILED — see $LOG/serve_trace.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/serve_trace.jsonl" --check \
+    > "$LOG/serve_trace_contract.log" || {
+  echo "serving span-balance/contract check FAILED — see" \
+       "$LOG/serve_trace_contract.log" >&2
+  exit 1
+}
+run serve_trace_export 300 python bin/hetu_trace.py \
+    "$LOG/serve_trace.jsonl" --export "$LOG/serve_trace_export.json"
+
 # 0. the rows a mid-capture wedge has previously cost us: the Aug-2
 #    recovery window measured bert_base/bert4l/gpt/resnet18 fresh, then
 #    the tunnel wedged INSIDE ctr_hybrid — so a fresh window banks the

@@ -69,8 +69,36 @@ _reg("HETU_TELEMETRY_LOG", "path", None,
      "file bin/hetu_trace.py merges, tails, and exports to a "
      "Chrome/Perfetto trace.", "telemetry")
 _reg("HETU_TELEMETRY_BUFFER", "int", 4096,
-     "In-memory event-ring capacity behind telemetry.snapshot().",
-     "telemetry")
+     "In-memory event-ring capacity behind telemetry.snapshot(); also "
+     "bounds ServingMetrics' in-memory event list when no serve log "
+     "path is configured.", "telemetry")
+_reg("HETU_FLIGHT_LOG", "path", None,
+     "JSONL sink the flight recorder dumps to on engine exception, "
+     "QueueFull storm, PS retry exhaustion, launcher terminal failure, "
+     "or a HETU_CHAOS kill (telemetry/flight.py: a flight_dump header "
+     "record + the last HETU_FLIGHT_DEPTH records leading up to the "
+     "fault).  Unset = recording still on, dumps disabled.", "telemetry")
+_reg("HETU_FLIGHT_DEPTH", "int", 512,
+     "Flight-recorder ring capacity: how many recent telemetry records "
+     "each dump carries.", "telemetry")
+
+# --------------------------------------------------------------------- #
+# serving SLOs (telemetry/slo.py)
+# --------------------------------------------------------------------- #
+_reg("HETU_SLO_TTFT_MS", "float", None,
+     "Latency-bound SLO: finished requests must reach their first "
+     "token within this many milliseconds (submit to first token, "
+     "queue wait included).  Unset = no latency SLO.", "slo")
+_reg("HETU_SLO_TPS", "float", None,
+     "Throughput-bound SLO: each finished request's per-stream decode "
+     "rate (tokens/second after the first token) must be at least "
+     "this.  Unset = no throughput SLO.", "slo")
+_reg("HETU_SLO_OBJECTIVE", "float", 0.99,
+     "Fraction of requests that must meet each SLO target (the error "
+     "budget is 1 - objective).", "slo")
+_reg("HETU_SLO_WINDOW", "int", 256,
+     "Sliding-window size (finished requests) for SLO burn-rate "
+     "tracking.", "slo")
 
 # --------------------------------------------------------------------- #
 # multi-process / TPU bring-up

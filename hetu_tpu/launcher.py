@@ -196,6 +196,13 @@ def run_cluster(config: DistConfig, command, coordinator_port=6655,
         from .telemetry import emit
         rec = emit(kind, _stream="failure", **fields)
         events.append(rec)
+        if kind in ("worker_failed", "ps_server_dead",
+                    "ps_restart_failed"):
+            # terminal supervisor outcomes (budget spent / respawn
+            # impossible): dump the flight ring so the post-mortem has
+            # the restart/backoff records that led here
+            from .telemetry.flight import RECORDER
+            RECORDER.dump("launcher_failure", trigger=kind)
         print(f"[heturun] {kind}: {fields}", flush=True)
 
     if supervise is None:

@@ -158,6 +158,9 @@ class _TCPTransport:
                     time.sleep(min(2.0, 0.2 * (attempt + 1)))
         if tel:
             telemetry.inc(f"ps.rpc.failures[{shard}]")
+        telemetry.flight.RECORDER.dump(
+            "ps_connection_error", method=method, shard=shard,
+            retries=self.retries)
         raise PSConnectionError(
             f"PS request {method!r} to {self.host}:{self.port} failed "
             f"after {self.retries} attempts (last: "
@@ -206,6 +209,8 @@ def _local_chaos_call(server, method, args, kwargs):
         return _done(result)
     if tel:
         telemetry.inc("ps.rpc.failures[local]")
+    telemetry.flight.RECORDER.dump(
+        "ps_connection_error", method=method, shard="local", retries=3)
     raise PSConnectionError(
         f"local PS call {method!r} dropped by chaos 3 times") from last
 

@@ -156,6 +156,15 @@ class FaultPlan:
             # incarnation (HETU_RESTART_COUNT > 0) must not re-fire the
             # kill, or recovery could never be observed
             self.fired["kill"] += 1
+            try:
+                # the kill's black box: dump the flight ring BEFORE the
+                # SIGKILL (the process gets no other chance) — a failed
+                # dump must never save the victim
+                from ..telemetry.flight import RECORDER
+                RECORDER.dump("chaos_kill", chaos_event=n,
+                              method=str(method))
+            except Exception:  # noqa: BLE001
+                pass
             os.kill(os.getpid(), signal.SIGKILL)
         u = _u01(self.seed, n)
         edge = 0.0

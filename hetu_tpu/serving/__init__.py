@@ -18,8 +18,19 @@ compiled arithmetic — with the offline path.
                   refcounted copy-on-write prefix sharing, chunked
                   prefill support) — paged=/$HETU_KV_BLOCK selects it
     request.py    Request / Result dataclasses
-    metrics.py    ServingMetrics: TTFT, tok/s, occupancy; JSONL events
-                  (per-step prefill_ms/decode_ms attribution)
+    metrics.py    ServingMetrics: TTFT/TPOT percentiles, tok/s,
+                  occupancy; JSONL events (per-step prefill_ms/
+                  decode_ms attribution); per-request LIFECYCLE tracing
+                  (queue/kv_alloc/prefill/decode/requeue req_span
+                  records -> per-request Perfetto tracks) with a
+                  component breakdown per retirement and
+                  explain_tail() naming what owns the p99 TTFT
+
+Observability: the engine's ``health()`` reports the SLO monitor's
+ok/degraded/breach state (telemetry/slo.py, ``HETU_SLO_*`` knobs or an
+explicit ``slo=``), ``bin/hetu_top.py`` renders the live dashboard, and
+the flight recorder (telemetry/flight.py) dumps the records leading
+into an engine exception or QueueFull storm to ``$HETU_FLIGHT_LOG``.
 
 Both phases have a ragged fast path (``fast_path=``/``$HETU_SERVE_FAST``,
 auto-on on TPU): admission prefills whole same-bucket GROUPS in one
@@ -37,15 +48,17 @@ Quickstart (greedy results are token-identical to ``generate_fast``):
     results = eng.run()           # {request_id: Result}
 """
 
+from ..telemetry.slo import SLO, SLOMonitor
 from .request import Request, Result
 from .kv_manager import (
     KVCacheManager, PagedKVManager, resolve_kv_block, round_up_pow2,
 )
-from .metrics import ServingMetrics
+from .metrics import COMPONENTS, ServingMetrics
 from .engine import ServingEngine, QueueFull
 
 __all__ = [
     "ServingEngine", "QueueFull", "Request", "Result",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",
+    "COMPONENTS", "SLO", "SLOMonitor",
     "resolve_kv_block", "round_up_pow2",
 ]

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 
 from .. import envvars, telemetry
+from ..telemetry import flight
+from ..telemetry import slo as slo_mod
 from ..models.gpt_decode import (
     _infer_name, _prep_param, _pow2, _resolve_fast, serve_decode_fn,
     serve_decode_paged_fn, serve_prefill_batch_fn,
@@ -45,6 +47,12 @@ from .request import Request, Result
 class QueueFull(RuntimeError):
     """Admission backpressure: the bounded request queue is at capacity.
     Callers shed load or retry after draining (``engine.step()``)."""
+
+
+# consecutive QueueFull rejections that count as a storm: the flight
+# recorder dumps once per storm so the black box captures the records
+# leading into sustained overload, not just the steady-state spam
+_STORM_REJECTS = 8
 
 
 class ServingEngine:
@@ -72,12 +80,22 @@ class ServingEngine:
     Composes with ``tp_shard_params``: pass the placed dict and the
     fused step runs tensor-parallel (``_prep_param`` preserves the
     NamedShardings; GSPMD propagates them through prefill and decode).
+
+    Observability: every request is lifecycle-traced (queue/kv_alloc/
+    prefill/decode/requeue component breakdown per retirement —
+    ``metrics.snapshot()["components"]`` and
+    ``metrics.explain_tail()``); ``slo=`` takes an
+    ``SLOMonitor``/list of ``SLO`` (default: the ``HETU_SLO_*``
+    env-declared monitor) and ``health()`` reports its
+    ok/degraded/breach state; exceptions escaping ``step()`` and
+    QueueFull storms dump the flight recorder to ``$HETU_FLIGHT_LOG``.
     """
 
     def __init__(self, params, config, *, slots=8, queue_limit=64,
                  max_seq_len=None, name=None, dtype=None, log_path=None,
                  donate=True, fast_path=None, paged=None, kv_block=None,
-                 pool_blocks=None, prefix_share=None, prefill_chunk=None):
+                 pool_blocks=None, prefix_share=None, prefill_chunk=None,
+                 slo=None):
         c = config
         self._name = _infer_name(params, name)
         dt_ = dtype or jnp.float32
@@ -131,6 +149,20 @@ class ServingEngine:
         self.queue_limit = int(queue_limit)
         self._queue = collections.deque()
         self.metrics = ServingMetrics(log_path)
+        # SLO monitor: explicit SLOMonitor / list of SLOs / default
+        # env-declared (HETU_SLO_*; empty = always "ok").  Violations
+        # and health transitions route through metrics.event so they
+        # land in the serve stream next to the request records.
+        if isinstance(slo, slo_mod.SLOMonitor):
+            self.slo = slo
+            self.slo.emit_fn = self.metrics.event
+        elif slo is not None:
+            self.slo = slo_mod.SLOMonitor(slo,
+                                          emit_fn=self.metrics.event)
+        else:
+            self.slo = slo_mod.SLOMonitor.from_env(
+                emit_fn=self.metrics.event)
+        self._reject_streak = 0
         B = self.kv.n_slots
         self._pos = np.zeros(B, np.int32)     # input position per slot
         self._tok = np.zeros(B, np.int32)     # next input token per slot
@@ -162,8 +194,16 @@ class ServingEngine:
                 f"blocks; the pool holds {self.kv.capacity_blocks}")
         if len(self._queue) >= self.queue_limit:
             self.metrics.record_reject(req.request_id, len(self._queue))
+            self._reject_streak += 1
+            if self._reject_streak == _STORM_REJECTS:
+                # once per storm: the streak resets on the next accept
+                flight.RECORDER.dump(
+                    "queue_storm", rejects=self._reject_streak,
+                    queue_depth=len(self._queue),
+                    queue_limit=self.queue_limit)
             raise QueueFull(
                 f"admission queue at capacity ({self.queue_limit})")
+        self._reject_streak = 0
         req.submitted_at = time.perf_counter()
         self._queue.append(req)
         self.metrics.record_submit(req.request_id, len(self._queue))
@@ -186,17 +226,38 @@ class ServingEngine:
         groups its admissions by prompt-length bucket, and prefills one
         group per jitted dispatch (fast path — the masked reference
         keeps its per-request scan); a request that finishes AT prefill
-        frees its slot for the next wave of the same step."""
-        if self.paged:
-            return self._step_paged()
+        frees its slot for the next wave of the same step.
+
+        An exception escaping the scheduler dumps the flight recorder
+        (``$HETU_FLIGHT_LOG``) before propagating — the black box holds
+        the records leading into the fault."""
+        try:
+            if self.paged:
+                return self._step_paged()
+            return self._step_contiguous()
+        except QueueFull:
+            raise
+        except Exception as e:   # noqa: BLE001 — dump-and-reraise
+            flight.RECORDER.dump(
+                "engine_exception",
+                error=f"{type(e).__name__}: {e}"[:200],
+                step=self.steps, live=len(self.kv.live()),
+                queue_depth=len(self._queue))
+            raise
+
+    def _step_contiguous(self):
         done = []
         prefill_s = 0.0
         while True:
             admits = []
             while self._queue and self.kv.free_slots:
                 req = self._queue.popleft()
-                admits.append((req, self.kv.alloc(req.request_id,
-                                                  len(req.prompt))))
+                t_a = time.perf_counter()
+                slot = self.kv.alloc(req.request_id, len(req.prompt))
+                self.metrics.lc_claimed(
+                    req.request_id,
+                    (time.perf_counter() - t_a) * 1e3)
+                admits.append((req, slot))
             if not admits:
                 break
             telemetry.inc("serve.admission_waves")
@@ -214,6 +275,8 @@ class ServingEngine:
                 prefill_s += dt
                 self.metrics.record_prefill(
                     len(group), pb, dt, batched=self.fast_path)
+                for req, _slot in group:
+                    self.metrics.lc_prefill(req.request_id, dt)
                 for (req, slot), tok0, key in zip(group, firsts, keys):
                     now = time.perf_counter()
                     req.first_token_at = now
@@ -236,6 +299,7 @@ class ServingEngine:
         live = self.kv.live()
         self.peak_live = max(self.peak_live, len(live))
         if live:
+            wave_reqs = [self._reqs[s].request_id for s in live]
             t0 = time.perf_counter()
             sampled, ck, cv, keys = self._decode(
                 self.params, self.cfg_tuple,
@@ -263,7 +327,9 @@ class ServingEngine:
             self.metrics.record_step(
                 live=len(live), slots=self.kv.n_slots,
                 queue_depth=len(self._queue), dt_s=dt,
-                new_tokens=len(live), prefill_s=prefill_s)
+                new_tokens=len(live), prefill_s=prefill_s,
+                step=self.steps, requests=wave_reqs,
+                end_perf=t0 + dt)
         return done
 
     # ------------------------------------------------------------- #
@@ -356,6 +422,7 @@ class ServingEngine:
         decoding = [s for s in live if self._gen[s] is not None]
         self.peak_live = max(self.peak_live, len(live))
         if decoding:
+            wave_reqs = [self._reqs[s].request_id for s in decoding]
             B = self.kv.n_slots
             mask = np.zeros(B, bool)
             mask[decoding] = True
@@ -390,7 +457,9 @@ class ServingEngine:
             self.metrics.record_step(
                 live=len(decoding), slots=self.kv.n_slots,
                 queue_depth=len(self._queue), dt_s=dt,
-                new_tokens=len(decoding), prefill_s=prefill_s)
+                new_tokens=len(decoding), prefill_s=prefill_s,
+                step=self.steps, requests=wave_reqs,
+                end_perf=t0 + dt)
         return done
 
     def _admit_paged(self):
@@ -404,12 +473,21 @@ class ServingEngine:
             while self._queue:
                 req = self._queue[0]
                 if self._defer_for_prefix(req):
+                    # waiting on another slot's in-flight prefill: the
+                    # requeue clock starts at the FIRST deferral
+                    self.metrics.lc_blocked(req.request_id)
                     break
+                t_a = time.perf_counter()
                 slot, cached = self.kv.alloc(
                     req.request_id, req.prompt,
                     len(req.prompt) + req.max_new_tokens)
                 if slot is None:
+                    # pool/slot exhaustion: head request waits admitted
+                    # capacity frees up (backpressure, not loss)
+                    self.metrics.lc_blocked(req.request_id)
                     break
+                self.metrics.lc_claimed(
+                    req.request_id, (time.perf_counter() - t_a) * 1e3)
                 self._queue.popleft()
                 self._reqs[slot] = req
                 self._gen[slot] = None
@@ -478,8 +556,10 @@ class ServingEngine:
         for pb, group in sorted(groups.items()):
             t0 = time.perf_counter()
             firsts, keys = self._flash_group_paged(pb, group)
-            self.metrics.record_prefill(
-                len(group), pb, time.perf_counter() - t0, batched=True)
+            dt = time.perf_counter() - t0
+            self.metrics.record_prefill(len(group), pb, dt, batched=True)
+            for s in group:
+                self.metrics.lc_prefill(self._reqs[s].request_id, dt)
             for s, tok0, key in zip(group, firsts, keys):
                 r = self._finish_prefill(s, tok0, key)
                 if r:
@@ -547,8 +627,9 @@ class ServingEngine:
         telemetry.inc("serve.prefill_chunks")
         self.kv.advance(slot, take)
         self._prefill_off[slot] = off + take
-        self.metrics.record_prefill(1, C_b, time.perf_counter() - t0,
-                                    batched=False)
+        dt = time.perf_counter() - t0
+        self.metrics.record_prefill(1, C_b, dt, batched=False)
+        self.metrics.lc_prefill(req.request_id, dt)
         if off + take >= P:
             return int(first), np.asarray(nk, np.uint32)
         return None
@@ -629,7 +710,19 @@ class ServingEngine:
             latency_s=now - req.submitted_at, slot=slot)
         self.metrics.record_finish(req.request_id, reason, n,
                                    res.latency_s)
+        decode_s = now - req.first_token_at
+        self.slo.observe(
+            request_id=req.request_id, ttft_ms=res.ttft_s * 1e3,
+            tok_s=((n - 1) / decode_s
+                   if n > 1 and decode_s > 0 else None))
         self._reqs[slot] = None
         self._gen[slot] = None
         self.kv.release(slot)
         return res
+
+    def health(self):
+        """The admission signal: the SLO monitor's worst-burn state —
+        "ok" / "degraded" / "breach" (always "ok" with no SLOs
+        declared).  A router shifts or sheds load on "breach"; see
+        telemetry/slo.py for the burn-rate semantics."""
+        return self.slo.health()

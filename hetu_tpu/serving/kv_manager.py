@@ -120,6 +120,10 @@ class KVCacheManager:
         """Slot indices currently holding a sequence (ascending)."""
         return [i for i in range(self.n_slots) if self.owner[i] is not None]
 
+    def _gauges(self):
+        telemetry.set_gauge("serve.occupancy", round(self.occupancy, 4))
+        telemetry.set_gauge("serve.slots_free", self.free_slots)
+
     def bucket_prompt(self, p):
         """Prompt-length bucket for the prefill scan: pow2, floor 8,
         capped at S_max AND the position-table cap — a handful of
@@ -141,6 +145,7 @@ class KVCacheManager:
         self.owner[slot] = owner
         self.lengths[slot] = length
         self.total_allocs += 1
+        self._gauges()
         return slot
 
     def advance(self, slot, n=1):
@@ -155,6 +160,7 @@ class KVCacheManager:
         self.owner[slot] = None
         self.lengths[slot] = 0
         self._free.append(slot)
+        self._gauges()
 
 
 class _PrefixEntry:
@@ -284,6 +290,7 @@ class PagedKVManager:
         return _bucket_prompt(p, self.s_max, self.pos_cap)
 
     def _gauges(self):
+        telemetry.set_gauge("serve.occupancy", round(self.occupancy, 4))
         telemetry.set_gauge("serve.blocks_free", self.free_blocks)
         telemetry.set_gauge("serve.blocks_shared", self.blocks_shared)
         telemetry.set_gauge("serve.prefix_entries", len(self._prefix))

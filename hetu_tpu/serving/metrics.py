@@ -1,4 +1,5 @@
-"""Serving telemetry: per-request latency aggregates + engine gauges.
+"""Serving telemetry: per-request lifecycle tracing + latency
+aggregates + engine gauges.
 
 Structured events flow through the ONE telemetry sink
 (telemetry/events.py): ``{"t": <epoch>, "event": <kind>, **fields}``
@@ -6,35 +7,88 @@ records kept in memory and appended as JSONL to the ``serve`` stream —
 ``$HETU_SERVE_LOG`` (legacy path, one tail/jq pipeline with the failure
 log) plus the merged ``$HETU_TELEMETRY_LOG``.
 
-Aggregates answer the serving questions: TTFT percentiles (queue wait
-included — measured from submit to first token), decode tokens/s, mean
-batch occupancy (how full the fused step ran), queue depth.
+Request lifecycle (ISSUE 7 tentpole): every request is tracked through
+submit -> queue -> kv_alloc -> prefill (per chunk) -> decode -> retire.
+At retirement the tracker emits one ``req_span`` record per phase
+(``t`` = the phase's START epoch, ``ms`` its length — the exact shape
+``span`` records use, so ``hetu_trace --export`` renders each request
+as its own Perfetto track) plus a ``req_retire`` record carrying the
+full component breakdown:
+
+    queue_ms        submit -> first admission attempt
+    requeue_ms      head-of-queue wait while blocked (paged pool
+                    exhaustion / prefix deferral); 0 when never blocked
+    kv_alloc_ms     slot + block-table claim
+    prefill_ms      prompt compute actually dispatched for this request
+    chunk_stall_ms  prefill-phase wall not spent computing (chunked
+                    prefill interleaving with decode waves)
+    decode_ms       first token -> retirement
+
+``snapshot()`` aggregates each component at p50/p95/p99 and
+``explain_tail()`` names the component that dominates the p99-TTFT
+tail — the "why was this request 40x the median" answer.
+
+Memory: ``events`` is the full history only when a log path is
+configured (the run is being deliberately observed and the JSONL has
+it anyway); otherwise it is a bounded ring (``HETU_TELEMETRY_BUFFER``)
+— a long-running engine no longer leaks one dict per record.
 """
 
 from __future__ import annotations
 
+import collections
 import time
 
 from .. import envvars, telemetry
+from ..telemetry.metrics import percentile
 
 import numpy as np
 
+COMPONENTS = ("queue_ms", "requeue_ms", "kv_alloc_ms", "prefill_ms",
+              "chunk_stall_ms", "decode_ms")
+
 
 def _pct(xs, q):
-    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else None
+    """Seconds-valued percentile via THE shared interpolating helper
+    (telemetry.metrics.percentile) — serving and the metrics registry
+    now agree on what a p99 is."""
+    xs = list(xs)
+    return percentile(xs, q) if xs else None
+
+
+class _Lifecycle:
+    """Perf-counter timeline of one request, engine-side."""
+
+    __slots__ = ("t_submit", "t_blocked", "t_claim", "kv_alloc_ms",
+                 "prefill_ms", "t_first", "n_prefills")
+
+    def __init__(self, t_submit):
+        self.t_submit = t_submit
+        self.t_blocked = None     # first blocked admission attempt
+        self.t_claim = None       # slot + KV claimed
+        self.kv_alloc_ms = 0.0
+        self.prefill_ms = 0.0     # dispatched prompt compute
+        self.n_prefills = 0       # dispatches (chunks) it rode in
+        self.t_first = None       # first token landed
 
 
 class ServingMetrics:
     def __init__(self, log_path=None):
         self.log_path = (log_path if log_path is not None
                          else envvars.get_path("HETU_SERVE_LOG"))
-        self.events = []
+        cap = max(1, envvars.get_int("HETU_TELEMETRY_BUFFER"))
+        # full in-memory history only when the run keeps a JSONL log
+        # (deliberate observation); ring-buffered otherwise so a
+        # long-running engine's memory stays bounded
+        self.events = ([] if self.log_path
+                       else collections.deque(maxlen=cap))
         self.submitted = 0
         self.rejected = 0
         self.finished = 0
         self.tokens_generated = 0
         self.ttfts = []            # seconds, submit -> first token
         self.latencies = []        # seconds, submit -> finish
+        self.tpots = []            # seconds/token after the first
         self.step_live = []        # live slots per fused step
         self.step_queue = []       # queue depth per fused step
         self.step_dt = []          # seconds per fused decode step
@@ -42,6 +96,11 @@ class ServingMetrics:
         self.prefill_dt = []       # seconds per prefill dispatch
         self.prefill_reqs = 0      # requests prefilled
         self.prefill_batched = 0   # batched (fast-path) dispatches
+        self.components = {c: [] for c in COMPONENTS}
+        # per-request breakdowns explain_tail() slices (ring: the tail
+        # report is about RECENT behavior, same cap as the event ring)
+        self.breakdowns = collections.deque(maxlen=cap)
+        self._lc = {}              # request_id -> _Lifecycle
         self._slots = None
         self._t0 = None
         self._t_last = None
@@ -49,8 +108,10 @@ class ServingMetrics:
     # ------------------------------------------------------------- #
 
     def event(self, kind, **fields):
+        # a "t" field overrides the record's timestamp (req_span records
+        # are START-stamped like `span` records)
         rec = telemetry.emit(kind, _stream="serve", _path=self.log_path,
-                             **fields)
+                             _t=fields.pop("t", None), **fields)
         self.events.append(rec)
         return rec
 
@@ -60,10 +121,44 @@ class ServingMetrics:
             self._t0 = now
         self._t_last = now
 
+    @staticmethod
+    def _epoch(perf_t):
+        """Map a perf_counter stamp onto the epoch clock the telemetry
+        stream uses (so req_span tracks align with span tracks)."""
+        return time.time() - (time.perf_counter() - perf_t)
+
+    # ------------------------------------------------------------- #
+    # lifecycle marks (the engine calls these at phase boundaries)
+    # ------------------------------------------------------------- #
+
+    def lc_blocked(self, request_id):
+        """The head-of-queue request could not admit this attempt
+        (pool/slot exhaustion or prefix deferral): starts its requeue
+        clock.  Idempotent — only the FIRST block mark counts."""
+        lc = self._lc.get(request_id)
+        if lc is not None and lc.t_blocked is None:
+            lc.t_blocked = time.perf_counter()
+
+    def lc_claimed(self, request_id, kv_alloc_ms):
+        """Slot + KV claimed (queue/requeue phases end here)."""
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.t_claim = time.perf_counter()
+            lc.kv_alloc_ms = float(kv_alloc_ms)
+
+    def lc_prefill(self, request_id, dt_s):
+        """Attribute one prefill dispatch's wall time to this request
+        (a chunked prompt accumulates across chunks)."""
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.prefill_ms += dt_s * 1e3
+            lc.n_prefills += 1
+
     # ------------------------------------------------------------- #
 
     def record_submit(self, request_id, queue_depth):
         self.submitted += 1
+        self._lc[request_id] = _Lifecycle(time.perf_counter())
         self.event("serve_submit", request=request_id,
                    queue_depth=queue_depth)
 
@@ -76,6 +171,9 @@ class ServingMetrics:
         self._mark()
         self.ttfts.append(ttft_s)
         self.tokens_generated += 1          # prefill emits token #1
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.t_first = time.perf_counter()
         self.event("serve_admit", request=request_id, slot=slot,
                    queue_wait_s=round(queue_wait_s, 6),
                    ttft_s=round(ttft_s, 6))
@@ -93,11 +191,18 @@ class ServingMetrics:
                    prefill_ms=round(dt_s * 1e3, 3), batched=bool(batched))
 
     def record_step(self, live, slots, queue_depth, dt_s, new_tokens,
-                    prefill_s=0.0):
+                    prefill_s=0.0, step=None, requests=None,
+                    end_perf=None):
         """One fused decode step; ``prefill_s`` is the prefill wall time
         this scheduler iteration paid before decoding, so the per-step
         JSONL event attributes the phases separately (the masked vs
-        ragged A/B reads these)."""
+        ragged A/B reads these).  ``step``/``requests`` identify the
+        wave and its participants — the trace exporter draws flow
+        arrows from each request's lifecycle track into the wave.
+        ``end_perf`` is the decode's end perf-stamp: the event's ``t``
+        then marks the true phase end (the exporter backdates the wave
+        start by ``decode_ms``) instead of the emission time, which
+        trails it by the retire loop."""
         self._mark()
         self._slots = slots
         self.step_live.append(live)
@@ -105,9 +210,16 @@ class ServingMetrics:
         self.step_dt.append(dt_s)
         self.step_prefill.append(prefill_s)
         self.tokens_generated += new_tokens
+        fields = {}
+        if step is not None:
+            fields["step"] = step
+        if requests is not None:
+            fields["requests"] = list(requests)
+        if end_perf is not None:
+            fields["t"] = self._epoch(end_perf)
         self.event("serve_step", live=live, queue_depth=queue_depth,
-                   prefill_ms=round(prefill_s * 1e3, 3),
-                   decode_ms=round(dt_s * 1e3, 3))
+                   slots=slots, prefill_ms=round(prefill_s * 1e3, 3),
+                   decode_ms=round(dt_s * 1e3, 3), **fields)
 
     def record_finish(self, request_id, reason, n_generated, latency_s):
         self._mark()
@@ -115,17 +227,81 @@ class ServingMetrics:
         self.latencies.append(latency_s)
         self.event("serve_finish", request=request_id, reason=reason,
                    n_generated=n_generated, latency_s=round(latency_s, 6))
+        return self._retire(request_id, n_generated)
+
+    # ------------------------------------------------------------- #
+    # retirement: component breakdown + per-phase req_span records
+    # ------------------------------------------------------------- #
+
+    def _retire(self, request_id, n_generated):
+        lc = self._lc.pop(request_id, None)
+        if lc is None or lc.t_claim is None or lc.t_first is None:
+            return None
+        now = time.perf_counter()
+        claim_end = lc.t_claim
+        claim_start = claim_end - lc.kv_alloc_ms / 1e3
+        queue_end = lc.t_blocked if lc.t_blocked is not None \
+            else claim_start
+        queue_ms = max(queue_end - lc.t_submit, 0.0) * 1e3
+        requeue_ms = (max(claim_start - lc.t_blocked, 0.0) * 1e3
+                      if lc.t_blocked is not None else 0.0)
+        prefill_wall_ms = max(lc.t_first - claim_end, 0.0) * 1e3
+        prefill_ms = min(lc.prefill_ms, prefill_wall_ms)
+        chunk_stall_ms = max(prefill_wall_ms - prefill_ms, 0.0)
+        decode_ms = max(now - lc.t_first, 0.0) * 1e3 \
+            if n_generated > 1 else 0.0
+        ttft_ms = max(lc.t_first - lc.t_submit, 0.0) * 1e3
+        comp = {"queue_ms": queue_ms, "requeue_ms": requeue_ms,
+                "kv_alloc_ms": lc.kv_alloc_ms, "prefill_ms": prefill_ms,
+                "chunk_stall_ms": chunk_stall_ms, "decode_ms": decode_ms}
+        for k, v in comp.items():
+            self.components[k].append(v)
+        if n_generated > 1 and decode_ms > 0:
+            self.tpots.append(decode_ms / 1e3 / (n_generated - 1))
+        breakdown = {"request": request_id, "ttft_ms": ttft_ms,
+                     **{k: round(v, 3) for k, v in comp.items()}}
+        self.breakdowns.append(breakdown)
+        # one span per phase, start-stamped like `span` records so the
+        # exporter lays the request out as its own track
+        phases = [("queue", lc.t_submit, queue_ms, {}),
+                  ("kv_alloc", claim_start, lc.kv_alloc_ms, {})]
+        if lc.t_blocked is not None:
+            phases.insert(1, ("requeue", lc.t_blocked, requeue_ms, {}))
+        phases.append(("prefill", claim_end, prefill_wall_ms,
+                       {"compute_ms": round(prefill_ms, 3),
+                        "stall_ms": round(chunk_stall_ms, 3),
+                        "dispatches": lc.n_prefills}))
+        if decode_ms > 0:
+            phases.append(("decode", lc.t_first, decode_ms,
+                           {"n_tokens": n_generated - 1}))
+        for phase, t_start, ms, extra in phases:
+            self.event("req_span", request=request_id, phase=phase,
+                       ms=round(ms, 3), t=self._epoch(t_start), **extra)
+        self.event("req_retire", request=request_id,
+                   ttft_ms=round(ttft_ms, 3),
+                   n_generated=n_generated, **breakdown_fields(comp))
+        return breakdown
 
     # ------------------------------------------------------------- #
 
     def snapshot(self):
-        """Aggregate view (JSON-able): throughput, TTFT p50/p99, mean
-        batch occupancy over fused steps, queue stats."""
+        """Aggregate view (JSON-able): throughput, TTFT/TPOT
+        percentiles, mean batch occupancy over fused steps, queue
+        stats, and the per-component tail decomposition."""
         wall = ((self._t_last - self._t0)
                 if self._t0 is not None and self._t_last > self._t0
                 else None)
         occ = ([l / self._slots for l in self.step_live]
                if self._slots else [])
+        comps = {}
+        for name, xs in self.components.items():
+            if xs:
+                comps[name] = {
+                    "p50_ms": round(_pct(xs, 50), 3),
+                    "p95_ms": round(_pct(xs, 95), 3),
+                    "p99_ms": round(_pct(xs, 99), 3),
+                    "mean_ms": round(float(np.mean(xs)), 3),
+                }
         return {
             "requests_submitted": self.submitted,
             "requests_rejected": self.rejected,
@@ -135,9 +311,12 @@ class ServingMetrics:
             "tokens_per_sec": (round(self.tokens_generated / wall, 2)
                                if wall else None),
             "ttft_p50_s": _pct(self.ttfts, 50),
+            "ttft_p95_s": _pct(self.ttfts, 95),
             "ttft_p99_s": _pct(self.ttfts, 99),
             "ttft_mean_s": (float(np.mean(self.ttfts))
                             if self.ttfts else None),
+            "tpot_p50_s": _pct(self.tpots, 50),
+            "tpot_p99_s": _pct(self.tpots, 99),
             "step_p50_s": _pct(self.step_dt, 50),
             "step_p99_s": _pct(self.step_dt, 99),
             "decode_ms_p50": (round(_pct(self.step_dt, 50) * 1e3, 3)
@@ -154,4 +333,53 @@ class ServingMetrics:
             "mean_batch_occupancy": (float(np.mean(occ)) if occ else None),
             "mean_queue_depth": (float(np.mean(self.step_queue))
                                  if self.step_queue else None),
+            "components": comps,
         }
+
+    def explain_tail(self, q=99):
+        """Name the component that dominates the TTFT tail: slice the
+        requests at or above the q-th TTFT percentile and average their
+        breakdowns.  The dominant component is the report's headline —
+        "p99 TTFT is queue-bound" is an actionable statement (admission
+        control) where "p99 TTFT is 40x p50" is not.  Returns None with
+        no finished requests."""
+        rows = [b for b in self.breakdowns if b.get("ttft_ms") is not None]
+        if not rows:
+            return None
+        ttfts = [b["ttft_ms"] for b in rows]
+        cut = _pct(ttfts, q)
+        tail = [b for b in rows if b["ttft_ms"] >= cut]
+        means = {c: float(np.mean([b[c] for b in tail]))
+                 for c in COMPONENTS}
+        # decode is not part of TTFT — the tail is decomposed over the
+        # submit->first-token phases only
+        ttft_parts = {c: v for c, v in means.items() if c != "decode_ms"}
+        dominant = max(ttft_parts, key=ttft_parts.get)
+        total = sum(ttft_parts.values()) or 1.0
+        share = ttft_parts[dominant] / total
+        report = {
+            "q": q,
+            "ttft_p_ms": round(cut, 3),
+            "ttft_p50_ms": round(_pct(ttfts, 50), 3),
+            "n_requests": len(rows),
+            "n_tail": len(tail),
+            "dominant_component": dominant,
+            "dominant_ms": round(ttft_parts[dominant], 3),
+            "dominant_share": round(share, 4),
+            "components_mean_ms": {c: round(v, 3)
+                                   for c, v in means.items()},
+            "tail_requests": [b["request"] for b in tail[:8]],
+        }
+        report["summary"] = (
+            f"p{q} TTFT {cut:.1f}ms ({len(tail)}/{len(rows)} requests): "
+            f"dominated by {dominant.replace('_ms', '')} "
+            f"({ttft_parts[dominant]:.1f}ms, {share:.0%} of the "
+            f"pre-token wall)")
+        return report
+
+
+def breakdown_fields(comp):
+    """Flatten a component dict for the req_retire record (scalar
+    fields survive the trace exporter's args filter; a nested dict
+    would be dropped)."""
+    return {k: round(v, 3) for k, v in comp.items()}

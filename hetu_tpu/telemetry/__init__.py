@@ -12,23 +12,29 @@ Every layer emits into this subsystem and every tool reads from it:
   ``snapshot()``.
 - :mod:`.health` — banking gates: sibling-consistency, physics
   ceiling, live-vs-banked provenance stamps (bench.py wires them).
+- :mod:`.slo` — declarative serving SLOs (TTFT / per-stream tok/s)
+  with sliding-window burn rates behind the engine's ``health()``.
+- :mod:`.flight` — the chaos flight recorder: an always-on bounded
+  ring of recent records dumped to ``$HETU_FLIGHT_LOG`` on faults.
 - :mod:`.trace` — merge/tail the streams, export Perfetto traces
-  (``bin/hetu_trace.py``).
+  (``bin/hetu_trace.py``); request-lifecycle tracks + counter tracks.
+- :mod:`.top` — the live terminal dashboard (``bin/hetu_top.py``).
 
 ``HETU_TELEMETRY=0`` turns spans and metric recording into no-ops.
 """
 
-from . import health, metrics, trace  # noqa: F401  (submodule surface)
+from . import flight, health, metrics, slo, top, trace  # noqa: F401
 from .events import (  # noqa: F401
     REQUIRED_FIELDS, STREAMS, TelemetrySink, counter, emit, enabled,
     gauge, get_sink, histogram, inc, make_record, observe, reset,
     set_gauge, snapshot, span, validate_record,
 )
-from .metrics import REGISTRY  # noqa: F401
+from .metrics import REGISTRY, percentile  # noqa: F401
 
 __all__ = [
     "REQUIRED_FIELDS", "STREAMS", "REGISTRY", "TelemetrySink",
-    "counter", "emit", "enabled", "gauge", "get_sink", "health",
-    "histogram", "inc", "make_record", "metrics", "observe", "reset",
-    "set_gauge", "snapshot", "span", "trace", "validate_record",
+    "counter", "emit", "enabled", "flight", "gauge", "get_sink",
+    "health", "histogram", "inc", "make_record", "metrics", "observe",
+    "percentile", "reset", "set_gauge", "slo", "snapshot", "span",
+    "top", "trace", "validate_record",
 ]

@@ -36,6 +36,7 @@ import threading
 import time
 
 from .. import envvars
+from . import flight
 from .metrics import REGISTRY
 
 # stream -> legacy per-stream JSONL env var (None = merged log only)
@@ -78,8 +79,17 @@ REQUIRED_FIELDS = {
     "graph_verified": ("subgraph", "phase"),
     "graph_verify_error": ("kind", "error"),
     "serving_verified": ("model",),
+    # request lifecycle (serve stream; ISSUE 7)
+    "req_span": ("request", "phase", "ms"),
+    "req_retire": ("request", "ttft_ms"),
+    # SLO monitor (telemetry/slo.py)
+    "slo_violation": ("slo", "value", "target"),
+    "slo_health": ("state",),
+    # flight recorder dump header (telemetry/flight.py)
+    "flight_dump": ("reason",),
     # telemetry core + bench
     "span": ("name", "ms"),
+    "gauge": ("name", "value"),
     "bench_row": ("config",),
     "bench_probe_health": ("ok",),
 }
@@ -164,6 +174,7 @@ class TelemetrySink:
         with self._lock:
             self._buffer.append(rec)
             self.emitted += 1
+        flight.RECORDER.record(rec)   # the always-on black box
         self._write([rec], self._targets(stream, path))
         return rec
 
@@ -176,6 +187,7 @@ class TelemetrySink:
         with self._lock:
             self._buffer.extend(records)
             self.emitted += len(records)
+        flight.RECORDER.extend(records)
         self._write(records, self._targets(stream, path))
         return records
 
@@ -273,6 +285,12 @@ def observe(name, v):
 def set_gauge(name, v):
     if enabled():
         REGISTRY.gauge(name).set(v)
+        # gauges are the only metric kind with a time dimension worth
+        # exporting (occupancy, queue depth, blocks_free over the run),
+        # so a configured merged log also gets a JSONL sample per set —
+        # the trace exporter renders them as Chrome "C" counter tracks
+        if envvars.is_set("HETU_TELEMETRY_LOG"):
+            _SINK.emit("gauge", stream="telemetry", name=name, value=v)
 
 
 def counter(name):
@@ -299,6 +317,8 @@ def snapshot():
 
 
 def reset():
-    """Clear metrics + the event ring (test isolation)."""
+    """Clear metrics + the event ring + the flight ring (test
+    isolation)."""
     REGISTRY.reset()
     _SINK.reset()
+    flight.RECORDER.reset()

@@ -28,6 +28,27 @@ import threading
 _RESERVOIR = 512
 
 
+def percentile(xs, q):
+    """THE repo percentile: linear interpolation between closest ranks
+    (numpy's default method), pure python, None on empty input.
+
+    Before this helper the repo had two disagreeing implementations —
+    nearest-rank here in ``Histogram`` and ``np.percentile`` in
+    ``serving/metrics.py`` — whose p99s diverged visibly on the small
+    reservoirs serving actually has.  Both now call this one; accepts
+    any sequence (sorts a copy, so pre-sorted callers pay one no-op
+    pass)."""
+    xs = sorted(float(x) for x in xs)
+    if not xs:
+        return None
+    if len(xs) == 1:
+        return xs[0]
+    pos = float(q) / 100.0 * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
 class Counter:
     """Monotonic int counter."""
 
@@ -91,13 +112,6 @@ class Histogram:
             self._recent.append(v)
         return self
 
-    def _pct(self, sorted_recent, q):
-        if not sorted_recent:
-            return None
-        i = min(len(sorted_recent) - 1,
-                int(round(q / 100.0 * (len(sorted_recent) - 1))))
-        return sorted_recent[i]
-
     def summary(self):
         with self._lock:
             recent = sorted(self._recent)
@@ -109,8 +123,9 @@ class Histogram:
             "min": mn,
             "max": mx,
             "mean": round(total / count, 6) if count else None,
-            "p50": self._pct(recent, 50),
-            "p99": self._pct(recent, 99),
+            "p50": percentile(recent, 50),
+            "p95": percentile(recent, 95),
+            "p99": percentile(recent, 99),
         }
 
 

@@ -1,0 +1,186 @@
+"""hetu_top: a live terminal dashboard over the merged telemetry stream.
+
+``bin/hetu_top.py`` is the CLI.  It tails the same contract-shaped JSONL
+files ``hetu_trace`` merges (default: every ``HETU_*_LOG`` configured in
+the environment) and renders the serving engine's vitals in place:
+
+- engine: batch occupancy, live slots, queue depth, fused-step count;
+- paged KV pool: blocks free / shared, registered prefixes (the
+  ``gauge`` records kv_manager emits);
+- latency: TTFT and TPOT percentiles over the visible window;
+- SLO: current health state (ok/degraded/breach), burn rate, violation
+  count — the same signal ``ServingEngine.health()`` returns;
+- incidents: flight-recorder dumps and queue rejections.
+
+Everything is derived from the log records alone (no live process
+hookup): point ``hetu_top`` at a dead run's log and it renders the
+final state — the "what was it doing" companion to the flight
+recorder's "what happened".  ``--once`` renders a single frame and
+exits (scripts, tests); otherwise the screen refreshes every
+``--interval`` seconds until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from .metrics import percentile
+from .trace import configured_logs, read_events
+
+
+def _pct_ms(xs, q):
+    v = percentile(xs, q) if xs else None
+    return None if v is None else v
+
+
+def summarize(events, window=512):
+    """Dashboard stats from the newest ``window`` records of a merged,
+    time-sorted stream (``read_events`` output)."""
+    events = events[-window:] if window else events
+    gauges = {}
+    ttft_ms, tpot_ms = [], []
+    counts = {"submitted": 0, "finished": 0, "rejected": 0}
+    steps = []
+    slo = {"state": None, "burn_rate": None, "violations": 0}
+    flight_dumps = 0
+    for e in events:
+        kind = e.get("event")
+        if kind == "gauge":
+            gauges[e.get("name")] = e.get("value")
+        elif kind == "serve_step":
+            steps.append(e)
+        elif kind == "serve_submit":
+            counts["submitted"] += 1
+        elif kind == "serve_finish":
+            counts["finished"] += 1
+        elif kind == "serve_queue_reject":
+            counts["rejected"] += 1
+        elif kind == "serve_admit":
+            if isinstance(e.get("ttft_s"), (int, float)):
+                ttft_ms.append(e["ttft_s"] * 1e3)
+        elif kind == "req_retire":
+            n = e.get("n_generated")
+            d = e.get("decode_ms")
+            if isinstance(n, int) and n > 1 and \
+                    isinstance(d, (int, float)) and d > 0:
+                tpot_ms.append(d / (n - 1))
+        elif kind == "slo_health":
+            slo["state"] = e.get("state")
+            slo["burn_rate"] = e.get("burn_rate")
+        elif kind == "slo_violation":
+            slo["violations"] += 1
+        elif kind == "flight_dump":
+            flight_dumps += 1
+    last = steps[-1] if steps else {}
+    occupancy = gauges.get("serve.occupancy")
+    if occupancy is None and isinstance(last.get("live"), int) and \
+            isinstance(last.get("slots"), int) and last["slots"]:
+        occupancy = round(last["live"] / last["slots"], 4)
+    tok_s = None
+    if len(steps) >= 2:
+        span = steps[-1].get("t", 0) - steps[0].get("t", 0)
+        if span > 0:
+            tok_s = round(sum(s.get("live", 0) for s in steps) / span, 1)
+    if slo["burn_rate"] is None:
+        slo["burn_rate"] = gauges.get("serve.slo_burn")
+    if slo["state"] is None:
+        slo["state"] = {0: "ok", 1: "degraded", 2: "breach"}.get(
+            gauges.get("serve.health"), "ok")
+    return {
+        "records": len(events),
+        "occupancy": occupancy,
+        "live": last.get("live"),
+        "slots": last.get("slots"),
+        "queue_depth": last.get("queue_depth"),
+        "steps": len(steps),
+        "tokens_per_sec": tok_s,
+        "blocks_free": gauges.get("serve.blocks_free"),
+        "blocks_shared": gauges.get("serve.blocks_shared"),
+        "prefix_entries": gauges.get("serve.prefix_entries"),
+        "ttft_p50_ms": _pct_ms(ttft_ms, 50),
+        "ttft_p95_ms": _pct_ms(ttft_ms, 95),
+        "ttft_p99_ms": _pct_ms(ttft_ms, 99),
+        "tpot_p50_ms": _pct_ms(tpot_ms, 50),
+        "tpot_p99_ms": _pct_ms(tpot_ms, 99),
+        "requests": counts,
+        "slo": slo,
+        "flight_dumps": flight_dumps,
+    }
+
+
+def _fmt(v, suffix="", nd=1):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}{suffix}"
+    return f"{v}{suffix}"
+
+
+def render(stats, clock=None):
+    """One dashboard frame as a string (ANSI-free: the CLI owns the
+    clear-screen escape so tests can assert on plain text)."""
+    s = stats
+    r = s["requests"]
+    slo = s["slo"]
+    state = slo["state"] or "ok"
+    badge = {"ok": "[ OK ]", "degraded": "[DEGR]",
+             "breach": "[BRCH]"}.get(state, f"[{state}]")
+    lines = [
+        f"hetu_top — {time.strftime('%H:%M:%S', time.gmtime(clock))} UTC"
+        f"  ({s['records']} records)",
+        "-" * 64,
+        f"engine    occupancy {_fmt(s['occupancy'])}"
+        f"  live {_fmt(s['live'])}/{_fmt(s['slots'])}"
+        f"  queue {_fmt(s['queue_depth'])}"
+        f"  steps {_fmt(s['steps'])}"
+        f"  tok/s {_fmt(s['tokens_per_sec'])}",
+        f"kv pool   blocks_free {_fmt(s['blocks_free'])}"
+        f"  blocks_shared {_fmt(s['blocks_shared'])}"
+        f"  prefixes {_fmt(s['prefix_entries'])}",
+        f"requests  submitted {r['submitted']}"
+        f"  finished {r['finished']}  rejected {r['rejected']}",
+        f"TTFT ms   p50 {_fmt(s['ttft_p50_ms'])}"
+        f"  p95 {_fmt(s['ttft_p95_ms'])}"
+        f"  p99 {_fmt(s['ttft_p99_ms'])}",
+        f"TPOT ms   p50 {_fmt(s['tpot_p50_ms'])}"
+        f"  p99 {_fmt(s['tpot_p99_ms'])}",
+        f"SLO       {badge} burn {_fmt(slo['burn_rate'], nd=2)}"
+        f"  violations {slo['violations']}"
+        f"  flight_dumps {s['flight_dumps']}",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hetu_top",
+        description="Live terminal dashboard over the merged telemetry "
+                    "JSONL stream (occupancy, queue, KV pool, TTFT/TPOT "
+                    "percentiles, SLO health).")
+    ap.add_argument("paths", nargs="*",
+                    help="JSONL files (default: every HETU_*_LOG / "
+                         "HETU_TELEMETRY_LOG set in the environment)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripts/tests)")
+    ap.add_argument("--window", type=int, default=512, metavar="N",
+                    help="newest N records the frame is computed over")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or configured_logs()
+    if not paths:
+        ap.error("no paths given and no HETU_*_LOG configured")
+    while True:
+        events, _bad = read_events(paths)
+        frame = render(summarize(events, window=args.window),
+                       clock=time.time())
+        if args.once:
+            print(frame)
+            return 0
+        print("\x1b[2J\x1b[H" + frame, flush=True)
+        try:
+            time.sleep(max(args.interval, 0.1))
+        except KeyboardInterrupt:
+            return 0

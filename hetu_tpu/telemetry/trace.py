@@ -9,15 +9,33 @@ read.  Output:
 - default: the merged, time-sorted stream as JSONL on stdout (the
   one ``tail | jq`` pipeline, now across all streams at once);
 - ``--export trace.json``: a Chrome/Perfetto-loadable trace —
-  duration-carrying records (``span``/``serve_step``/``serve_prefill``)
-  become ``"X"`` complete events laid out per pid/thread track,
-  everything else an ``"i"`` instant — plus a one-line summary on
-  stdout.
+  duration-carrying records (``span``/``serve_step``/``serve_prefill``/
+  ``req_span``) become ``"X"`` complete events laid out per pid/thread
+  track, ``gauge`` records become ``"C"`` counter tracks (occupancy,
+  queue depth, blocks_free render as time series; ``serve_step``
+  records contribute ``serve.queue_depth``/``serve.live`` counters
+  too), everything else an ``"i"`` instant — plus a one-line summary
+  on stdout.
 
-Durations: a ``span`` record's ``t`` is its START epoch and ``ms`` its
-length (events.py writes them that way); serving step/prefill records
-timestamp the END of the phase, so the exporter backdates their start
-by the duration field.
+Request-lifecycle tracks: ``req_span`` records (serving/metrics.py, one
+per queue/kv_alloc/prefill/decode/requeue phase of each request) land
+on a per-request track named ``req:<request_id>``, so one request's
+whole lifecycle reads as a lane; flow arrows (``s``/``t``/``f`` events
+keyed by the request id) connect its decode span into every engine
+fused-step wave it participated in (``serve_step`` records carry the
+per-wave request list).
+
+Durations: a ``span``/``req_span`` record's ``t`` is its START epoch
+and ``ms`` its length (events.py writes them that way); serving
+step/prefill records timestamp the END of the phase, so the exporter
+backdates their start by the duration field.
+
+``--check`` validates every record against the event contract AND the
+span-balance rule: every ``serve_admit`` must have a matching
+``serve_finish`` (a request admitted but never retired is a leaked
+slot or a crashed scheduler loop).  Balance is skipped when the input
+contains a ``flight_dump`` header — a flight recording is by
+definition a mid-flight snapshot.
 """
 
 from __future__ import annotations
@@ -33,10 +51,15 @@ from .events import STREAMS, validate_record
 # serving kinds (their emitter stamps after the phase completes)
 _DUR_FIELDS = {
     "span": ("ms", None),              # name comes from the record
+    "req_span": ("ms", None),          # name = the lifecycle phase
     "serve_prefill": ("prefill_ms", "serve.prefill"),
     "serve_step": ("decode_ms", "serve.decode"),
 }
 _T_IS_END = ("serve_prefill", "serve_step")
+
+# serve_step fields worth a counter track alongside the wave span
+_STEP_COUNTERS = (("queue_depth", "serve.queue_depth"),
+                  ("live", "serve.live"))
 
 
 def configured_logs():
@@ -83,10 +106,15 @@ def read_events(paths, strict=False):
 
 def to_chrome_trace(events):
     """Chrome trace-event JSON (Perfetto-loadable): spans as complete
-    ("X") events, point events as instants ("i"), with thread-name
-    metadata so tracks read as the emitting thread."""
+    ("X") events, gauges + serve_step depths as counter ("C") tracks,
+    request lifecycles as per-request ``req:<id>`` tracks with flow
+    arrows into the engine's fused-step wave spans, point events as
+    instants ("i"), with thread-name metadata so tracks read as the
+    emitting thread."""
     out = []
     tids = {}
+    waves = []          # (start_us, end_us, pid, tid, request ids)
+    decode_spans = {}   # request id -> (start_us, end_us, pid, tid)
 
     def tid_for(pid, name):
         key = (pid, name)
@@ -100,11 +128,22 @@ def to_chrome_trace(events):
     for rec in events:
         kind = rec.get("event")
         pid = int(rec.get("pid", 0))
-        tid = tid_for(pid, rec.get("tid", rec.get("_src", "events")))
+        if kind == "req_span":
+            # lifecycle phases live on the request's own track
+            track = f"req:{rec.get('request')}"
+        else:
+            track = rec.get("tid", rec.get("_src", "events"))
+        tid = tid_for(pid, track)
         ts_us = float(rec.get("t", 0.0)) * 1e6
         args = {k: v for k, v in rec.items()
                 if k not in ("t", "event", "pid", "tid", "_src")
                 and isinstance(v, (int, float, str, bool))}
+        if kind == "gauge":
+            out.append({"name": str(rec.get("name")), "cat": "gauge",
+                        "ph": "C", "ts": ts_us, "pid": pid,
+                        "tid": tid_for(pid, "counters"),
+                        "args": {"value": rec.get("value")}})
+            continue
         dur_spec = _DUR_FIELDS.get(kind)
         dur_ms = (rec.get(dur_spec[0])
                   if dur_spec is not None else None)
@@ -112,16 +151,80 @@ def to_chrome_trace(events):
             dur_us = float(dur_ms) * 1e3
             if kind in _T_IS_END:
                 ts_us -= dur_us
-            name = rec.get("name") or dur_spec[1] or kind
+            name = (rec.get("name") or rec.get("phase")
+                    or dur_spec[1] or kind)
             out.append({"name": str(name), "cat": kind, "ph": "X",
                         "ts": ts_us, "dur": dur_us, "pid": pid,
                         "tid": tid, "args": args})
             n_spans += 1
+            if kind == "serve_step":
+                for field, cname in _STEP_COUNTERS:
+                    if isinstance(rec.get(field), (int, float)):
+                        out.append({
+                            "name": cname, "cat": "gauge", "ph": "C",
+                            "ts": ts_us, "pid": pid,
+                            "tid": tid_for(pid, "counters"),
+                            "args": {"value": rec[field]}})
+                reqs = rec.get("requests")
+                if isinstance(reqs, (list, tuple)):
+                    waves.append((ts_us, ts_us + dur_us, pid, tid,
+                                  [str(r) for r in reqs]))
+            elif kind == "req_span" and rec.get("phase") == "decode":
+                decode_spans[str(rec.get("request"))] = \
+                    (ts_us, ts_us + dur_us, pid, tid)
         else:
             out.append({"name": str(kind), "cat": "event", "ph": "i",
                         "s": "t", "ts": ts_us, "pid": pid, "tid": tid,
                         "args": args})
+    # flow arrows: each request's decode span -> the engine wave spans
+    # it participated in (s on the request track, t bound inside each
+    # wave slice, f back on the request track at retire)
+    n_flows = 0
+    for rid, (d0, d1, rpid, rtid) in sorted(decode_spans.items()):
+        hits = [(w0, wpid, wtid) for w0, w1, wpid, wtid, reqs in waves
+                if rid in reqs]
+        if not hits:
+            continue
+        flow = {"name": "req_flow", "cat": "req", "id": rid}
+        out.append({**flow, "ph": "s", "ts": d0, "pid": rpid,
+                    "tid": rtid})
+        for w0, wpid, wtid in sorted(hits):
+            # clamp into the decode span: the wave's backdated start
+            # can drift past the request's retire stamp by scheduler-
+            # loop overhead (the two are stamped at different points of
+            # the same iteration), and flow steps must stay s <= t <= f
+            out.append({**flow, "ph": "t",
+                        "ts": min(max(w0, d0), d1),
+                        "pid": wpid, "tid": wtid})
+        out.append({**flow, "ph": "f", "bp": "e", "ts": d1,
+                    "pid": rpid, "tid": rtid})
+        n_flows += 1
     return {"traceEvents": out, "displayTimeUnit": "ms"}, n_spans
+
+
+def check_span_balance(events):
+    """The request span-balance rule: every ``serve_admit`` must pair
+    with a ``serve_finish`` for the same request id (and vice versa —
+    a finish with no admit is a torn or miswired log).  Returns problem
+    strings; empty on a balanced stream.  A stream containing a
+    ``flight_dump`` header is a mid-flight snapshot and is exempt."""
+    if any(e.get("event") == "flight_dump" for e in events):
+        return []
+    admits, finishes = set(), set()
+    for e in events:
+        kind = e.get("event")
+        if kind == "serve_admit":
+            admits.add(e.get("request"))
+        elif kind == "serve_finish":
+            finishes.add(e.get("request"))
+    problems = []
+    for rid in sorted(str(r) for r in admits - finishes):
+        problems.append(f"span-balance: request {rid!r} admitted but "
+                        f"never finished/retired")
+    for rid in sorted(str(r) for r in finishes - admits):
+        problems.append(f"span-balance: request {rid!r} finished "
+                        f"without a matching admit")
+    return problems
 
 
 def main(argv=None):
@@ -142,7 +245,9 @@ def main(argv=None):
                          "(e.g. span,serve_step)")
     ap.add_argument("--check", action="store_true",
                     help="validate every record against the event "
-                         "contract; exit 1 on violations")
+                         "contract AND the request span-balance rule "
+                         "(every serve_admit has a serve_finish); "
+                         "exit 1 on violations")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()
@@ -161,10 +266,13 @@ def main(argv=None):
             for p in validate_record(rec):
                 problems.append(f"{rec.get('_src')}: {p}: "
                                 f"{json.dumps(rec)[:160]}")
+        balance = check_span_balance(events)
+        problems.extend(balance)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
-                          "contract_violations": len(problems)}))
+                          "contract_violations": len(problems),
+                          "span_balance_violations": len(balance)}))
         return 1 if problems or bad else 0
 
     if args.export:

@@ -666,3 +666,83 @@ class TestValidatorEventLogContract:
                 for line in log.read_text().splitlines()]
         assert len(recs) == 2
         assert all(self._record_shape_ok(r) for r in recs)
+
+
+@pytest.mark.smoke
+class TestFlightRecorderChaos:
+    """ISSUE 7 tentpole (d): the chaos flight recorder under
+    ``HETU_CHAOS``.  A ``kill=`` event must write the black box to
+    ``$HETU_FLIGHT_LOG`` BEFORE the SIGKILL lands (the process gets no
+    other chance), and a reset storm that exhausts the client's retries
+    dumps from the ``PSConnectionError`` failure path — in both cases a
+    contract-valid JSONL file holding the records that led up to the
+    fault."""
+
+    def _read_dump(self, path):
+        with open(path) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+
+    def test_chaos_kill_dumps_flight_log(self, tmp_path):
+        import subprocess
+        flog = str(tmp_path / "flight.jsonl")
+        script = (
+            "from hetu_tpu import telemetry\n"
+            "from hetu_tpu.ps import faults\n"
+            "for i in range(6):\n"
+            "    telemetry.emit('worker_exit', _stream='failure',\n"
+            "                   rank=i, rc=0)\n"
+            "plan = faults.plan_from_env()\n"
+            "for _ in range(10):\n"
+            "    plan.draw('push')   # the 4th evaluated event SIGKILLs\n"
+            "raise SystemExit('kill never fired')\n")
+        env = dict(os.environ, HETU_CHAOS="seed=1,kill=4",
+                   HETU_CHAOS_ROLE="", HETU_RESTART_COUNT="0",
+                   HETU_FLIGHT_LOG=flog, HETU_FLIGHT_DEPTH="32",
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, timeout=120)
+        assert proc.returncode == -9, (proc.returncode, proc.stderr)
+        recs = self._read_dump(flog)
+        assert recs[0]["event"] == "flight_dump"
+        assert recs[0]["reason"] == "chaos_kill"
+        assert recs[0]["chaos_event"] == 4
+        assert recs[0]["records"] == len(recs) - 1
+        # the records leading up to the kill are all there, in order
+        assert [r["rank"] for r in recs[1:]] == list(range(6))
+        from hetu_tpu.telemetry import validate_record
+        for rec in recs:
+            assert validate_record(rec) == [], rec
+        # and hetu_trace --check accepts the dump as a stream
+        from hetu_tpu.telemetry.trace import main as trace_main
+        assert trace_main([flog, "--check"]) == 0
+
+    def test_reset_storm_dumps_on_retry_exhaustion(self, tmp_path,
+                                                   monkeypatch):
+        from hetu_tpu import telemetry
+        flog = str(tmp_path / "reset.jsonl")
+        monkeypatch.setenv("HETU_FLIGHT_LOG", flog)
+        telemetry.reset()
+        telemetry.emit("worker_exit", _stream="failure", rank=7, rc=0)
+        srv = PSServer()
+        c = PSClient(transport=_LocalServerTransport(srv))
+        c.param_set("fw", np.zeros(2, np.float32))
+        monkeypatch.setenv("HETU_CHAOS", "seed=0,reset=1.0")
+        with pytest.raises(PSConnectionError):
+            c.pull("fw")
+        recs = self._read_dump(flog)
+        headers = [r for r in recs if r["event"] == "flight_dump"]
+        assert headers and headers[0]["reason"] == "ps_connection_error"
+        assert headers[0]["shard"] == "local"
+        # the pre-fault marker made it into the black box
+        assert any(r["event"] == "worker_exit" and r.get("rank") == 7
+                   for r in recs)
+        from hetu_tpu.telemetry import validate_record
+        for rec in recs:
+            assert validate_record(rec) == [], rec
+
+    def test_no_flight_log_never_blocks_the_kill_path(self, monkeypatch):
+        # HETU_FLIGHT_LOG unset: dump is a no-op returning None (the
+        # chaos kill and error paths must not grow a new failure mode)
+        from hetu_tpu.telemetry.flight import RECORDER
+        monkeypatch.delenv("HETU_FLIGHT_LOG", raising=False)
+        assert RECORDER.dump("chaos_kill") is None

@@ -329,6 +329,12 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     assert art["speedup"] > 1.0
     assert art["continuous"]["ttft_p50_s"] is not None
     assert art["continuous"]["mean_batch_occupancy"] > 0
+    # request-lifecycle observability rides the same replay (ISSUE 7):
+    # the artifact records the tail decomposition + SLO state
+    obs = art["observability"]
+    assert obs["explain_tail"]["dominant_component"] in obs["components"]
+    assert obs["health"] in ("ok", "degraded", "breach")
+    assert obs["slo"]["health"] == obs["health"]
     # fast-path A/B: acceptance is greedy parity + per-phase numbers
     # (the ragged-vs-masked WIN is an on-chip claim — interpret-mode
     # emulation pays per-block overhead on CPU; suite stage 4c measures)
