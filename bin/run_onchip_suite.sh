@@ -151,6 +151,70 @@ python bin/hetu_trace.py "$LOG/serve_trace.jsonl" --check \
 run serve_trace_export 300 python bin/hetu_trace.py \
     "$LOG/serve_trace.jsonl" --export "$LOG/serve_trace_export.json"
 
+# 00d. router trace-replay gate: an N=2 CPU fleet with a seeded chaos
+#      kill of one replica mid-trace must retire EVERY request exactly
+#      once (requeued to the peer, never lost), leave contract-valid
+#      failure events + a flight dump on the killed replica, and a
+#      serve stream that passes the fleet span-balance rule — the
+#      router's robustness contract proven BEFORE chip-time serving.
+run router_trace 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/router_trace.jsonl" \
+    HETU_FAILURE_LOG="$LOG/router_failure.jsonl" \
+    HETU_FLIGHT_LOG="$LOG/router_flight.jsonl" \
+    HETU_CHAOS="seed=3,kill=4,role=replica1" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.serving import Request, ServingEngine, ServingRouter
+
+rng, hd = np.random.RandomState(0), 16
+p = {"rg_wte_table": rng.randn(61, hd) * 0.05,
+     "rg_wpe": rng.randn(32, hd) * 0.05,
+     "rg_ln_f_scale": np.ones(hd), "rg_ln_f_bias": np.zeros(hd)}
+for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+               ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+               ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+    p[f"rg_h0_{w}_weight"] = rng.randn(*shp) * 0.05
+    p[f"rg_h0_{w}_bias"] = np.zeros(shp[1])
+for ln in ("ln1", "ln2"):
+    p[f"rg_h0_{ln}_scale"] = np.ones(hd)
+    p[f"rg_h0_{ln}_bias"] = np.zeros(hd)
+cfg = GPTConfig(vocab_size=61, hidden_size=hd, num_hidden_layers=1,
+                num_attention_heads=2, max_position_embeddings=32,
+                batch_size=1, seq_len=32, dropout_rate=0.0)
+router = ServingRouter(
+    lambda i: ServingEngine(p, cfg, slots=2, fast_path=False),
+    replicas=2, restart_backoff=0.01)
+treq = np.random.RandomState(11)
+reqs = [Request(prompt=[int(t) for t in treq.randint(0, 61, 3)],
+                max_new_tokens=4, seed=s) for s in range(8)]
+res = router.run(reqs)
+snap = router.snapshot()
+assert len(res) == 8, f"retired {len(res)}/8"
+assert snap["lost"] == 0 and snap["duplicates"] == 0, snap
+assert snap["requeued"] >= 1, "the kill never cost a requeue?"
+print("router gate OK: finished", snap["finished"],
+      "requeued", snap["requeued"])
+PYEOF
+if ! grep -q 'router gate OK' "$LOG/router_trace.log"; then
+  echo "router fleet gate FAILED — see $LOG/router_trace.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/router_trace.jsonl" \
+    "$LOG/router_failure.jsonl" --check \
+    > "$LOG/router_trace_contract.log" || {
+  echo "router span-balance/contract check FAILED — see" \
+       "$LOG/router_trace_contract.log" >&2
+  exit 1
+}
+python bin/hetu_trace.py "$LOG/router_flight.jsonl" --check \
+    > "$LOG/router_flight_contract.log" || {
+  echo "router flight-dump contract check FAILED — see" \
+       "$LOG/router_flight_contract.log" >&2
+  exit 1
+}
+
 # 0. the rows a mid-capture wedge has previously cost us: the Aug-2
 #    recovery window measured bert_base/bert4l/gpt/resnet18 fresh, then
 #    the tunnel wedged INSIDE ctr_hybrid — so a fresh window banks the

@@ -243,6 +243,46 @@ _reg("HETU_KV_CHUNK", "int", 0,
      "pass).", "serving")
 
 # --------------------------------------------------------------------- #
+# serving fleet router (serving/router.py)
+# --------------------------------------------------------------------- #
+_reg("HETU_REPLICAS", "int", 2,
+     "Default fleet size for ServingRouter: how many supervised "
+     "ServingEngine replicas the router builds from its factory "
+     "(constructor replicas= overrides).", "router")
+_reg("HETU_ROUTER_AFFINITY", "bool", True,
+     "Session affinity: hash Request.session_id to a stable home "
+     "replica so a returning session's shared-prefix KV blocks stay "
+     "hot (remapped with a prefix_misses count when the home replica "
+     "is unroutable).", "router")
+_reg("HETU_ROUTER_STALE", "float", 0.0,
+     "> 0: the router kills, drains, and requeues a replica whose "
+     "step heartbeat is staler than this many seconds — wedged-replica "
+     "detection, the serving analog of HETU_LIVENESS_STALE.", "router")
+_reg("HETU_ROUTER_BREAKER", "int", 3,
+     "Per-replica circuit breaker: consecutive failures "
+     "(deaths/wedge kills) that eject the replica from routing; a "
+     "half-open probe request readmits it after the cooldown.",
+     "router")
+_reg("HETU_ROUTER_BREAKER_COOLDOWN", "float", 0.5,
+     "Base seconds an open circuit breaker holds before the half-open "
+     "probe (doubles per failure past the threshold).", "router")
+_reg("HETU_ROUTER_RETRY_LIMIT", "int", 5,
+     "Placement retries the router grants a request it holds "
+     "(requeued off a dead replica / fleet full) before declaring it "
+     "lost — a terminal failure with a flight dump.", "router")
+_reg("HETU_ROUTER_RETRY_BACKOFF", "float", 0.02,
+     "Base seconds of exponential backoff between a held request's "
+     "placement retries.", "router")
+_reg("HETU_ROUTER_SHED_QUEUE", "float", 0.75,
+     "Fleet queue-fill fraction at which SLO-class load shedding "
+     "starts: throughput-class submissions are shed (RouterShed) while "
+     "latency-class requests keep admitting until hard-full.", "router")
+_reg("HETU_ROUTER_SHED_ON_SLO", "bool", True,
+     "Also shed throughput-class traffic while any replica's SLO "
+     "health is at breach (frees capacity to pull latency-class TTFT "
+     "back inside budget).", "router")
+
+# --------------------------------------------------------------------- #
 # graph/ops knobs
 # --------------------------------------------------------------------- #
 _reg("HETU_MOE_SCATTER_DISPATCH", "bool", False,

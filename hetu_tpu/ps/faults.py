@@ -23,9 +23,21 @@ Spec grammar (comma-separated ``k=v``)::
     slow=<p>:<s>      P[<s> seconds of server slowness after applying]
     kill=<n>          one-shot SIGKILL of THIS process at the n-th
                       evaluated event (1-based; the chaos test's
-                      mid-training shard kill)
+                      mid-training shard kill).  A seam drawing with
+                      ``inline=True`` (the serving replica harness)
+                      gets ``Fault("kill")`` back instead of the
+                      process-wide SIGKILL and handles the death itself
+    wedge=<n>         one-shot WEDGE at the n-th evaluated event: the
+                      victim stays alive but stops making progress (and
+                      stops heartbeating) — the mid-run hang class of
+                      failure.  Only fires at seams that opt in via
+                      ``kinds`` containing "wedge" (the serving replica
+                      step seam); transports never draw it
     role=<name>       plan active only when HETU_CHAOS_ROLE == name
-                      (prefix match: role=server matches server:0)
+                      (prefix match: role=server matches server:0).
+                      Seams hosting several roles in ONE process (the
+                      router's replica fleet) pass their role to
+                      ``draw(role=...)`` explicitly, overriding the env
 
 Determinism: decision ``i`` is a pure function of ``(seed, i)`` (a
 blake2 hash, not an RNG object), so a spec replays the identical fault
@@ -62,7 +74,7 @@ class InjectedFault(ConnectionError):
 
 class Fault:
     """One drawn event: ``kind`` in {none, drop, dup, reset, delay, slow,
-    kill} plus the latency for the timed kinds."""
+    kill, wedge} plus the latency for the timed kinds."""
 
     __slots__ = ("kind", "seconds")
 
@@ -84,7 +96,8 @@ def _u01(seed, n):
 
 class FaultPlan:
     def __init__(self, seed=0, drop=0.0, dup=0.0, reset=0.0,
-                 delay=(0.0, 0.0), slow=(0.0, 0.0), kill=None, role=None):
+                 delay=(0.0, 0.0), slow=(0.0, 0.0), kill=None, wedge=None,
+                 role=None):
         self.seed = int(seed)
         self.drop = float(drop)
         self.dup = float(dup)
@@ -92,12 +105,14 @@ class FaultPlan:
         self.delay = (float(delay[0]), float(delay[1]))
         self.slow = (float(slow[0]), float(slow[1]))
         self.kill = None if kill is None else int(kill)
+        self.wedge = None if wedge is None else int(wedge)
         self.role = role
         self._n = 0
         self._mu = threading.Lock()
         # observability: how often each kind actually fired
         self.fired = {k: 0 for k in
-                      ("drop", "dup", "reset", "delay", "slow", "kill")}
+                      ("drop", "dup", "reset", "delay", "slow", "kill",
+                       "wedge")}
 
     # ---------------- spec parsing ---------------- #
 
@@ -114,7 +129,7 @@ class FaultPlan:
             k, v = part.split("=", 1)
             k = k.strip()
             v = v.strip()
-            if k in ("seed", "kill"):
+            if k in ("seed", "kill", "wedge"):
                 kw[k] = int(v)
             elif k in ("drop", "dup", "reorder", "reset"):
                 key = "dup" if k == "reorder" else k
@@ -128,23 +143,33 @@ class FaultPlan:
                 raise ValueError(f"unknown chaos spec key {k!r}")
         return cls(**kw)
 
-    def active(self):
+    def active(self, role=None):
         """Role gate: a role-scoped plan only fires in matching
-        processes (HETU_CHAOS_ROLE, prefix match)."""
+        processes (HETU_CHAOS_ROLE, prefix match).  ``role`` overrides
+        the env lookup for seams hosting several roles in one process
+        (the router's replica fleet stamps ``replica<k>``)."""
         if self.role is None:
             return True
+        if role is not None:
+            return str(role).startswith(self.role)
         from .. import envvars
         return envvars.get_str("HETU_CHAOS_ROLE").startswith(self.role)
 
     # ---------------- the decision stream ---------------- #
 
-    def draw(self, method=None, kinds=None):
+    def draw(self, method=None, kinds=None, role=None, inline=False):
         """Consume one decision and return the Fault for it.  ``kinds``
         restricts which kinds may fire at this seam (the counter always
         advances, so restricted and unrestricted callers share one
         deterministic stream).  A ``kill`` event SIGKILLs this process
-        and does not return."""
-        if not self.active():
+        and does not return — unless ``inline`` is set, in which case
+        ``Fault("kill")`` is returned and the caller owns the death
+        (the serving replica harness, where a fleet of roles shares one
+        process and a SIGKILL would take out the survivors too).
+        ``role`` overrides the env role for the gate (see ``active``);
+        a non-matching role never advances the counter, so each
+        replica's step stream is independently deterministic."""
+        if not self.active(role):
             return Fault("none")
         with self._mu:
             self._n += 1
@@ -156,6 +181,8 @@ class FaultPlan:
             # incarnation (HETU_RESTART_COUNT > 0) must not re-fire the
             # kill, or recovery could never be observed
             self.fired["kill"] += 1
+            if inline:
+                return Fault("kill")
             try:
                 # the kill's black box: dump the flight ring BEFORE the
                 # SIGKILL (the process gets no other chance) — a failed
@@ -166,6 +193,13 @@ class FaultPlan:
             except Exception:  # noqa: BLE001
                 pass
             os.kill(os.getpid(), signal.SIGKILL)
+        if self.wedge is not None and n == self.wedge and \
+                kinds is not None and "wedge" in kinds:
+            # wedges only fire at seams that can act them out (the
+            # replica step loop); transports draw without "wedge" and
+            # simply consume the position
+            self.fired["wedge"] += 1
+            return Fault("wedge")
         u = _u01(self.seed, n)
         edge = 0.0
         for kind, p, secs in (("drop", self.drop, 0.0),

@@ -11,6 +11,18 @@ compiled arithmetic — with the offline path.
 
     engine.py     ServingEngine: admission queue with backpressure, the
                   per-step admit -> prefill -> fused-decode -> retire loop
+    router.py     ServingRouter: the FLEET tier — health-aware weighted
+                  routing over N supervised replicas, session affinity
+                  (session_id -> home replica, warm prefix blocks),
+                  per-replica circuit breakers, dead/wedged-replica
+                  drain + requeue with zero request loss, bounded
+                  retry/deadlines, SLO-class load shedding
+                  (throughput-class first), QueueFull backpressure
+                  propagated up
+    replica.py    Replica: one supervised engine slot — respawn under
+                  the launcher's HETU_RESTART_LIMIT/BACKOFF budget,
+                  chaos kill/wedge at the step seam (HETU_CHAOS
+                  role=replica<k>), heartbeat for wedge detection
     kv_manager.py KVCacheManager: free-slot allocation + per-slot filled
                   lengths over one preallocated [L, B_slots, S_max, H, Dh]
                   cache pair, pow2-bucketed shapes; PagedKVManager: the
@@ -55,9 +67,12 @@ from .kv_manager import (
 )
 from .metrics import COMPONENTS, ServingMetrics
 from .engine import ServingEngine, QueueFull
+from .replica import Replica
+from .router import RouterShed, ServingRouter
 
 __all__ = [
-    "ServingEngine", "QueueFull", "Request", "Result",
+    "ServingEngine", "ServingRouter", "Replica", "QueueFull",
+    "RouterShed", "Request", "Result",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",
     "COMPONENTS", "SLO", "SLOMonitor",
     "resolve_kv_block", "round_up_pow2",

@@ -95,7 +95,7 @@ class ServingEngine:
                  max_seq_len=None, name=None, dtype=None, log_path=None,
                  donate=True, fast_path=None, paged=None, kv_block=None,
                  pool_blocks=None, prefix_share=None, prefill_chunk=None,
-                 slo=None):
+                 slo=None, tags=None):
         c = config
         self._name = _infer_name(params, name)
         dt_ = dtype or jnp.float32
@@ -148,7 +148,9 @@ class ServingEngine:
         self.peak_live = 0            # max concurrent admitted slots
         self.queue_limit = int(queue_limit)
         self._queue = collections.deque()
-        self.metrics = ServingMetrics(log_path)
+        # tags (e.g. replica=<k> from the fleet router) ride on every
+        # event so N engines sharing one merged stream stay separable
+        self.metrics = ServingMetrics(log_path, tags=tags)
         # SLO monitor: explicit SLOMonitor / list of SLOs / default
         # env-declared (HETU_SLO_*; empty = always "ok").  Violations
         # and health transitions route through metrics.event so they
@@ -213,6 +215,12 @@ class ServingEngine:
     def pending(self):
         """Requests not yet finished (queued + in slots)."""
         return len(self._queue) + len(self.kv.live())
+
+    @property
+    def queue_depth(self):
+        """Admissions waiting in the bounded queue (the router's
+        backpressure/shedding signal, alongside ``health()``)."""
+        return len(self._queue)
 
     # ------------------------------------------------------------- #
 

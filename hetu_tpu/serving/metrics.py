@@ -18,6 +18,10 @@ full component breakdown:
     queue_ms        submit -> first admission attempt
     requeue_ms      head-of-queue wait while blocked (paged pool
                     exhaustion / prefix deferral); 0 when never blocked
+    router_hop_ms   wall time lost to a FAILED placement before this
+                    engine saw the request (the fleet router requeued
+                    it off a dead/wedged replica — serving/router.py
+                    credits the hop at re-submission); 0 un-routed
     kv_alloc_ms     slot + block-table claim
     prefill_ms      prompt compute actually dispatched for this request
     chunk_stall_ms  prefill-phase wall not spent computing (chunked
@@ -44,8 +48,8 @@ from ..telemetry.metrics import percentile
 
 import numpy as np
 
-COMPONENTS = ("queue_ms", "requeue_ms", "kv_alloc_ms", "prefill_ms",
-              "chunk_stall_ms", "decode_ms")
+COMPONENTS = ("queue_ms", "requeue_ms", "router_hop_ms", "kv_alloc_ms",
+              "prefill_ms", "chunk_stall_ms", "decode_ms")
 
 
 def _pct(xs, q):
@@ -60,7 +64,7 @@ class _Lifecycle:
     """Perf-counter timeline of one request, engine-side."""
 
     __slots__ = ("t_submit", "t_blocked", "t_claim", "kv_alloc_ms",
-                 "prefill_ms", "t_first", "n_prefills")
+                 "prefill_ms", "t_first", "n_prefills", "hop_ms")
 
     def __init__(self, t_submit):
         self.t_submit = t_submit
@@ -70,12 +74,18 @@ class _Lifecycle:
         self.prefill_ms = 0.0     # dispatched prompt compute
         self.n_prefills = 0       # dispatches (chunks) it rode in
         self.t_first = None       # first token landed
+        self.hop_ms = 0.0         # router requeue hops before us
 
 
 class ServingMetrics:
-    def __init__(self, log_path=None):
+    def __init__(self, log_path=None, tags=None):
         self.log_path = (log_path if log_path is not None
                          else envvars.get_path("HETU_SERVE_LOG"))
+        # fields stamped onto EVERY event this engine emits (the fleet
+        # router tags each replica's engine with replica=<k>, which is
+        # what lets hetu_top --fleet and the per-replica span-balance
+        # rule tell N same-process engines apart in one merged stream)
+        self.tags = dict(tags or {})
         cap = max(1, envvars.get_int("HETU_TELEMETRY_BUFFER"))
         # full in-memory history only when the run keeps a JSONL log
         # (deliberate observation); ring-buffered otherwise so a
@@ -111,7 +121,8 @@ class ServingMetrics:
         # a "t" field overrides the record's timestamp (req_span records
         # are START-stamped like `span` records)
         rec = telemetry.emit(kind, _stream="serve", _path=self.log_path,
-                             _t=fields.pop("t", None), **fields)
+                             _t=fields.pop("t", None),
+                             **{**self.tags, **fields})
         self.events.append(rec)
         return rec
 
@@ -153,6 +164,15 @@ class ServingMetrics:
         if lc is not None:
             lc.prefill_ms += dt_s * 1e3
             lc.n_prefills += 1
+
+    def lc_hop(self, request_id, hop_ms):
+        """Credit wall time the fleet router lost placing this request
+        on a replica that died/wedged before it could retire (called by
+        the router right after the re-submission; accumulates across
+        hops)."""
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.hop_ms += float(hop_ms)
 
     # ------------------------------------------------------------- #
 
@@ -252,6 +272,7 @@ class ServingMetrics:
             if n_generated > 1 else 0.0
         ttft_ms = max(lc.t_first - lc.t_submit, 0.0) * 1e3
         comp = {"queue_ms": queue_ms, "requeue_ms": requeue_ms,
+                "router_hop_ms": lc.hop_ms,
                 "kv_alloc_ms": lc.kv_alloc_ms, "prefill_ms": prefill_ms,
                 "chunk_stall_ms": chunk_stall_ms, "decode_ms": decode_ms}
         for k, v in comp.items():
@@ -267,6 +288,12 @@ class ServingMetrics:
                   ("kv_alloc", claim_start, lc.kv_alloc_ms, {})]
         if lc.t_blocked is not None:
             phases.insert(1, ("requeue", lc.t_blocked, requeue_ms, {}))
+        if lc.hop_ms > 0:
+            # the hop happened BEFORE this engine's submit: backdate
+            # its span so the request's track reads hop -> queue -> ...
+            phases.insert(0, ("router_hop",
+                              lc.t_submit - lc.hop_ms / 1e3,
+                              lc.hop_ms, {}))
         phases.append(("prefill", claim_end, prefill_wall_ms,
                        {"compute_ms": round(prefill_ms, 3),
                         "stall_ms": round(chunk_stall_ms, 3),

@@ -29,6 +29,13 @@ class Request:
     sampling once this id is emitted past the prompt; seed: the
     request's own rng stream; stream_cb: called as cb(request, token)
     for every generated token as it lands (iteration-level streaming).
+
+    Fleet fields (serving/router.py; a bare engine ignores them):
+    slo_class "latency" or "throughput" — under overload the router
+    sheds throughput-class traffic first; session_id keys session
+    affinity (same session -> same replica, so its shared-prefix KV
+    blocks stay hot); deadline_s bounds how long the router may hold
+    the request across retries/requeues before expiring it.
     """
 
     prompt: Sequence[int]
@@ -39,6 +46,10 @@ class Request:
     seed: int = 0
     stream_cb: Optional[Callable] = None
     request_id: Optional[str] = None
+    # fleet routing (serving/router.py)
+    slo_class: str = "throughput"
+    session_id: Optional[str] = None
+    deadline_s: Optional[float] = None
     # set by the engine
     submitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -51,6 +62,10 @@ class Request:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
         self.max_new_tokens = int(self.max_new_tokens)
+        if self.slo_class not in ("latency", "throughput"):
+            raise ValueError(
+                f"slo_class must be 'latency' or 'throughput', "
+                f"got {self.slo_class!r}")
         if self.request_id is None:
             self.request_id = f"req-{next(_ids)}"
 

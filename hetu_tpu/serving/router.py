@@ -1,0 +1,635 @@
+"""Multi-replica serving router: health-aware routing, dead-replica
+drain + requeue, and SLO-class load shedding over N supervised engines.
+
+One ServingEngine is a single scheduler loop; a fleet is N of them
+behind this router, which owns everything a fleet adds to the problem:
+
+- **Health-aware weighted routing.**  Each placement scores the
+  routable replicas by their SLO health (``engine.health()`` —
+  ok/degraded/breach, PR 7's burn-rate signal) discounted by current
+  load (queue depth + live slots) and picks the best, so a degraded
+  replica sheds weight before it breaches and an empty replica absorbs
+  bursts.  Deterministic: same fleet state, same pick.
+
+- **Session affinity.**  ``Request.session_id`` hashes to a home
+  replica (stable across the fleet's lifetime), so a returning user's
+  shared-prefix KV blocks (PR 6's refcounted prefix cache) stay hot on
+  the replica that already holds them.  When the home replica is
+  unroutable the session is remapped to the best peer and the
+  ``prefix_misses`` counter records the cold start.
+
+- **Supervised replicas with drain + requeue.**  Replicas die (chaos
+  kill, scheduler exception) and wedge (alive, silent).  Death is
+  detected by state, wedge by stale heartbeat (``HETU_ROUTER_STALE``,
+  the serving analog of ``HETU_LIVENESS_STALE``) — either way the
+  router DRAINS the corpse from its own assignment records (a dead
+  process cannot be introspected) and requeues every unretired request
+  onto peers: **no request is lost**, and because outputs are a pure
+  function of the Request (seed-derived rng), a requeued request's
+  tokens are identical to an undisturbed run.  The lost wall time is
+  attributed: a ``router_hop`` event per re-placement plus the
+  ``router_hop_ms`` lifecycle component in the peer engine's
+  ``ServingMetrics.snapshot()``.  The replica respawns under the
+  launcher's exponential-backoff budget (``HETU_RESTART_LIMIT`` /
+  ``HETU_RESTART_BACKOFF``); a spent budget is terminal
+  (``replica_failed`` + flight dump).
+
+- **Per-replica circuit breaker.**  ``HETU_ROUTER_BREAKER`` consecutive
+  failures eject the replica from routing (state "open"); after a
+  cooldown one half-open PROBE request is let through — retiring it
+  closes the breaker, another failure reopens it with a doubled
+  cooldown.  A flapping replica stops eating traffic even while the
+  supervisor keeps respawning it.
+
+- **Bounded retry + deadlines.**  A request the router holds (requeued
+  off a corpse, or unplaceable) retries with exponential backoff
+  (``HETU_ROUTER_RETRY_BACKOFF``) up to ``HETU_ROUTER_RETRY_LIMIT``
+  times; exhaustion is a router terminal failure (event + flight dump).
+  ``Request.deadline_s`` bounds how long the router may hold it before
+  expiring it (``router_deadline``) instead of serving uselessly late.
+
+- **SLO-class load shedding + backpressure.**  Under pressure (fleet
+  queue fill >= ``HETU_ROUTER_SHED_QUEUE``, or any replica's SLO state
+  at breach with ``HETU_ROUTER_SHED_ON_SLO``) throughput-class
+  submissions are shed (:class:`RouterShed`) while latency-class
+  requests keep admitting until the fleet is hard-full — keeping
+  latency-class TTFT inside budget by sacrificing the traffic that
+  only cares about aggregate tokens.  When every routable replica's
+  queue is at capacity, ``submit`` raises plain QueueFull: the
+  replicas' backpressure propagates up through the router unchanged.
+
+Single-threaded by design: ``step()`` advances supervision, placement,
+and every live replica exactly once, which makes chaos runs
+seed-deterministic (the integration tests replay a kill and assert
+zero loss).  On chip, replicas would live on separate hosts; this
+in-process harness is the semantics testbed, the same way the launcher
+tests supervise local processes.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import time
+
+from .. import envvars, telemetry
+from ..telemetry import flight
+from .engine import QueueFull, _STORM_REJECTS
+from .replica import BACKOFF, DEAD, UP, WEDGED, Replica  # noqa: F401
+
+# health-state weights for the routing score (breach still gets a
+# trickle: it may be the only replica, and starving it entirely would
+# turn a soft breach into a hard outage)
+_HEALTH_W = {"ok": 1.0, "degraded": 0.5, "breach": 0.25}
+_LEVEL = {"ok": 0, "degraded": 1, "breach": 2}
+
+
+class RouterShed(QueueFull):
+    """SLO-class load shed: the fleet is under pressure and this
+    request's class is the one provisioned to lose.  Subclasses
+    QueueFull so a caller's backpressure handling needs no new case."""
+
+
+class _Routed:
+    """Router-side record of one submitted request."""
+
+    __slots__ = ("request", "t_submit", "t_assigned", "replica",
+                 "prev_replica", "hops", "retries", "next_at", "done",
+                 "lost", "result")
+
+    def __init__(self, request, t_submit):
+        self.request = request
+        self.t_submit = t_submit     # router clock (perf_counter)
+        self.t_assigned = None       # last successful placement
+        self.replica = None          # current replica index
+        self.prev_replica = None     # where the last hop came from
+        self.hops = 0                # requeues off dead replicas
+        self.retries = 0             # failed placement attempts
+        self.next_at = 0.0           # retry-backoff deadline
+        self.done = False
+        self.lost = False            # retry budget exhausted
+        self.result = None
+
+
+def _session_hash(session_id, n):
+    """Stable home-replica index for a session (blake2, not python's
+    salted hash(), so affinity survives process restarts)."""
+    h = hashlib.blake2b(str(session_id).encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "little") % n
+
+
+class ServingRouter:
+    """Load-balance requests across N supervised ServingEngine
+    replicas (see module docstring for the robustness contract).
+
+    ``factory(index)`` builds one replica's engine — every incarnation,
+    including post-death respawns, comes from it.  All engines must
+    share one config (the router pre-validates prompt lengths against
+    the first incarnation's ``s_max``).  Knobs default to the
+    ``HETU_ROUTER_*`` / launcher env registry entries; constructor
+    arguments override.
+    """
+
+    def __init__(self, factory, replicas=None, *, session_affinity=None,
+                 stale=None, breaker_threshold=None,
+                 breaker_cooldown=None, retry_limit=None,
+                 retry_backoff=None, shed_queue=None, shed_on_slo=None,
+                 restart_limit=None, restart_backoff=None,
+                 log_path=None):
+        n = int(replicas if replicas is not None
+                else envvars.get_int("HETU_REPLICAS"))
+        if n < 1:
+            raise ValueError(f"a fleet needs >= 1 replica, got {n}")
+        self.session_affinity = (
+            session_affinity if session_affinity is not None
+            else envvars.get_bool("HETU_ROUTER_AFFINITY"))
+        self.stale = float(stale if stale is not None
+                           else envvars.get_float("HETU_ROUTER_STALE"))
+        self.breaker_threshold = int(
+            breaker_threshold if breaker_threshold is not None
+            else envvars.get_int("HETU_ROUTER_BREAKER"))
+        self.breaker_cooldown = float(
+            breaker_cooldown if breaker_cooldown is not None
+            else envvars.get_float("HETU_ROUTER_BREAKER_COOLDOWN"))
+        self.retry_limit = int(
+            retry_limit if retry_limit is not None
+            else envvars.get_int("HETU_ROUTER_RETRY_LIMIT"))
+        self.retry_backoff = float(
+            retry_backoff if retry_backoff is not None
+            else envvars.get_float("HETU_ROUTER_RETRY_BACKOFF"))
+        self.shed_queue = float(
+            shed_queue if shed_queue is not None
+            else envvars.get_float("HETU_ROUTER_SHED_QUEUE"))
+        self.shed_on_slo = (
+            shed_on_slo if shed_on_slo is not None
+            else envvars.get_bool("HETU_ROUTER_SHED_ON_SLO"))
+        self.log_path = log_path
+        self.replicas = [
+            Replica(i, factory, restart_limit=restart_limit,
+                    restart_backoff=restart_backoff,
+                    emit_fn=self._fail_event)
+            for i in range(n)]
+        self.s_max = self.replicas[0].engine.kv.s_max
+        self._routed = {}                      # rid -> _Routed
+        self._assigned = {i: {} for i in range(n)}  # idx -> ordered rids
+        self._pending = collections.deque()    # router-held, to place
+        self._breaker = [
+            {"state": "closed", "failures": 0, "open_until": 0.0,
+             "probe": None, "opens": 0} for _ in range(n)]
+        self._reject_streak = [0] * n
+        self._session_last = {}                # session_id -> replica
+        # counters (snapshot surface)
+        self.submitted = 0
+        self.finished = 0
+        self.shed = 0
+        self.shed_by_class = {"latency": 0, "throughput": 0}
+        self.requeued = 0
+        self.expired = 0
+        self.lost = 0
+        self.duplicates = 0
+        self.prefix_misses = 0
+        self._placed = [0] * n
+        self._rejects = [0] * n
+        self._lat = []                         # fleet e2e latency (s)
+        self._ttft = []                        # fleet submit->token1 (s)
+        self._ttft_by_class = {"latency": [], "throughput": []}
+
+    # ------------------------------------------------------------- #
+    # events
+    # ------------------------------------------------------------- #
+
+    def _event(self, kind, **fields):
+        """Router request-path events ride the serve stream, next to
+        the engines' records."""
+        return telemetry.emit(kind, _stream="serve", _path=self.log_path,
+                              **fields)
+
+    def _fail_event(self, kind, **fields):
+        """Supervision events ride the failure stream, in the
+        launcher's record shape."""
+        return telemetry.emit(kind, _stream="failure", **fields)
+
+    # ------------------------------------------------------------- #
+    # fleet signals
+    # ------------------------------------------------------------- #
+
+    def health(self):
+        """Worst SLO health across serving replicas ("breach" when
+        nothing is up: a fleet with no capacity is past degraded)."""
+        states = [r.health() for r in self.replicas if r.state == UP]
+        if not states:
+            return "breach"
+        return max(states, key=lambda s: _LEVEL.get(s, 2))
+
+    def queue_pressure(self):
+        """Aggregate queue fill fraction across serving replicas
+        (1.0 with nothing up — no capacity IS full)."""
+        depth = cap = 0
+        for r in self.replicas:
+            if r.state == UP:
+                depth += r.queue_depth
+                cap += r.engine.queue_limit
+        return (depth / cap) if cap else 1.0
+
+    @property
+    def pending(self):
+        """Submitted requests not yet retired (router-held + on
+        replicas)."""
+        return sum(1 for rt in self._routed.values() if not rt.done)
+
+    def _all_terminal(self):
+        return all(r.terminal for r in self.replicas)
+
+    # ------------------------------------------------------------- #
+    # circuit breaker
+    # ------------------------------------------------------------- #
+
+    def _breaker_allows(self, idx, now):
+        b = self._breaker[idx]
+        if b["state"] == "closed":
+            return True
+        if b["state"] == "open":
+            if now >= b["open_until"]:
+                b["state"] = "half_open"
+                b["probe"] = None
+                self._event("router_breaker", replica=idx,
+                            state="half_open")
+                return True
+            return False
+        # half_open: exactly one outstanding probe
+        return b["probe"] is None
+
+    def _breaker_failure(self, idx, now):
+        b = self._breaker[idx]
+        b["failures"] += 1
+        b["probe"] = None
+        if b["failures"] >= self.breaker_threshold:
+            # exponential cooldown in the number of EXTRA failures: a
+            # replica that keeps dying backs out of rotation for longer
+            cool = self.breaker_cooldown * 2 ** (
+                b["failures"] - self.breaker_threshold)
+            b["open_until"] = now + cool
+            if b["state"] != "open":
+                b["opens"] += 1
+            b["state"] = "open"
+            self._event("router_breaker", replica=idx, state="open",
+                        failures=b["failures"],
+                        cooldown_s=round(cool, 3))
+
+    def _breaker_success(self, idx, rid):
+        b = self._breaker[idx]
+        if b["state"] == "half_open" and b["probe"] == rid:
+            b["state"] = "closed"
+            b["failures"] = 0
+            b["probe"] = None
+            self._event("router_breaker", replica=idx, state="closed")
+        elif b["state"] == "closed":
+            b["failures"] = 0   # consecutive-failure semantics
+
+    # ------------------------------------------------------------- #
+    # placement
+    # ------------------------------------------------------------- #
+
+    def _score(self, r):
+        """Health-weighted inverse-load score (higher = better)."""
+        w = _HEALTH_W.get(r.health(), 0.25)
+        return w / (1.0 + r.queue_depth + r.live)
+
+    def _candidates(self, routed, now):
+        """Routable replicas, best first; the session's home replica
+        (stable hash) leads when affinity applies and it is routable."""
+        cands = [r for r in self.replicas
+                 if r.state == UP and self._breaker_allows(r.index, now)]
+        cands.sort(key=lambda r: (-self._score(r), r.index))
+        sid = routed.request.session_id
+        if self.session_affinity and sid is not None and cands:
+            home = _session_hash(sid, len(self.replicas))
+            for i, r in enumerate(cands):
+                if r.index == home:
+                    cands.insert(0, cands.pop(i))
+                    break
+        return cands
+
+    def _place(self, routed, now):
+        """Try to put the request on a replica (best candidate first);
+        returns True on success.  Emits router_route (first placement)
+        or router_hop (requeue) and credits the hop's wall time to the
+        peer engine's lifecycle tracker."""
+        req = routed.request
+        rid = req.request_id
+        for r in self._candidates(routed, now):
+            try:
+                r.submit(req)
+            except QueueFull:
+                self._note_reject(r.index)
+                continue
+            self._reject_streak[r.index] = 0
+            self._placed[r.index] += 1
+            b = self._breaker[r.index]
+            if b["state"] == "half_open" and b["probe"] is None:
+                b["probe"] = rid
+            sid = req.session_id
+            affinity = None
+            if self.session_affinity and sid is not None:
+                last = self._session_last.get(sid)
+                affinity = "hit" if last in (None, r.index) else "miss"
+                if affinity == "miss":
+                    # the session's warm prefix blocks live elsewhere:
+                    # this placement pays the cold prefill
+                    self.prefix_misses += 1
+                    telemetry.inc("router.prefix_miss")
+                self._session_last[sid] = r.index
+            self._assigned[r.index][rid] = None
+            if routed.hops:
+                hop_ms = (now - (routed.t_assigned
+                                 if routed.t_assigned is not None
+                                 else routed.t_submit)) * 1e3
+                r.engine.metrics.lc_hop(rid, hop_ms)
+                self._event("router_hop", request=rid,
+                            to_replica=r.index,
+                            from_replica=routed.prev_replica,
+                            hop=routed.hops, hop_ms=round(hop_ms, 3))
+            else:
+                self._event("router_route", request=rid,
+                            replica=r.index, slo_class=req.slo_class,
+                            **({"affinity": affinity}
+                               if affinity else {}))
+            routed.replica = r.index
+            routed.t_assigned = now
+            return True
+        return False
+
+    def _note_reject(self, idx):
+        """Per-replica QueueFull streak -> one flight dump per storm
+        (the engine-global storm detector cannot tell WHICH replica is
+        drowning in a fleet)."""
+        self._rejects[idx] += 1
+        self._reject_streak[idx] += 1
+        if self._reject_streak[idx] == _STORM_REJECTS:
+            flight.RECORDER.dump(
+                "replica_queue_storm", replica=idx,
+                rejects=self._reject_streak[idx],
+                pressure=round(self.queue_pressure(), 4))
+
+    # ------------------------------------------------------------- #
+    # shedding
+    # ------------------------------------------------------------- #
+
+    def _should_shed(self, slo_class):
+        """Throughput-class traffic sheds first: under queue pressure
+        or an SLO breach anywhere in the fleet, rejecting the traffic
+        that only cares about aggregate tokens is what keeps
+        latency-class TTFT inside budget.  Latency-class requests are
+        only ever refused by hard QueueFull."""
+        if slo_class == "latency":
+            return False
+        if self.queue_pressure() >= self.shed_queue:
+            return True
+        return self.shed_on_slo and self.health() == "breach"
+
+    # ------------------------------------------------------------- #
+    # the public surface (mirrors ServingEngine)
+    # ------------------------------------------------------------- #
+
+    def submit(self, request):
+        """Route one Request into the fleet.  Raises :class:`RouterShed`
+        (a QueueFull) when its SLO class is being shed, plain QueueFull
+        when every routable replica's queue is at capacity
+        (backpressure propagated up), ValueError when it can never fit,
+        RuntimeError when the whole fleet is terminally dead."""
+        req = request
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.s_max:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the "
+                f"fleet's S_max {self.s_max}")
+        if self._all_terminal():
+            raise RuntimeError(
+                "fleet terminal: every replica's restart budget is "
+                "spent")
+        now = time.perf_counter()
+        if self._should_shed(req.slo_class):
+            self.shed += 1
+            self.shed_by_class[req.slo_class] += 1
+            self._event("router_shed", request=req.request_id,
+                        slo_class=req.slo_class,
+                        pressure=round(self.queue_pressure(), 4),
+                        health=self.health())
+            raise RouterShed(
+                f"shedding {req.slo_class}-class traffic "
+                f"(pressure {self.queue_pressure():.2f}, "
+                f"health {self.health()})")
+        routed = _Routed(req, now)
+        if not self._place(routed, now):
+            raise QueueFull(
+                "every routable replica's queue is at capacity")
+        self._routed[req.request_id] = routed
+        self.submitted += 1
+        return req
+
+    def step(self):
+        """One fleet iteration: respawn due replicas, detect wedges by
+        stale heartbeat, drain + requeue corpses, place router-held
+        requests, then advance every serving replica one scheduler
+        step.  Returns the Results that retired this iteration."""
+        now = time.perf_counter()
+        for r in self.replicas:
+            r.maybe_respawn(now)
+        if self.stale > 0:
+            for r in self.replicas:
+                if r.alive and r.stale(self.stale, now):
+                    # wedged: alive but silent — the mid-run hang.  Kill
+                    # it so the death path (drain/requeue/respawn) takes
+                    # over, like the launcher's HETU_LIVENESS_STALE.
+                    self._fail_event(
+                        "replica_wedged_kill", replica=r.index,
+                        age_s=round(now - r.last_beat, 3))
+                    r.die(rc=-9, error="stale heartbeat")
+        for r in self.replicas:
+            if r.state == DEAD and not r.drained:
+                self._on_death(r, now)
+        self._flush_pending(now)
+        results = []
+        for r in self.replicas:
+            if r.state != UP:
+                continue
+            for res in r.step():
+                out = self._finish(res, r.index)
+                if out is not None:
+                    results.append(out)
+            if r.state == DEAD and not r.drained:
+                # died mid-step: drain NOW so its requests can requeue
+                # within this same router iteration
+                self._on_death(r, time.perf_counter())
+        telemetry.set_gauge("router.pressure",
+                            round(self.queue_pressure(), 4))
+        return results
+
+    def run(self, requests=()):
+        """Submit ``requests`` (stepping through backpressure) then
+        step until everything retires; returns {request_id: Result}.
+        Shed requests are recorded and dropped — the caller reads
+        ``snapshot()['shed']`` — and never appear in the output."""
+        out = {}
+        for req in requests:
+            while True:
+                try:
+                    self.submit(req)
+                    break
+                except RouterShed:
+                    break
+                except QueueFull:
+                    for res in self.step():
+                        out[res.request_id] = res
+        while self.pending:
+            for res in self.step():
+                out[res.request_id] = res
+        return out
+
+    # ------------------------------------------------------------- #
+    # failure handling
+    # ------------------------------------------------------------- #
+
+    def _on_death(self, r, now):
+        """Drain a dead replica from the router's own records: every
+        request it had not retired requeues onto peers (no loss), the
+        breaker notes the failure, and the supervisor schedules the
+        respawn (or goes terminal)."""
+        self._breaker_failure(r.index, now)
+        assigned = self._assigned[r.index]
+        lost = [rid for rid in assigned
+                if not self._routed[rid].done]
+        self._assigned[r.index] = {}
+        for rid in lost:
+            routed = self._routed[rid]
+            routed.hops += 1
+            routed.prev_replica = r.index
+            routed.replica = None
+            routed.next_at = 0.0
+            self.requeued += 1
+            telemetry.inc("router.requeues")
+            self._pending.append(routed)
+        r.drained = True
+        self._fail_event("replica_drain", replica=r.index,
+                         requeued=len(lost), rc=r.exit_code)
+        r.schedule_restart(now)
+
+    def _flush_pending(self, now):
+        """Place router-held requests (requeued off corpses or backed
+        off): deadline-expire, honor retry backoff, and give up —
+        terminally, with a flight dump — only past the retry budget."""
+        still = collections.deque()
+        while self._pending:
+            routed = self._pending.popleft()
+            if routed.done:
+                continue
+            req = routed.request
+            waited = now - routed.t_submit
+            if req.deadline_s is not None and waited > req.deadline_s:
+                routed.done = True
+                self.expired += 1
+                self._event("router_deadline", request=req.request_id,
+                            waited_s=round(waited, 3),
+                            deadline_s=req.deadline_s,
+                            slo_class=req.slo_class)
+                continue
+            if now < routed.next_at:
+                still.append(routed)
+                continue
+            if self._place(routed, now):
+                continue
+            routed.retries += 1
+            if routed.retries > self.retry_limit:
+                # router terminal failure for this request: budget
+                # spent with nowhere to put it.  Record loudly.
+                routed.done = True
+                routed.lost = True
+                self.lost += 1
+                self._event("router_retry_exhausted",
+                            request=req.request_id,
+                            retries=routed.retries, hops=routed.hops)
+                flight.RECORDER.dump("router_retry_exhausted",
+                                     request=req.request_id,
+                                     retries=routed.retries)
+                continue
+            routed.next_at = now + self.retry_backoff * 2 ** (
+                routed.retries - 1)
+            still.append(routed)
+        self._pending = still
+
+    def _finish(self, res, idx):
+        """Bookkeeping for one retired Result; returns it, or None for
+        a duplicate (a request must retire exactly once fleet-wide)."""
+        routed = self._routed.get(res.request_id)
+        if routed is None:
+            return res           # not router-managed (direct submit)
+        if routed.done:
+            self.duplicates += 1
+            return None
+        routed.done = True
+        routed.result = res
+        self._assigned[idx].pop(res.request_id, None)
+        self.finished += 1
+        now = time.perf_counter()
+        self._lat.append(now - routed.t_submit)
+        req = routed.request
+        if req.first_token_at is not None:
+            # fleet-clock TTFT: router submit -> first token, hops and
+            # requeues included (the engine's ttft_s restarts per hop)
+            ttft = req.first_token_at - routed.t_submit
+            self._ttft.append(ttft)
+            self._ttft_by_class[req.slo_class].append(ttft)
+        self._breaker_success(idx, res.request_id)
+        return res
+
+    # ------------------------------------------------------------- #
+
+    def snapshot(self):
+        """JSON-able fleet view: routing/shedding/requeue counters,
+        fleet-clock latency percentiles (per SLO class too), and a row
+        per replica (state, health, load, breaker, restarts)."""
+        pct = telemetry.percentile
+
+        def _p(xs, q):
+            v = pct(list(xs), q) if xs else None
+            return round(v, 6) if v is not None else None
+
+        classes = {}
+        for cls, xs in self._ttft_by_class.items():
+            classes[cls] = {
+                "finished": len(xs),
+                "shed": self.shed_by_class[cls],
+                "ttft_p50_s": _p(xs, 50),
+                "ttft_p95_s": _p(xs, 95),
+                "ttft_p99_s": _p(xs, 99),
+            }
+        rows = []
+        for r in self.replicas:
+            row = r.snapshot()
+            b = self._breaker[r.index]
+            row["breaker"] = b["state"]
+            row["breaker_opens"] = b["opens"]
+            row["routed"] = self._placed[r.index]
+            row["rejects"] = self._rejects[r.index]
+            rows.append(row)
+        return {
+            "replicas": rows,
+            "health": self.health(),
+            "queue_pressure": round(self.queue_pressure(), 4),
+            "submitted": self.submitted,
+            "finished": self.finished,
+            "pending": self.pending,
+            "shed": self.shed,
+            "requeued": self.requeued,
+            "expired": self.expired,
+            "lost": self.lost,
+            "duplicates": self.duplicates,
+            "prefix_misses": self.prefix_misses,
+            "latency_p50_s": _p(self._lat, 50),
+            "latency_p95_s": _p(self._lat, 95),
+            "latency_p99_s": _p(self._lat, 99),
+            "ttft_p50_s": _p(self._ttft, 50),
+            "ttft_p95_s": _p(self._ttft, 95),
+            "ttft_p99_s": _p(self._ttft, 99),
+            "classes": classes,
+        }

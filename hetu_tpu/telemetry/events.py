@@ -85,6 +85,21 @@ REQUIRED_FIELDS = {
     # SLO monitor (telemetry/slo.py)
     "slo_violation": ("slo", "value", "target"),
     "slo_health": ("state",),
+    # serving fleet: supervised replicas (failure stream; ISSUE 8)
+    "replica_start": ("replica",),
+    "replica_exit": ("replica", "rc"),
+    "replica_restart_scheduled": ("replica", "attempt"),
+    "replica_restart": ("replica", "attempt"),
+    "replica_failed": ("replica", "rc"),
+    "replica_wedged_kill": ("replica",),
+    "replica_drain": ("replica", "requeued"),
+    # serving fleet: router request path (serve stream; ISSUE 8)
+    "router_route": ("request", "replica"),
+    "router_hop": ("request", "to_replica"),
+    "router_shed": ("request", "slo_class"),
+    "router_breaker": ("replica", "state"),
+    "router_deadline": ("request",),
+    "router_retry_exhausted": ("request",),
     # flight recorder dump header (telemetry/flight.py)
     "flight_dump": ("reason",),
     # telemetry core + bench

@@ -18,6 +18,13 @@ final state — the "what was it doing" companion to the flight
 recorder's "what happened".  ``--once`` renders a single frame and
 exits (scripts, tests); otherwise the screen refreshes every
 ``--interval`` seconds until Ctrl-C.
+
+``--fleet`` switches to the ServingRouter view: one row per replica
+(state/health, occupancy, queue depth, breaker state, routed/requeue/
+reject/death counts) assembled from the ``replica``-tagged serve
+events plus the router's ``router_route``/``router_hop``/
+``router_breaker`` and the supervisor's ``replica_*`` failure records,
+with fleet totals (shed by class, requeues, pressure) underneath.
 """
 
 from __future__ import annotations
@@ -109,6 +116,113 @@ def summarize(events, window=512):
     }
 
 
+def summarize_fleet(events, window=4096):
+    """Per-replica dashboard rows from a merged fleet stream: serve
+    events tagged ``replica=<k>`` (each router replica's engine stamps
+    its records), router placement/breaker events, and the
+    supervisor's replica_* failure records."""
+    events = events[-window:] if window else events
+    per = {}
+
+    def row(k):
+        return per.setdefault(k, {
+            "replica": k, "state": "up", "health": "ok",
+            "live": None, "slots": None, "queue_depth": None,
+            "steps": 0, "breaker": "closed", "routed": 0,
+            "requeued": 0, "rejects": 0, "deaths": 0, "restarts": 0,
+            "finished": 0,
+        })
+
+    shed = {"latency": 0, "throughput": 0}
+    hops = 0
+    pressure = None
+    for e in events:
+        kind = e.get("event")
+        rep = e.get("replica")
+        if kind == "serve_step" and rep is not None:
+            r = row(rep)
+            r["live"] = e.get("live")
+            r["slots"] = e.get("slots")
+            r["queue_depth"] = e.get("queue_depth")
+            r["steps"] += 1
+        elif kind == "slo_health" and rep is not None:
+            row(rep)["health"] = e.get("state")
+        elif kind == "serve_finish" and rep is not None:
+            row(rep)["finished"] += 1
+        elif kind == "serve_queue_reject" and rep is not None:
+            row(rep)["rejects"] += 1
+        elif kind == "router_route" and rep is not None:
+            row(rep)["routed"] += 1
+        elif kind == "router_hop":
+            hops += 1
+            to = e.get("to_replica")
+            if to is not None:
+                r = row(to)
+                r["routed"] += 1
+                r["requeued"] += 1
+        elif kind == "router_breaker" and rep is not None:
+            row(rep)["breaker"] = e.get("state")
+        elif kind == "router_shed":
+            cls = e.get("slo_class")
+            if cls in shed:
+                shed[cls] += 1
+        elif kind == "replica_start" and rep is not None:
+            row(rep)["state"] = "up"
+        elif kind == "replica_exit" and rep is not None:
+            r = row(rep)
+            r["deaths"] += 1
+            r["state"] = "dead"
+        elif kind == "replica_restart" and rep is not None:
+            r = row(rep)
+            r["restarts"] = e.get("attempt", r["restarts"] + 1)
+            r["state"] = "up"
+        elif kind == "replica_failed" and rep is not None:
+            row(rep)["state"] = "failed"
+        elif kind == "gauge" and e.get("name") == "router.pressure":
+            pressure = e.get("value")
+    for r in per.values():
+        if isinstance(r["live"], int) and isinstance(r["slots"], int) \
+                and r["slots"]:
+            r["occupancy"] = round(r["live"] / r["slots"], 4)
+        else:
+            r["occupancy"] = None
+    return {
+        "records": len(events),
+        "replicas": [per[k] for k in sorted(per)],
+        "shed": shed,
+        "requeues": hops,
+        "pressure": pressure,
+    }
+
+
+def render_fleet(stats, clock=None):
+    """One fleet frame as a string: a row per replica + fleet totals."""
+    lines = [
+        f"hetu_top --fleet — "
+        f"{time.strftime('%H:%M:%S', time.gmtime(clock))} UTC"
+        f"  ({stats['records']} records)",
+        "-" * 72,
+        f"{'rep':>3} {'state':<7} {'health':<9} {'occ':>5} "
+        f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
+        f"{'requeued':>8} {'rejects':>7} {'deaths':>6}",
+    ]
+    for r in stats["replicas"]:
+        lines.append(
+            f"{r['replica']:>3} {r['state']:<7} {str(r['health']):<9} "
+            f"{_fmt(r['occupancy'], nd=2):>5} {_fmt(r['live']):>4} "
+            f"{_fmt(r['queue_depth']):>5} {r['breaker']:<9} "
+            f"{r['routed']:>6} {r['requeued']:>8} {r['rejects']:>7} "
+            f"{r['deaths']:>6}")
+    shed = stats["shed"]
+    lines.append("-" * 72)
+    lines.append(
+        f"fleet     requeues {stats['requeues']}"
+        f"  shed latency {shed['latency']}"
+        f" / throughput {shed['throughput']}"
+        f"  pressure {_fmt(stats['pressure'], nd=2)}")
+    return "\n".join(lines)
+
+
 def _fmt(v, suffix="", nd=1):
     if v is None:
         return "-"
@@ -167,6 +281,10 @@ def main(argv=None):
                     help="render one frame and exit (scripts/tests)")
     ap.add_argument("--window", type=int, default=512, metavar="N",
                     help="newest N records the frame is computed over")
+    ap.add_argument("--fleet", action="store_true",
+                    help="per-replica rows for a ServingRouter fleet "
+                         "(state, health, occupancy, queue, breaker, "
+                         "routed/requeue/reject/death counts)")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()
@@ -174,8 +292,13 @@ def main(argv=None):
         ap.error("no paths given and no HETU_*_LOG configured")
     while True:
         events, _bad = read_events(paths)
-        frame = render(summarize(events, window=args.window),
-                       clock=time.time())
+        if args.fleet:
+            frame = render_fleet(
+                summarize_fleet(events, window=max(args.window, 4096)),
+                clock=time.time())
+        else:
+            frame = render(summarize(events, window=args.window),
+                           clock=time.time())
         if args.once:
             print(frame)
             return 0

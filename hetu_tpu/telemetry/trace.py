@@ -33,9 +33,12 @@ backdates their start by the duration field.
 ``--check`` validates every record against the event contract AND the
 span-balance rule: every ``serve_admit`` must have a matching
 ``serve_finish`` (a request admitted but never retired is a leaked
-slot or a crashed scheduler loop).  Balance is skipped when the input
-contains a ``flight_dump`` header — a flight recording is by
-definition a mid-flight snapshot.
+slot or a crashed scheduler loop).  Fleet streams (``replica``-tagged
+serve events from a ServingRouter) additionally pair admit/finish PER
+REPLICA, with requests the router requeued off a dead replica
+(``router_hop`` records) exempt — they must finish on *some* replica.
+Balance is skipped when the input contains a ``flight_dump`` header —
+a flight recording is by definition a mid-flight snapshot.
 """
 
 from __future__ import annotations
@@ -207,23 +210,48 @@ def check_span_balance(events):
     with a ``serve_finish`` for the same request id (and vice versa —
     a finish with no admit is a torn or miswired log).  Returns problem
     strings; empty on a balanced stream.  A stream containing a
-    ``flight_dump`` header is a mid-flight snapshot and is exempt."""
+    ``flight_dump`` header is a mid-flight snapshot and is exempt.
+
+    Fleet streams (serve events tagged ``replica=<k>`` by the router's
+    engines) are checked per replica too: an admit on replica k must
+    finish ON replica k — a leaked slot on one replica is invisible to
+    the set-based rule once a same-id request retires elsewhere —
+    UNLESS a ``router_hop`` record shows the router requeued the
+    request off a dead replica, in which case finishing on *some*
+    replica is the contract (requeue hops are exempt from the
+    per-replica pairing, like flight dumps are from the whole rule)."""
     if any(e.get("event") == "flight_dump" for e in events):
         return []
-    admits, finishes = set(), set()
+    admits, finishes = {}, {}     # request id -> set of replica tags
+    hopped = set()                # requests the router requeued
     for e in events:
         kind = e.get("event")
         if kind == "serve_admit":
-            admits.add(e.get("request"))
+            admits.setdefault(e.get("request"), set()).add(
+                e.get("replica"))
         elif kind == "serve_finish":
-            finishes.add(e.get("request"))
+            finishes.setdefault(e.get("request"), set()).add(
+                e.get("replica"))
+        elif kind == "router_hop":
+            hopped.add(e.get("request"))
     problems = []
-    for rid in sorted(str(r) for r in admits - finishes):
+    for rid in sorted(str(r) for r in set(admits) - set(finishes)):
         problems.append(f"span-balance: request {rid!r} admitted but "
                         f"never finished/retired")
-    for rid in sorted(str(r) for r in finishes - admits):
+    for rid in sorted(str(r) for r in set(finishes) - set(admits)):
         problems.append(f"span-balance: request {rid!r} finished "
                         f"without a matching admit")
+    for rid in sorted(admits, key=str):
+        if rid not in finishes or rid in hopped:
+            continue
+        for rep in sorted(admits[rid] - finishes[rid],
+                          key=lambda x: str(x)):
+            if rep is None:
+                continue   # untagged single-engine stream: set rule
+            problems.append(
+                f"span-balance: request {rid!r} admitted on replica "
+                f"{rep} but finished elsewhere with no router_hop "
+                f"(leaked slot?)")
     return problems
 
 

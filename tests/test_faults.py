@@ -119,6 +119,63 @@ class TestFaultPlan:
 
 
 @pytest.mark.smoke
+class TestReplicaScopedChaos:
+    """The serving-fleet seam additions (ISSUE 8): wedge events, the
+    explicit draw(role=...) override for seams hosting several roles in
+    one process, and inline kills that return instead of SIGKILLing the
+    whole fleet."""
+
+    def test_wedge_spec_parse(self):
+        p = FaultPlan.from_spec("seed=2,wedge=3,role=replica0")
+        assert p.wedge == 3 and p.role == "replica0"
+
+    def test_wedge_fires_only_at_optin_seams(self):
+        """A transport (no "wedge" in kinds) walks straight past the
+        wedge position; a replica step seam draws it exactly once."""
+        p1 = FaultPlan(seed=0, wedge=2)
+        kinds = [p1.draw(kinds=("drop",)).kind for _ in range(5)]
+        assert "wedge" not in kinds and p1.fired["wedge"] == 0
+        p2 = FaultPlan(seed=0, wedge=2)
+        kinds = [p2.draw(kinds=("kill", "wedge")).kind
+                 for _ in range(5)]
+        assert kinds[1] == "wedge" and kinds.count("wedge") == 1
+        assert p2.fired["wedge"] == 1
+
+    def test_explicit_role_overrides_env(self, monkeypatch):
+        """draw(role=...) gates the plan per call — the fleet's
+        replicas share one process, so HETU_CHAOS_ROLE cannot tell
+        them apart."""
+        monkeypatch.setenv("HETU_CHAOS_ROLE", "replica1")
+        p = FaultPlan(seed=0, drop=1.0, role="replica0")
+        assert p.draw().kind == "none"               # env role: no match
+        assert p.draw(role="replica0").kind == "drop"   # explicit: fires
+        assert p.draw(role="replica1").kind == "none"
+
+    def test_nonmatching_role_never_advances_counter(self):
+        """Each replica's step stream is independently deterministic:
+        other replicas' draws must not consume positions."""
+        p = FaultPlan(seed=9, kill=2, role="replica1")
+        for _ in range(10):   # replica0 hammers the plan — inert
+            assert p.draw(role="replica0", kinds=("kill", "wedge"),
+                          inline=True).kind == "none"
+        assert p._n == 0
+        # replica1's own 2nd step is still the kill
+        assert p.draw(role="replica1", kinds=("kill",),
+                      inline=True).kind == "none"
+        assert p.draw(role="replica1", kinds=("kill",),
+                      inline=True).kind == "kill"
+
+    def test_inline_kill_returns_instead_of_sigkill(self):
+        """inline=True hands the death to the caller (the replica
+        harness) — the test process surviving IS the assertion."""
+        p = FaultPlan(seed=0, kill=1)
+        f = p.draw(kinds=("kill",), inline=True)
+        assert f.kind == "kill" and p.fired["kill"] == 1
+        # one-shot: the position is consumed
+        assert p.draw(kinds=("kill",), inline=True).kind == "none"
+
+
+@pytest.mark.smoke
 class TestChaosLocalTier:
     def test_local_transport_drops_retry_exactly_once(self, monkeypatch):
         """In-process tier under loss: every push applies exactly once

@@ -1,0 +1,506 @@
+"""Serving fleet resilience (ISSUE 8 tentpole): the multi-replica
+router over supervised engines.
+
+The acceptance spine: a seeded ``HETU_CHAOS`` kill of one replica in an
+N=2 fleet loses ZERO requests — everything the corpse held requeues to
+the peer and retires exactly once, token-identical to offline
+``generate_fast`` (outputs are a pure function of the Request), with
+``router_hop`` attribution in the peer's ``ServingMetrics.snapshot()``,
+contract-valid failure events and a flight dump on the killed replica,
+and a span-balanced serve stream.  Around it: health-aware routing,
+session affinity + remap prefix-miss counting, the per-replica circuit
+breaker (ejection, half-open probe readmission), wedge detection by
+stale heartbeat, SLO-class load shedding (throughput first,
+latency-class TTFT inside the configured SLO), QueueFull backpressure
+propagation, deadlines, retry exhaustion as a terminal failure, the
+extended span-balance rule, and ``hetu_top --fleet``.
+
+All CPU-harness, all smoke-tier (the engines are tiny random-weight
+GPTs — the fleet's contract is scheduling and recovery, not model
+quality).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht  # noqa: F401  (platform forcing + compat shims)
+from hetu_tpu import telemetry
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.models.gpt_decode import generate_fast
+from hetu_tpu.ps import faults
+from hetu_tpu.serving import (
+    QueueFull, Request, RouterShed, ServingEngine, ServingRouter, SLO,
+)
+from hetu_tpu.serving.router import _session_hash
+from hetu_tpu.telemetry import top
+from hetu_tpu.telemetry.trace import check_span_balance, read_events
+
+pytestmark = pytest.mark.smoke
+
+
+def _rand_gpt(name="fl", L=2, H=2, Dh=8, V=61, S=32, seed=0):
+    """Deterministic random params in generate_fast's naming contract."""
+    rng = np.random.RandomState(seed)
+    hd = H * Dh
+    p = {f"{name}_wte_table": rng.randn(V, hd) * 0.05,
+         f"{name}_wpe": rng.randn(S, hd) * 0.05,
+         f"{name}_ln_f_scale": np.ones(hd),
+         f"{name}_ln_f_bias": np.zeros(hd)}
+    for i in range(L):
+        us = f"{name}_h{i}"
+        for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+                       ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+                       ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+            p[f"{us}_{w}_weight"] = rng.randn(*shp) * 0.05
+            p[f"{us}_{w}_bias"] = np.zeros(shp[1])
+        for ln in ("ln1", "ln2"):
+            p[f"{us}_{ln}_scale"] = np.ones(hd)
+            p[f"{us}_{ln}_bias"] = np.zeros(hd)
+    cfg = GPTConfig(vocab_size=V, hidden_size=hd, num_hidden_layers=L,
+                    num_attention_heads=H, max_position_embeddings=S,
+                    batch_size=1, seq_len=S, dropout_rate=0.0)
+    return p, cfg
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _rand_gpt()
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("HETU_TELEMETRY", "1")
+    monkeypatch.delenv("HETU_CHAOS", raising=False)
+    faults.reset_plans()
+    telemetry.reset()
+    yield
+    faults.reset_plans()
+    telemetry.reset()
+
+
+def _factory(model, **kw):
+    p, cfg = model
+    kw.setdefault("slots", 2)
+    kw.setdefault("queue_limit", 16)
+    kw.setdefault("fast_path", False)
+    return lambda i: ServingEngine(p, cfg, **kw)
+
+
+def _trace(n=6, seed=7, vocab=61, s_max=32):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        P = int(rng.randint(1, 5))
+        out.append(([int(t) for t in rng.randint(0, vocab, P)],
+                    int(rng.randint(3, 9))))
+    return out
+
+
+def _offline(model, req):
+    p, cfg = model
+    return generate_fast(p, cfg, [req.prompt],
+                         num_tokens=req.max_new_tokens)[0].tolist()
+
+
+# --------------------------------------------------------------------- #
+# routing + affinity units
+# --------------------------------------------------------------------- #
+
+class TestRouting:
+    def test_fleet_matches_offline_and_spreads_load(self, model):
+        """Results are per-request identical to the offline path and
+        every replica takes traffic (health-weighted placement prefers
+        the idler replica as queues build)."""
+        router = ServingRouter(_factory(model), replicas=2)
+        reqs = [Request(prompt=pr, max_new_tokens=n)
+                for pr, n in _trace(8)]
+        res = router.run(reqs)
+        assert len(res) == 8
+        for r in reqs:
+            assert res[r.request_id].tokens.tolist() == \
+                _offline(model, r)
+        snap = router.snapshot()
+        assert snap["finished"] == 8 and snap["lost"] == 0
+        assert all(row["routed"] > 0 for row in snap["replicas"])
+        assert snap["health"] == "ok"
+
+    def test_session_affinity_pins_home_replica(self, model):
+        """All of one session's requests land on its stable-hash home
+        replica while it is routable."""
+        router = ServingRouter(_factory(model), replicas=2,
+                               session_affinity=True)
+        home = _session_hash("user-42", 2)
+        for _ in range(4):
+            router.submit(Request(prompt=[3, 4], max_new_tokens=3,
+                                  session_id="user-42"))
+        assert router._session_last["user-42"] == home
+        assert router._placed[home] == 4
+        assert router.prefix_misses == 0
+        router.run()
+
+    def test_affinity_remap_counts_prefix_miss(self, model):
+        """The home replica is down: the session is remapped to a peer
+        and the cold start is counted (prefix_misses)."""
+        router = ServingRouter(_factory(model), replicas=2,
+                               restart_backoff=5.0)
+        home = _session_hash("sess", 2)
+        router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                              session_id="sess"))
+        router.run()
+        router.replicas[home].die(rc=1, error="test")
+        router.step()   # drain + schedule respawn (long backoff)
+        router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                              session_id="sess"))
+        assert router._session_last["sess"] != home
+        assert router.prefix_misses == 1
+        router.run()
+
+    def test_submit_rejects_impossible_request(self, model):
+        router = ServingRouter(_factory(model), replicas=1)
+        with pytest.raises(ValueError):
+            router.submit(Request(prompt=[1] * 30, max_new_tokens=10))
+
+    def test_env_replica_count(self, model, monkeypatch):
+        monkeypatch.setenv("HETU_REPLICAS", "3")
+        router = ServingRouter(_factory(model))
+        assert len(router.replicas) == 3
+
+
+# --------------------------------------------------------------------- #
+# circuit breaker
+# --------------------------------------------------------------------- #
+
+class TestCircuitBreaker:
+    def test_open_half_open_close_cycle(self, model):
+        """Consecutive-failure ejection, cooldown, half-open probe,
+        readmission on the probe's retirement."""
+        router = ServingRouter(_factory(model), replicas=2,
+                               breaker_threshold=1,
+                               breaker_cooldown=0.05,
+                               restart_backoff=0.0)
+        router.replicas[1].die(rc=1, error="test")
+        router.step()
+        assert router._breaker[1]["state"] == "open"
+        assert not router._breaker_allows(1, time.perf_counter())
+        time.sleep(0.06)
+        router.step()   # respawn happened; breaker cooled down
+        assert router._breaker_allows(1, time.perf_counter())
+        assert router._breaker[1]["state"] == "half_open"
+        # force the probe onto replica 1 by saturating replica 0
+        for _ in range(router.replicas[0].engine.queue_limit):
+            router.replicas[0].engine.submit(
+                Request(prompt=[9], max_new_tokens=1))
+        probe = Request(prompt=[5, 6], max_new_tokens=3)
+        router.submit(probe)
+        assert router._breaker[1]["probe"] == probe.request_id
+        router.run()
+        assert router._breaker[1]["state"] == "closed"
+        kinds = [e.get("event") for e in telemetry.get_sink().recent()]
+        assert "router_breaker" in kinds
+
+    def test_open_breaker_ejects_from_routing(self, model):
+        """While open, a healthy-looking replica takes no traffic."""
+        router = ServingRouter(_factory(model), replicas=2,
+                               breaker_threshold=1,
+                               breaker_cooldown=30.0,
+                               restart_backoff=0.0)
+        router.replicas[1].die(rc=1, error="test")
+        router.step()          # drain + respawn scheduling
+        router.step()          # respawn (zero backoff)
+        assert router.replicas[1].state == "up"
+        for _ in range(4):
+            router.submit(Request(prompt=[2, 3], max_new_tokens=2))
+        assert router._placed[1] == 0     # breaker holds it out
+        router.run()
+
+
+# --------------------------------------------------------------------- #
+# the acceptance spine: seeded chaos kill, zero loss
+# --------------------------------------------------------------------- #
+
+class TestChaosKillIntegration:
+    def test_kill_a_replica_loses_nothing(self, model, tmp_path,
+                                          monkeypatch):
+        """Seeded HETU_CHAOS kills replica 1 mid-trace: every request
+        retires exactly once (requeued, never lost or double-counted),
+        token-identical to offline; the hop is attributed in the peer
+        engine's snapshot; the killed replica leaves contract-valid
+        failure events and a flight dump; the serve stream span-checks
+        clean."""
+        flog = str(tmp_path / "flight.jsonl")
+        slog = str(tmp_path / "serve.jsonl")
+        flg = str(tmp_path / "failure.jsonl")
+        monkeypatch.setenv("HETU_FLIGHT_LOG", flog)
+        monkeypatch.setenv("HETU_SERVE_LOG", slog)
+        monkeypatch.setenv("HETU_FAILURE_LOG", flg)
+        monkeypatch.setenv("HETU_CHAOS", "seed=3,kill=4,role=replica1")
+        faults.reset_plans()
+        router = ServingRouter(_factory(model), replicas=2,
+                               restart_backoff=0.01)
+        reqs = [Request(prompt=pr, max_new_tokens=n)
+                for pr, n in _trace(8, seed=11)]
+        res = router.run(reqs)
+        # supervision continues past the drain: step until the killed
+        # replica's backoff elapses and it respawns
+        deadline = time.time() + 5.0
+        while router.replicas[1].state != "up" and \
+                time.time() < deadline:
+            router.step()
+            time.sleep(0.005)
+        assert router.replicas[1].state == "up"
+        # exactly once, zero loss, deterministic outputs
+        assert len(res) == len(reqs)
+        for r in reqs:
+            assert res[r.request_id].tokens.tolist() == \
+                _offline(model, r), r.request_id
+        snap = router.snapshot()
+        assert snap["requeued"] >= 1
+        assert snap["lost"] == 0 and snap["duplicates"] == 0
+        assert snap["finished"] == len(reqs)
+        assert snap["replicas"][1]["restarts"] == 1
+        # requeue/hop attribution: the peer's lifecycle components
+        comp = router.replicas[0].engine.metrics.snapshot()["components"]
+        assert comp["router_hop_ms"]["p99_ms"] > 0
+        # failure events in the launcher's record shape
+        events, bad = read_events([flg])
+        assert bad == 0
+        kinds = [e["event"] for e in events]
+        assert "replica_exit" in kinds
+        assert "replica_drain" in kinds
+        assert "replica_restart" in kinds
+        for e in events:
+            assert telemetry.validate_record(e) == [], e
+        # the kill's black box: a contract-valid flight dump
+        fevents, fbad = read_events([flog])
+        assert fbad == 0
+        headers = [e for e in fevents if e["event"] == "flight_dump"]
+        assert any(h["reason"] == "replica_chaos_kill" and
+                   h.get("replica") == 1 for h in headers)
+        for e in fevents:
+            assert telemetry.validate_record(e) == [], e
+        # the serve stream balances: every routed admit has a finish on
+        # SOME replica (the hop exemption covers the killed one)
+        sevents, sbad = read_events([slog])
+        assert sbad == 0
+        assert check_span_balance(sevents) == []
+        hops = [e for e in sevents if e["event"] == "router_hop"]
+        assert hops and all(e["to_replica"] == 0 for e in hops)
+
+    def test_wedged_replica_detected_and_drained(self, model,
+                                                 monkeypatch):
+        """A chaos wedge (alive, silent) is caught by the stale
+        heartbeat, killed, drained, and its requests retire on the
+        peer."""
+        monkeypatch.setenv("HETU_CHAOS", "seed=1,wedge=2,role=replica0")
+        faults.reset_plans()
+        router = ServingRouter(_factory(model), replicas=2,
+                               stale=0.05, restart_backoff=0.05)
+        reqs = [Request(prompt=[i + 1, i + 2], max_new_tokens=5)
+                for i in range(6)]
+        res = router.run(reqs)
+        assert len(res) == 6
+        for r in reqs:
+            assert res[r.request_id].tokens.tolist() == \
+                _offline(model, r)
+        kinds = [e.get("event") for e in telemetry.get_sink().recent()]
+        assert "replica_wedged_kill" in kinds
+        assert router.snapshot()["lost"] == 0
+
+
+# --------------------------------------------------------------------- #
+# SLO-class shedding + backpressure + deadlines + terminal failures
+# --------------------------------------------------------------------- #
+
+class TestSheddingAndBackpressure:
+    def test_throughput_sheds_first_latency_inside_slo(self, model):
+        """Synthetic overload (tiny queues): throughput-class traffic
+        is shed while every latency-class request admits and its fleet
+        TTFT p95 stays inside the configured SLO."""
+        slo_ms = 60000.0   # the configured latency SLO (generous: the
+        # CPU harness proves ORDER and bounds, not chip latency)
+        p, cfg = model
+        factory = lambda i: ServingEngine(   # noqa: E731
+            p, cfg, slots=1, queue_limit=2, fast_path=False,
+            slo=[SLO("ttft", "latency", slo_ms)])
+        router = ServingRouter(factory, replicas=2, shed_queue=0.5)
+        lat, thr, shed = [], [], 0
+        for i in range(16):
+            cls = "latency" if i % 4 == 0 else "throughput"
+            req = Request(prompt=[1, 2], max_new_tokens=3,
+                          slo_class=cls)
+            try:
+                router.submit(req)
+                (lat if cls == "latency" else thr).append(req)
+            except RouterShed:
+                shed += 1
+                assert cls == "throughput"   # sheds throughput FIRST
+            except QueueFull:
+                # hard-full backpressure: drain one step and move on
+                router.step()
+        res = router.run()
+        snap = router.snapshot()
+        assert snap["shed"] == shed and shed > 0
+        assert snap["classes"]["latency"]["shed"] == 0
+        assert snap["classes"]["throughput"]["shed"] == shed
+        # every admitted latency-class request finished, inside SLO
+        for r in lat:
+            assert r.request_id in res
+        assert snap["classes"]["latency"]["finished"] == len(lat)
+        assert snap["classes"]["latency"]["ttft_p95_s"] is not None
+        assert snap["classes"]["latency"]["ttft_p95_s"] * 1e3 <= slo_ms
+        shed_events = [e for e in telemetry.get_sink().recent()
+                       if e.get("event") == "router_shed"]
+        assert len(shed_events) == shed
+        assert all(e["slo_class"] == "throughput" for e in shed_events)
+
+    def test_hard_full_propagates_queuefull(self, model):
+        """Latency-class traffic is never shed — at true capacity the
+        replicas' QueueFull propagates up through the router."""
+        p, cfg = model
+        factory = lambda i: ServingEngine(   # noqa: E731
+            p, cfg, slots=1, queue_limit=1, fast_path=False)
+        router = ServingRouter(factory, replicas=2, shed_queue=0.99)
+        with pytest.raises(QueueFull) as ei:
+            for _ in range(8):
+                router.submit(Request(prompt=[1], max_new_tokens=2,
+                                      slo_class="latency"))
+        assert not isinstance(ei.value, RouterShed)
+        router.run()
+
+    def test_deadline_expires_router_held_requests(self, model):
+        """A request the router holds past its deadline expires with a
+        router_deadline event instead of serving uselessly late."""
+        router = ServingRouter(_factory(model), replicas=1,
+                               restart_backoff=30.0)
+        req = Request(prompt=[1, 2], max_new_tokens=4,
+                      deadline_s=0.001)
+        router.submit(req)
+        router.replicas[0].die(rc=1, error="test")
+        time.sleep(0.005)
+        router.step()   # drain -> pending -> deadline check
+        snap = router.snapshot()
+        assert snap["expired"] == 1 and snap["pending"] == 0
+        kinds = [e.get("event") for e in telemetry.get_sink().recent()]
+        assert "router_deadline" in kinds
+
+    def test_retry_exhaustion_is_terminal_with_flight_dump(
+            self, model, tmp_path, monkeypatch):
+        """Nowhere to place a held request past the retry budget: it is
+        declared lost (loudly — event + flight dump), and a terminally
+        dead fleet refuses new submissions."""
+        flog = str(tmp_path / "flight.jsonl")
+        monkeypatch.setenv("HETU_FLIGHT_LOG", flog)
+        router = ServingRouter(_factory(model), replicas=1,
+                               restart_limit=0, retry_limit=1,
+                               retry_backoff=0.001)
+        req = Request(prompt=[1, 2], max_new_tokens=4)
+        router.submit(req)
+        router.replicas[0].die(rc=1, error="test")
+        deadline = time.time() + 5.0
+        while router.pending and time.time() < deadline:
+            router.step()
+            time.sleep(0.002)
+        snap = router.snapshot()
+        assert snap["lost"] == 1 and snap["pending"] == 0
+        assert router.replicas[0].terminal
+        headers = [json.loads(l) for l in open(flog)
+                   if '"flight_dump"' in l]
+        reasons = {h["reason"] for h in headers}
+        assert "router_retry_exhausted" in reasons
+        assert "replica_budget_spent" in reasons
+        with pytest.raises(RuntimeError):
+            router.submit(Request(prompt=[1], max_new_tokens=2))
+
+    def test_per_replica_queue_storm_dumps_flight(self, model,
+                                                  tmp_path,
+                                                  monkeypatch):
+        """Sustained rejection by ONE replica dumps the flight ring
+        with that replica attributed (the engine-global detector can't
+        name the drowning replica)."""
+        flog = str(tmp_path / "storm.jsonl")
+        monkeypatch.setenv("HETU_FLIGHT_LOG", flog)
+        p, cfg = model
+        factory = lambda i: ServingEngine(   # noqa: E731
+            p, cfg, slots=1, queue_limit=1, fast_path=False)
+        router = ServingRouter(factory, replicas=1, shed_queue=2.0)
+        router.submit(Request(prompt=[1], max_new_tokens=8))
+        for _ in range(10):   # streak past the storm threshold (8)
+            with pytest.raises(QueueFull):
+                router.submit(Request(prompt=[3], max_new_tokens=2))
+        headers = [json.loads(l) for l in open(flog)
+                   if '"flight_dump"' in l]
+        assert any(h["reason"] == "replica_queue_storm" and
+                   h.get("replica") == 0 for h in headers)
+        router.run()
+
+
+# --------------------------------------------------------------------- #
+# span balance (fleet rule) + hetu_top --fleet
+# --------------------------------------------------------------------- #
+
+class TestFleetObservability:
+    def _rec(self, kind, **f):
+        return {"t": 1.0, "event": kind, **f}
+
+    def test_span_balance_flags_leaked_replica_admit(self):
+        """An admit on replica 0 that finishes on replica 1 with NO
+        router_hop is a leaked slot; the hop record exempts it."""
+        stream = [self._rec("serve_admit", request="r1", slot=0,
+                            ttft_s=0.1, replica=0),
+                  self._rec("serve_admit", request="r1", slot=0,
+                            ttft_s=0.1, replica=1),
+                  self._rec("serve_finish", request="r1",
+                            reason="length", n_generated=2, replica=1)]
+        problems = check_span_balance(stream)
+        assert len(problems) == 1 and "replica 0" in problems[0]
+        exempt = stream + [self._rec("router_hop", request="r1",
+                                     to_replica=1)]
+        assert check_span_balance(exempt) == []
+
+    def test_span_balance_unfinished_still_fails_fleetwide(self):
+        stream = [self._rec("serve_admit", request="r2", slot=0,
+                            ttft_s=0.1, replica=0),
+                  self._rec("router_hop", request="r2", to_replica=1)]
+        problems = check_span_balance(stream)
+        assert problems and "never finished" in problems[0]
+
+    def test_legacy_untagged_stream_unchanged(self):
+        stream = [self._rec("serve_admit", request="r3", slot=0,
+                            ttft_s=0.1),
+                  self._rec("serve_finish", request="r3",
+                            reason="eos", n_generated=2)]
+        assert check_span_balance(stream) == []
+
+    def test_hetu_top_fleet_rows(self, model, tmp_path, monkeypatch,
+                                 capsys):
+        """--fleet renders one row per replica (health/occupancy/queue/
+        breaker) plus fleet totals from the merged stream alone."""
+        slog = str(tmp_path / "serve.jsonl")
+        flg = str(tmp_path / "failure.jsonl")
+        monkeypatch.setenv("HETU_SERVE_LOG", slog)
+        monkeypatch.setenv("HETU_FAILURE_LOG", flg)
+        router = ServingRouter(_factory(model), replicas=2,
+                               restart_backoff=0.01)
+        reqs = [Request(prompt=pr, max_new_tokens=n)
+                for pr, n in _trace(6, seed=23)]
+        for r in reqs[:4]:
+            router.submit(r)
+        router.replicas[1].die(rc=1, error="test")
+        router.run(reqs[4:])
+        stats = top.summarize_fleet(read_events([slog, flg])[0])
+        rows = {r["replica"]: r for r in stats["replicas"]}
+        assert set(rows) == {0, 1}
+        assert rows[1]["deaths"] == 1
+        assert rows[0]["routed"] > 0
+        assert stats["requeues"] >= 1   # the corpse's requests hopped
+        rc = top.main([slog, flg, "--fleet", "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "hetu_top --fleet" in out
+        assert "breaker" in out and "requeued" in out
+        # per-replica rows present
+        assert "\n  0 " in out and "\n  1 " in out

@@ -364,8 +364,26 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
         pg["contiguous"]["hbm_bytes_per_slot"], pg
     assert pg["paged"]["kv"]["prefix_hits"] > 0
     assert pg["paged"]["kv"]["cow_copies"] > 0
+    # fleet A/B at equal resources (ISSUE 8): greedy parity single
+    # engine vs the 2-replica router, both rates + fleet TTFT p99
+    # recorded live, and the overload run proves the shedding contract
+    # — throughput-class shed first, admitted latency-class TTFT p95
+    # inside the configured SLO
+    fl = art["fleet_ab"]
+    assert fl["provenance"] == "live" and fl["platform"] == "cpu"
+    assert fl["greedy_identical"] is True
+    assert fl["single_engine"]["tokens_per_sec"] > 0
+    assert fl["fleet"]["tokens_per_sec"] > 0
+    assert fl["fleet"]["ttft_p99_s"] is not None
+    assert all(n > 0 for n in fl["fleet"]["routed_per_replica"])
+    ov = fl["overload_shed"]
+    assert ov["shed"] > 0
+    assert ov["shed_by_class"]["latency"] == 0
+    assert ov["shed_by_class"]["throughput"] == ov["shed"]
+    assert ov["latency_within_slo"] is True
     with open(tmp_path / "BENCH_SERVE.json") as f:
         on_disk = json.load(f)
     assert on_disk["continuous"]["tokens_per_sec"] == cont
     assert on_disk["static_baseline"]["tokens_per_sec"] == stat
     assert on_disk["fast_path_ab"]["greedy_identical"] is True
+    assert on_disk["fleet_ab"]["greedy_identical"] is True
