@@ -254,6 +254,18 @@ HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 #     already in the shared compilation cache.
 HETU_BENCH_SERVE=1 run serve 3600 python bench.py
 
+# 4d. quantized-bytes A/Bs of record (ISSUE 9).  The serving half rides
+#     stage 4c's invocation (BENCH_SERVE.json quant_ab: int8 KV vs f32
+#     at equal HBM bytes — peak concurrent slots + tok/s, the
+#     tolerance-gated greedy top-1 check, and the >=1.9x slot-capacity
+#     floor asserted in-bench; on chip the int8 decode kernels run
+#     native instead of interpret mode, making THIS the tok/s number of
+#     record).  This stage measures the training half: int8 PS
+#     push/pull vs the exact f32 wire — bytes via the PR 5
+#     ps.rpc.bytes_* counters + step time, >=3.5x reduction asserted —
+#     merged into BENCH_PS_SCALING.json as its quant_ab section.
+run ps_quant 1800 python examples/ctr/bench_ps_scaling.py --quant-only
+
 # 5. long-context tile tuning: A/B a couple of block shapes at 32k
 for blocks in "512,1024" "1024,1024" "1024,2048" "512,2048"; do
   HETU_BENCH_LC_BLOCKS=$blocks HETU_BENCH_CONFIGS=long_context \

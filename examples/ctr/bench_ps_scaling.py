@@ -108,6 +108,112 @@ def _fan_out(ctx, target, args_for, n):
     return rates
 
 
+def quant_ab(iters=20, dense_shape=(512, 1024), sparse_batch=4096,
+             dim=16, rows=100_000):
+    """Int8 PS wire A/B (ISSUE 9): the SAME dense push/pull and sparse
+    sd_pushpull traffic against one TCP PSServer, exact f32 vs
+    ``HETU_PS_QUANT=int8``, measured by the PR 5 per-shard
+    ``ps.rpc.bytes_sent/recv`` counters — the artifact records the wire
+    bytes, the reduction ratio (acceptance floor 3.5x, ASSERTED), the
+    ``ps.rpc.bytes_saved`` counter, and wall time per round trip.
+    Returns the ``quant_ab`` dict merged into BENCH_PS_SCALING.json."""
+    from hetu_tpu import envvars, quant, telemetry
+    from hetu_tpu.ps.client import PSClient, _TCPTransport
+
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    srv = ctx.Process(target=_serve, args=(port,), daemon=True)
+    srv.start()
+    _wait(port)
+    rng = np.random.RandomState(7)
+    dense_grad = rng.randn(*dense_shape).astype(np.float32)
+    ids = ((rng.zipf(1.05, size=(iters, sparse_batch)) - 1) % rows)
+    sparse_rows = rng.randn(sparse_batch, dim).astype(np.float32)
+
+    def measure(mode):
+        old = envvars.get_raw("HETU_PS_QUANT")
+        if mode:
+            os.environ["HETU_PS_QUANT"] = mode
+        else:
+            os.environ.pop("HETU_PS_QUANT", None)
+        telemetry.reset()
+        c = PSClient(transport=_TCPTransport("127.0.0.1", port))
+        try:
+            key = f"qab_{mode or 'off'}"
+            c.param_set(key, np.zeros(dense_shape, np.float32),
+                        opt="sgd", opt_args={"learning_rate": 0.01})
+            c.param_set(key + "_emb", np.zeros((rows, dim), np.float32),
+                        opt="sgd", opt_args={"learning_rate": 0.01})
+            c.push(key, dense_grad)          # warm the connection
+            telemetry.reset()
+            t0 = time.perf_counter()
+            for i in range(iters):
+                c.push(key, dense_grad)
+                c.pull(key)
+                c.sd_pushpull(key + "_emb", ids[i], sparse_rows)
+            dt = time.perf_counter() - t0
+            snap = telemetry.snapshot()["counters"]
+            out = {
+                "quant": mode or "off",
+                "iters": iters,
+                "wall_s": round(dt, 3),
+                "ms_per_round": round(dt / iters * 1e3, 3),
+                "bytes_sent": int(snap.get("ps.rpc.bytes_sent", 0)),
+                "bytes_recv": int(snap.get("ps.rpc.bytes_recv", 0)),
+                "bytes_saved": int(snap.get("ps.rpc.bytes_saved", 0)),
+            }
+            out["bytes_total"] = out["bytes_sent"] + out["bytes_recv"]
+            return out
+        finally:
+            c.finalize()
+            if old is None:
+                os.environ.pop("HETU_PS_QUANT", None)
+            else:
+                os.environ["HETU_PS_QUANT"] = old
+
+    try:
+        exact = measure(None)
+        int8 = measure("int8")
+    finally:
+        srv.terminate()
+    ratio = round(exact["bytes_total"] / max(int8["bytes_total"], 1), 2)
+    section = {
+        "config": {"dense_shape": list(dense_shape),
+                   "sparse_batch": sparse_batch, "dim": dim,
+                   "rows": rows, "iters": iters,
+                   "chunk": quant.wire_chunk(),
+                   "traffic": "dense push + dense pull + sparse "
+                              "sd_pushpull per round",
+                   "counters": "ps.rpc.bytes_sent/recv (PR 5), "
+                               "ps.rpc.bytes_saved (this PR)"},
+        "exact": exact,
+        "int8": int8,
+        "wire_reduction": ratio,
+        "note": "symmetric per-chunk int8 + f32 scales on the typed "
+                "wire (ps/wire.py tag Q); dequantized server-side "
+                "before the optimizer step, symmetrically on pull; "
+                "acceptance floor 3.5x asserted",
+    }
+    assert ratio >= 3.5, (
+        f"int8 PS wire reduction {ratio}x below the 3.5x acceptance "
+        f"floor: {exact} vs {int8}")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "..", "BENCH_PS_SCALING.json")
+    path = os.path.abspath(path)
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, ValueError):
+        art = {"bench": "ps_sd_pushpull_scaling"}
+    art["quant_ab"] = section
+    with open(path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({"quant_ab_wire_reduction": ratio,
+                      "ms_per_round_exact": exact["ms_per_round"],
+                      "ms_per_round_int8": int8["ms_per_round"]}))
+    return section
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
@@ -117,7 +223,15 @@ def main():
     ap.add_argument("--workers", default="1,2,4,8")
     ap.add_argument("--servers", default="1,4",
                     help="server-group sizes to sweep (row-sharded)")
+    ap.add_argument("--quant-only", action="store_true",
+                    help="run ONLY the int8-wire quant A/B and merge "
+                         "its quant_ab section into "
+                         "BENCH_PS_SCALING.json")
     args = ap.parse_args()
+
+    if args.quant_only:
+        quant_ab()
+        return
 
     ctx = mp.get_context("spawn")
     results = {}

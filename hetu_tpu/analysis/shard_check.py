@@ -20,6 +20,10 @@ host in microseconds:
 - :func:`check_collective_order_static` — per-group collective sequences
   agree (the build-time sibling of ``parallel/collective_check.py``,
   which needs a traced shard_map program; this one needs only the graph).
+- :func:`check_quantized_collectives` — every quantized collective is a
+  complete quantize→collective→dequantize trio on one axis (a quantize
+  without its paired dequantize across the collective is rejected
+  before compile — the HETU_COMM_QUANT pair contract).
 
 :func:`check_parallelism` is the umbrella the executor wires in under
 ``HETU_VALIDATE=1``: hard violations raise :class:`ShardCheckError`;
@@ -29,8 +33,9 @@ advisory ones come back as findings dicts.
 from __future__ import annotations
 
 from ..graph.node import Op
-from ..graph.ops_comm import (CollectiveOp, PipelineReceiveOp,
-                              PipelineSendOp)
+from ..graph.ops_comm import (CollectiveOp, DequantizeCommOp,
+                              PipelineReceiveOp, PipelineSendOp,
+                              QuantAllReduceCommunicateOp, QuantizeCommOp)
 from ..graph.ops_misc import PlaceholderOp
 
 
@@ -44,6 +49,87 @@ class ShardCheckError(Exception):
         super().__init__(message)
         self.node = node
         self.kind = kind
+
+
+# --------------------------------------------------------------------- #
+# quantized-collective pairing (HETU_COMM_QUANT pairs; EQuARX lineage)
+# --------------------------------------------------------------------- #
+
+def check_quantized_collectives(eval_nodes):
+    """Every quantized collective must be a complete, axis-consistent
+    quantize→collective→dequantize trio (``graph/ops_comm``):
+
+    - a ``QuantizeCommOp``'s output feeds ONLY quantized collectives
+      (its (int8, scales) pair is meaningless to any other consumer, and
+      a quantize whose pair never crosses a collective + dequantize
+      would silently hand int8 garbage downstream);
+    - a ``QuantAllReduceCommunicateOp`` takes exactly a quantize and
+      feeds only dequantizes;
+    - a ``DequantizeCommOp`` decodes exactly a quantized collective;
+    - all three agree on the mesh axis.
+
+    Raises ShardCheckError(kind='quant_pair'); returns the trios found
+    as [(quantize, collective, dequantize), ...]."""
+    topo = _topo_of(eval_nodes)
+    consumers = {}
+    for n in topo:
+        for i in n.inputs:
+            consumers.setdefault(id(i), []).append(n)
+    trios = []
+    for n in topo:
+        if isinstance(n, QuantizeCommOp):
+            cons = consumers.get(id(n), [])
+            bad = [c for c in cons
+                   if not isinstance(c, QuantAllReduceCommunicateOp)]
+            if bad or not cons:
+                raise ShardCheckError(
+                    f"quantize {n.name} has no paired dequantize across "
+                    f"a quantized collective: consumed by "
+                    f"{[c.name for c in bad] or 'nothing'} — emit the "
+                    f"trio via quantized_allreduce_op (the (int8, "
+                    f"scales) pair must cross a "
+                    f"QuantAllReduceCommunicateOp into a "
+                    f"DequantizeCommOp)", node=n, kind="quant_pair")
+        elif isinstance(n, QuantAllReduceCommunicateOp):
+            src = n.inputs[0]
+            if not isinstance(src, QuantizeCommOp):
+                raise ShardCheckError(
+                    f"quantized collective {n.name} consumes "
+                    f"{src.name} ({type(src).__name__}), not a "
+                    f"QuantizeCommOp — all_gathering raw f32 through "
+                    f"the quantized pair moves full-width bytes and "
+                    f"breaks the dequantize contract", node=n,
+                    kind="quant_pair")
+            cons = consumers.get(id(n), [])
+            deqs = [c for c in cons if isinstance(c, DequantizeCommOp)]
+            if not deqs or len(deqs) != len(cons):
+                others = [c.name for c in cons
+                          if not isinstance(c, DequantizeCommOp)]
+                raise ShardCheckError(
+                    f"quantized collective {n.name} (axis {n.axis!r}) "
+                    f"has no paired DequantizeCommOp"
+                    + (f"; consumed by {others}" if others else "")
+                    + " — a quantize without its dequantize across the "
+                    "collective leaves int8 payloads in the graph",
+                    node=n, kind="quant_pair")
+            for d in deqs + [src]:
+                if getattr(d, "axis", n.axis) != n.axis:
+                    raise ShardCheckError(
+                        f"quantized trio disagrees on the mesh axis: "
+                        f"{src.name}/{n.name}/{[x.name for x in deqs]} "
+                        f"mix {d.axis!r} and {n.axis!r}", node=n,
+                        kind="quant_pair")
+            for d in deqs:
+                trios.append((src, n, d))
+        elif isinstance(n, DequantizeCommOp):
+            src = n.inputs[0]
+            if not isinstance(src, QuantAllReduceCommunicateOp):
+                raise ShardCheckError(
+                    f"dequantize {n.name} consumes {src.name} "
+                    f"({type(src).__name__}), not a quantized "
+                    f"collective — the pair must cross the collective",
+                    node=n, kind="quant_pair")
+    return trios
 
 
 def _comm_nodes(topo):
@@ -306,6 +392,7 @@ def check_parallelism(eval_nodes, mesh, config=None, feed_shapes=None):
     eval_nodes = [n for n in eval_nodes if n is not None]
     findings = []
     check_mesh_axes(eval_nodes, mesh)
+    check_quantized_collectives(eval_nodes)
     findings += check_divisibility(eval_nodes, mesh,
                                    feed_shapes=feed_shapes)
     if config is not None and getattr(config, "pipeline", None):

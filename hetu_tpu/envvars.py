@@ -283,6 +283,32 @@ _reg("HETU_ROUTER_SHED_ON_SLO", "bool", True,
      "back inside budget).", "router")
 
 # --------------------------------------------------------------------- #
+# quantization (hetu_tpu/quant.py — one layer, three seams)
+# --------------------------------------------------------------------- #
+_reg("HETU_PS_QUANT", "str", None,
+     "PS transport quantization: 'int8' ships push/pull payloads as "
+     "symmetric per-chunk int8 + f32 scales over the wire (~3.7x fewer "
+     "bytes; dequantized server-side before the optimizer step, "
+     "symmetrically on pull).  Unset/0 = exact f32 wire (default).",
+     "quant")
+_reg("HETU_COMM_QUANT", "str", None,
+     "Collective quantization: 'int8' makes DataParallel emit the "
+     "quantize→all_gather→dequantize comm-op pair for dp gradient "
+     "aggregation (int8 payload on the interconnect under shard_map "
+     "execution; fake-quant annotation under pjit, where XLA owns the "
+     "collective).  Unset/0 = plain f32 collectives (default).",
+     "quant")
+_reg("HETU_KV_QUANT", "str", None,
+     "Serving KV-cache quantization: 'int8' stores the KV pool as int8 "
+     "with per-(position, head) f32 scales (~3.7x more tokens per HBM "
+     "byte; dequantized inside the decode kernels' online-softmax "
+     "loop).  Unset/0 = the cache follows the weight dtype (default).",
+     "quant")
+_reg("HETU_QUANT_CHUNK", "int", 256,
+     "Elements per f32 scale for the flat (PS wire / comm pair) int8 "
+     "codec; the KV cache always scales per (position, head).", "quant")
+
+# --------------------------------------------------------------------- #
 # graph/ops knobs
 # --------------------------------------------------------------------- #
 _reg("HETU_MOE_SCATTER_DISPATCH", "bool", False,

@@ -118,6 +118,120 @@ def reduceCommunicate_op(node, comm=None, root=0, axis="dp", ctx=None):
     return ReduceCommunicateOp(node, root=root, axis=axis, ctx=ctx)
 
 
+# --------------------------------------------------------------------- #
+# quantized collective pair (HETU_COMM_QUANT=int8; EQuARX lineage)
+# --------------------------------------------------------------------- #
+#
+# A quantized gradient aggregation is THREE nodes, so the static
+# checkers can see (and reject a broken) pairing before compile:
+#
+#     QuantizeCommOp  ->  QuantAllReduceCommunicateOp  ->  DequantizeCommOp
+#     f32 -> (int8,scales)    all_gather the pair          decode + sum
+#
+# int8 cannot be psum'd directly (overflow, and the scales would sum
+# wrong), so the collective is an all_gather of the (payload, scales)
+# pytree — the interconnect carries int8 bytes — and the dequantize side
+# decodes each participant's contribution and reduces in f32.  Under
+# shard_map execution (tc.has_axis) this is the real quantized
+# collective; under pjit, where XLA owns collective insertion and the
+# plain CollectiveOp degrades to an annotation, the pair degrades to a
+# shape-preserving fake-quant of the gradient (EQuARX does the int8
+# rewrite inside XLA itself, which is exactly the part we cannot reach
+# from op level).  ``analysis/shard_check.check_quantized_collectives``
+# rejects any quantize without its paired dequantize across the
+# collective; emit the trio via :func:`quantized_allreduce_op`.
+
+class QuantizeCommOp(Op):
+    """Encode a float tensor to (int8 payload, f32 scales) for a
+    quantized collective.  Output is a 2-tuple pytree; its ONLY legal
+    consumer is a quantized collective (shard_check enforces this)."""
+
+    def __init__(self, node, axis=None, chunk=None, ctx=None):
+        super().__init__(node, name="QuantizeComm", ctx=ctx)
+        self.axis = axis or "dp"
+        from .. import quant as _quant
+        self.chunk = int(chunk or _quant.wire_chunk())
+
+    def compute(self, input_vals, tc: TraceContext):
+        from .. import quant as _quant
+        (x,) = input_vals
+        flat = x.astype(jnp.float32).reshape(-1)
+        pad = (-flat.shape[0]) % self.chunk
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        return _quant.quantize_jax(flat, self.chunk)
+
+    def gradient(self, output_grad):
+        return [output_grad]
+
+
+class QuantAllReduceCommunicateOp(CollectiveOp):
+    """The collective leg of the pair: all_gather the (int8, scales)
+    pytree over ``axis`` so the wire moves quantized bytes.  Always
+    emits a leading participant dim (size 1 under pjit, where the
+    collective is an annotation) so the dequantize side can reduce
+    uniformly."""
+
+    axis_default = "dp"
+
+    def compute(self, input_vals, tc: TraceContext):
+        (pair,) = input_vals
+        if tc.has_axis(self.axis):
+            return jax.lax.all_gather(pair, self.axis)
+        return jax.tree_util.tree_map(lambda a: a[None], pair)
+
+    def gradient(self, output_grad):
+        return [output_grad]
+
+
+class DequantizeCommOp(Op):
+    """Decode the gathered (int8, scales) pair and reduce: each
+    participant's contribution dequantizes to f32 and the sum is the
+    quantized AllReduce's result, reshaped back to the original
+    gradient shape."""
+
+    def __init__(self, node, shape, axis=None, chunk=None, ctx=None):
+        super().__init__(node, name="DequantizeComm", ctx=ctx)
+        self.axis = axis or "dp"
+        self.shape = tuple(int(d) for d in shape)
+        from .. import quant as _quant
+        self.chunk = int(chunk or _quant.wire_chunk())
+
+    def compute(self, input_vals, tc: TraceContext):
+        from .. import quant as _quant
+        (pair,) = input_vals
+        q, scales = pair                       # [n, padded], [n, chunks]
+        out = _quant.dequantize_jax(
+            q.reshape(-1, q.shape[-1]), scales.reshape(-1, scales.shape[-1]),
+            self.chunk).sum(axis=0)
+        n = 1
+        for d in self.shape:
+            n *= d
+        return out[:n].reshape(self.shape)
+
+    def infer_shape(self, input_shapes, input_dtypes=None):
+        return self.shape
+
+    def gradient(self, output_grad):
+        return [output_grad]
+
+
+def quantized_allreduce_op(node, axis="dp", chunk=None, shape=None,
+                           ctx=None):
+    """Emit the quantize→all_gather→dequantize trio for one gradient
+    (``shape`` = the gradient's shape; taken from ``node.shape`` when
+    the node carries one).  Returns the DequantizeCommOp head."""
+    if shape is None:
+        shape = getattr(node, "shape", None)
+    if shape is None:
+        raise ValueError(
+            f"quantized_allreduce_op needs the gradient shape for "
+            f"{node!r}: pass shape= (the node carries none)")
+    q = QuantizeCommOp(node, axis=axis, chunk=chunk, ctx=ctx)
+    g = QuantAllReduceCommunicateOp(q, axis=axis, ctx=ctx)
+    return DequantizeCommOp(g, shape, axis=axis, chunk=q.chunk, ctx=ctx)
+
+
 class PipelineSendOp(Op):
     """P2P send to the next pipeline stage.  Under the scan-based pipeline
     executor these become ppermute rotations (parallel/pipeline.py); as a

@@ -24,6 +24,13 @@ softmax), matching the flash prefill kernel's accounting.
 Decode is inference-only — no VJP.  On non-TPU backends the kernel
 runs in interpret mode, so the same code path is testable on the CPU
 harness (parity suite in tests/test_serve_fastpath.py).
+
+INT8 KV (``HETU_KV_QUANT``, Ragged Paged Attention lineage): both
+kernels take optional ``k_scale``/``v_scale`` planes — the cache stays
+int8 in HBM and dequantizes INSIDE the online-softmax loop (per
+(position, head) scales ride the same revisit index maps, so dead
+blocks skip their DMA too); no f32 pool is ever materialized, which is
+what lets ~3.7x more tokens fit per HBM byte.
 """
 
 from __future__ import annotations
@@ -38,6 +45,39 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, _fit_block, _prec
 
 _LANES = 128
+
+
+def _online_softmax_update(q, k, v, filled, j, bk, scale, m_ref, l_ref,
+                           acc_ref):
+    """One KV block's contribution to a slot's online softmax: shared
+    verbatim by the f32/bf16 kernels and the int8 variants (which
+    dequantize k/v right before calling this — the dequant lives INSIDE
+    the online-softmax loop, no f32 pool is ever materialized)."""
+    H = q.shape[0]
+    # s[h, s] = q[h] . k[s, h] — per-head matvec, batched over heads
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        precision=_prec(q.dtype),
+        preferred_element_type=jnp.float32) * scale   # [H, bk]
+    kv_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (H, bk), 1)
+    s = jnp.where(kv_pos < filled, s, NEG_INF)
+    m_prev = m_ref[:, 0:1]
+    l_prev = l_ref[:, 0:1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - safe_m)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(jnp.clip(m_prev - m_new, max=0.0))
+    alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+        precision=_prec(v.dtype),
+        preferred_element_type=jnp.float32)           # [H, Dh]
+    acc_ref[:] = acc_ref[:] * alpha + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
 
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
@@ -57,34 +97,43 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
     # was already skipped by the revisit index map; skip the compute too
     @pl.when(j * bk < filled)
     def _compute():
-        q = q_ref[0, 0]          # [H, Dh]
-        k = k_ref[0]             # [bk, H, Dh]
-        v = v_ref[0]
-        H = q.shape[0]
-        # s[h, s] = q[h] . k[s, h] — per-head matvec, batched over heads
-        s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))),
-            precision=_prec(q.dtype),
-            preferred_element_type=jnp.float32) * scale   # [H, bk]
-        kv_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (H, bk), 1)
-        s = jnp.where(kv_pos < filled, s, NEG_INF)
-        m_prev = m_ref[:, 0:1]
-        l_prev = l_ref[:, 0:1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - safe_m)
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.exp(jnp.clip(m_prev - m_new, max=0.0))
-        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
-            precision=_prec(v.dtype),
-            preferred_element_type=jnp.float32)           # [H, Dh]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        _online_softmax_update(q_ref[0, 0], k_ref[0], v_ref[0], filled,
+                               j, bk, scale, m_ref, l_ref, acc_ref)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0:1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _decode_kernel_int8(lens_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                        o_ref, m_ref, l_ref, acc_ref, *, scale, bk,
+                        n_kv):
+    """Int8 twin of ``_decode_kernel``: the KV blocks arrive as int8
+    payloads plus per-(position, head) f32 scales (two extra refs with
+    the same revisit index maps, so dead blocks skip the scale DMA
+    too), and dequantize to f32 INSIDE the online-softmax loop — the
+    HBM traffic is int8, the softmax accounting identical to the f32
+    kernel."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    filled = lens_ref[b]
+
+    @pl.when(j * bk < filled)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32) * ks_ref[0][..., None]
+        v = v_ref[0].astype(jnp.float32) * vs_ref[0][..., None]
+        _online_softmax_update(q_ref[0, 0].astype(jnp.float32), k, v,
+                               filled, j, bk, scale, m_ref, l_ref,
+                               acc_ref)
 
     @pl.when(j == n_kv - 1)
     def _finalize():
@@ -98,7 +147,7 @@ def _use_interpret():
 
 
 def paged_decode_attention(q, k, v, lengths, *, block_k=128,
-                           interpret=None):
+                           k_scale=None, v_scale=None, interpret=None):
     """One decode position per slot over a paged/ragged KV cache.
 
     q: [B, H, Dh] (this step's query per slot); k, v: [B, S_max, H, Dh]
@@ -109,6 +158,11 @@ def paged_decode_attention(q, k, v, lengths, *, block_k=128,
     ``ceil(lengths[b] / block_k)`` KV blocks; a slot with lengths 0
     returns zeros (matching the masked reference's fully-dead-row
     convention).
+
+    INT8 caches: pass k/v as int8 with ``k_scale``/``v_scale``
+    [B, S_max, H] f32 (one scale per position per head — the
+    ``HETU_KV_QUANT`` layout); the kernel DMAs int8 and dequantizes
+    inside the online-softmax loop.
     """
     B, H, Dh = q.shape
     S = k.shape[1]
@@ -117,6 +171,7 @@ def paged_decode_attention(q, k, v, lengths, *, block_k=128,
     scale = Dh ** -0.5
     if interpret is None:
         interpret = _use_interpret()
+    quantized = k_scale is not None
 
     def kv_idx(b, j, lens_ref):
         # dead blocks revisit the slot's last live block: the repeated
@@ -124,14 +179,33 @@ def paged_decode_attention(q, k, v, lengths, *, block_k=128,
         last = jnp.maximum(lens_ref[b] - 1, 0) // bk
         return (b, jnp.minimum(j, last), 0, 0)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(B, n_kv),
-        in_specs=[
+    def sc_idx(b, j, lens_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bk
+        return (b, jnp.minimum(j, last), 0)
+
+    if quantized:
+        kernel = _decode_kernel_int8
+        in_specs = [
+            pl.BlockSpec((1, 1, H, Dh), lambda b, j, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+            pl.BlockSpec((1, bk, H), sc_idx),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+            pl.BlockSpec((1, bk, H), sc_idx),
+        ]
+        operands = (q[:, None], k, k_scale, v, v_scale)
+    else:
+        kernel = _decode_kernel
+        in_specs = [
             pl.BlockSpec((1, 1, H, Dh), lambda b, j, lens: (b, 0, 0, 0)),
             pl.BlockSpec((1, bk, H, Dh), kv_idx),
             pl.BlockSpec((1, bk, H, Dh), kv_idx),
-        ],
+        ]
+        operands = (q[:, None], k, v)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, n_kv),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, H, Dh),
                                lambda b, j, lens: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -141,11 +215,11 @@ def paged_decode_attention(q, k, v, lengths, *, block_k=128,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bk=bk, n_kv=n_kv),
+        functools.partial(kernel, scale=scale, bk=bk, n_kv=n_kv),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, 1, H, Dh), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), q[:, None], k, v)
+    )(lengths.astype(jnp.int32), *operands)
     return out[:, 0]
 
 
@@ -160,8 +234,21 @@ def _block_decode_kernel(lens_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, scale=scale, bk=bk, n_kv=n_kv)
 
 
+def _block_decode_kernel_int8(lens_ref, bt_ref, q_ref, k_ref, ks_ref,
+                              v_ref, vs_ref, o_ref, m_ref, l_ref,
+                              acc_ref, *, scale, bk, n_kv):
+    """Block-table twin of ``_decode_kernel_int8``: int8 pool blocks +
+    per-(position, head) scale blocks, both routed through the table's
+    index maps, dequantized inside the online-softmax loop."""
+    del bt_ref
+    _decode_kernel_int8(lens_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                        o_ref, m_ref, l_ref, acc_ref, scale=scale,
+                        bk=bk, n_kv=n_kv)
+
+
 def paged_block_decode_attention(q, pool_k, pool_v, lengths,
-                                 block_tables, *, interpret=None):
+                                 block_tables, *, k_scale=None,
+                                 v_scale=None, interpret=None):
     """One decode position per slot over a BLOCK-TABLE paged KV pool.
 
     q: [B, H, Dh]; pool_k, pool_v: [N_blocks, bs, H, Dh] — the SHARED
@@ -180,6 +267,12 @@ def paged_block_decode_attention(q, pool_k, pool_v, lengths,
     Shared prefix blocks are fetched per-slot but STORED once in HBM,
     which is the capacity win this kernel exists for.  f32
     online-softmax over bf16 pools, matching ``paged_decode_attention``.
+
+    INT8 pools (``HETU_KV_QUANT``): pass the pools as int8 with
+    ``k_scale``/``v_scale`` [N_blocks, bs, H] f32 — the scale blocks
+    ride the same table index maps (dead entries skip their DMA too)
+    and dequantize inside the online-softmax loop, so the capacity win
+    compounds ~3.7x on top of prefix sharing.
     """
     B, H, Dh = q.shape
     bs = pool_k.shape[1]
@@ -187,20 +280,41 @@ def paged_block_decode_attention(q, pool_k, pool_v, lengths,
     scale = Dh ** -0.5
     if interpret is None:
         interpret = _use_interpret()
+    quantized = k_scale is not None
 
     def kv_idx(b, j, lens_ref, bt_ref):
         last = jnp.maximum(lens_ref[b] - 1, 0) // bs
         return (bt_ref[b, jnp.minimum(j, last)], 0, 0, 0)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, T),
-        in_specs=[
+    def sc_idx(b, j, lens_ref, bt_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bs
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0)
+
+    if quantized:
+        kernel = _block_decode_kernel_int8
+        in_specs = [
+            pl.BlockSpec((1, 1, H, Dh),
+                         lambda b, j, lens, bt: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+            pl.BlockSpec((1, bs, H), sc_idx),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+            pl.BlockSpec((1, bs, H), sc_idx),
+        ]
+        operands = (q[:, None], pool_k, k_scale, pool_v, v_scale)
+    else:
+        kernel = _block_decode_kernel
+        in_specs = [
             pl.BlockSpec((1, 1, H, Dh),
                          lambda b, j, lens, bt: (b, 0, 0, 0)),
             pl.BlockSpec((1, bs, H, Dh), kv_idx),
             pl.BlockSpec((1, bs, H, Dh), kv_idx),
-        ],
+        ]
+        operands = (q[:, None], pool_k, pool_v)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, T),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, H, Dh),
                                lambda b, j, lens, bt: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -210,33 +324,47 @@ def paged_block_decode_attention(q, pool_k, pool_v, lengths,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_block_decode_kernel, scale=scale, bk=bs,
-                          n_kv=T),
+        functools.partial(kernel, scale=scale, bk=bs, n_kv=T),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, 1, H, Dh), q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
-      q[:, None], pool_k, pool_v)
+      *operands)
     return out[:, 0]
 
 
 def paged_block_decode_reference(q, pool_k, pool_v, lengths,
-                                 block_tables):
+                                 block_tables, k_scale=None,
+                                 v_scale=None):
     """Gather-then-mask oracle for the block-table kernel: materialize
-    each slot's logical [T*bs] KV from the pool and run the contiguous
+    each slot's logical [T*bs] KV from the pool (dequantizing int8
+    pools through their gathered scale planes — the masked-gather
+    reference path the engine runs off-TPU) and run the contiguous
     masked reference over it."""
     B = q.shape[0]
     bs = pool_k.shape[1]
     T = block_tables.shape[1]
     k = pool_k[block_tables].reshape(B, T * bs, *pool_k.shape[2:])
     v = pool_v[block_tables].reshape(B, T * bs, *pool_v.shape[2:])
+    if k_scale is not None:
+        ks = k_scale[block_tables].reshape(B, T * bs,
+                                           *k_scale.shape[2:])
+        vs = v_scale[block_tables].reshape(B, T * bs,
+                                           *v_scale.shape[2:])
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     return masked_decode_reference(q, k, v, lengths)
 
 
-def masked_decode_reference(q, k, v, lengths):
+def masked_decode_reference(q, k, v, lengths, k_scale=None,
+                            v_scale=None):
     """Exact masked-``S_max`` oracle (f32) for the parity suite: the
     same arithmetic ``_decode_step``'s einsum path runs, minus the
-    compute-dtype shortcuts."""
+    compute-dtype shortcuts.  Int8 caches dequantize through their
+    per-(position, head) scales first."""
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[..., None]
+        v = v.astype(jnp.float32) * v_scale[..., None]
     S = k.shape[1]
     s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)

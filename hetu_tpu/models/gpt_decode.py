@@ -39,8 +39,96 @@ import jax
 import jax.numpy as jnp
 
 from .. import envvars
+from ..quant import kv_decode, kv_encode
 
 NEG_INF = -1e30
+
+
+# ----------------------- quantized-cache plumbing ----------------------- #
+#
+# An int8 KV cache (HETU_KV_QUANT) travels as a ``(int8 data, f32
+# scales)`` 2-tuple wherever a plain cache array travels — jit treats it
+# as a pytree, donation donates both leaves, and the engine reassigns it
+# opaquely.  These helpers are the ONLY places the layout forks: writes
+# encode through ``quant.kv_encode`` (one scale per position per head),
+# reads either dequantize (reference/masked paths) or hand the raw
+# payload + scales to the int8 decode kernels, which dequantize inside
+# the online-softmax loop.
+
+
+def _kv_q(cache):
+    """True when ``cache`` is the quantized (data, scales) pair."""
+    return isinstance(cache, (tuple, list))
+
+
+def _kv_dtype(cache):
+    return cache[0].dtype if _kv_q(cache) else cache.dtype
+
+
+def _kv_shape(cache):
+    """The payload shape (scales mirror it minus the head_dim axis)."""
+    return cache[0].shape if _kv_q(cache) else cache.shape
+
+
+def _kv_scatter(cache, idx, val):
+    """``cache.at[idx].set(val)`` for either layout: ``val`` is the
+    float K/V slab; a quantized cache encodes it and writes payload +
+    scales through the SAME index (the scale planes drop only the
+    trailing head_dim axis, so any index that selects ``[..., H, Dh]``
+    slabs of the payload selects ``[..., H]`` slabs of the scales)."""
+    if _kv_q(cache):
+        data, sc = cache
+        q, s = kv_encode(val)
+        return (data.at[idx].set(q), sc.at[idx].set(s))
+    return cache.at[idx].set(val.astype(cache.dtype))
+
+
+def _kv_dus(cache, val, i, pos):
+    """The offline scan's contiguous dynamic_update_slice write (one
+    [B, H, Dh] slab at scalar position ``pos`` of layer ``i``), both
+    layouts."""
+    if _kv_q(cache):
+        data, sc = cache
+        q, s = kv_encode(val)
+        return (jax.lax.dynamic_update_slice(
+                    data, q[None, :, None], (i, 0, pos, 0, 0)),
+                jax.lax.dynamic_update_slice(
+                    sc, s[None, :, None], (i, 0, pos, 0)))
+    return jax.lax.dynamic_update_slice(
+        cache, val[None, :, None], (i, 0, pos, 0, 0))
+
+
+def _kv_gather_row(cache, i, table_row, span, H, Dh):
+    """One slot's logical [span, H, Dh] context gathered from a paged
+    pool through its block table row (the chunk-prefill read path);
+    quantized pools dequantize the gathered view."""
+    if _kv_q(cache):
+        data, sc = cache
+        g = data[i][table_row].reshape(span, H, Dh)
+        s = sc[i][table_row].reshape(span, H)
+        return g.astype(jnp.float32) * s[..., None]
+    return cache[i][table_row].reshape(span, H, Dh)
+
+
+def _kv_slot_slice(cache, slot, sizes):
+    """One slot's [L, 1, S_max, H, Dh] view of a contiguous cache (the
+    reference prefill works on this slice), both layouts."""
+    if _kv_q(cache):
+        data, sc = cache
+        return (jax.lax.dynamic_slice(data, (0, slot, 0, 0, 0), sizes),
+                jax.lax.dynamic_slice(sc, (0, slot, 0, 0), sizes[:-1]))
+    return jax.lax.dynamic_slice(cache, (0, slot, 0, 0, 0), sizes)
+
+
+def _kv_slot_update(cache, sub, slot):
+    """Write a slot view (from :func:`_kv_slot_slice`) back."""
+    if _kv_q(cache):
+        data, sc = cache
+        return (jax.lax.dynamic_update_slice(data, sub[0],
+                                             (0, slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(sc, sub[1],
+                                             (0, slot, 0, 0)))
+    return jax.lax.dynamic_update_slice(cache, sub, (0, slot, 0, 0, 0))
 
 
 def _pow2(n, floor=1):
@@ -133,7 +221,7 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
         lens = ((pos + 1).astype(jnp.int32) if per_slot
                 else jnp.full((B,), pos + 1, jnp.int32))
     if paged:
-        bs_blk = cache_k.shape[2]
+        bs_blk = _kv_shape(cache_k)[2]
         T = block_tables.shape[1]
         bidx = jnp.arange(B)
         wblk = block_tables[bidx, pos // bs_blk]
@@ -159,33 +247,48 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
         q = q.reshape(B, H, Dh)
         k = k.reshape(B, H, Dh)
         v = v.reshape(B, H, Dh)
-        # write this position's k/v into the cache
+        # write this position's k/v into the cache (quantized caches
+        # encode payload + per-(position, head) scales in one helper)
         if paged:
-            cache_k = cache_k.at[i, wblk, woff].set(k)
-            cache_v = cache_v.at[i, wblk, woff].set(v)
+            cache_k = _kv_scatter(cache_k, (i, wblk, woff), k)
+            cache_v = _kv_scatter(cache_v, (i, wblk, woff), v)
         elif per_slot:
-            cache_k = cache_k.at[i, bidx, pos].set(k)
-            cache_v = cache_v.at[i, bidx, pos].set(v)
+            cache_k = _kv_scatter(cache_k, (i, bidx, pos), k)
+            cache_v = _kv_scatter(cache_v, (i, bidx, pos), v)
         else:
-            cache_k = jax.lax.dynamic_update_slice(
-                cache_k, k[None, :, None], (i, 0, pos, 0, 0))
-            cache_v = jax.lax.dynamic_update_slice(
-                cache_v, v[None, :, None], (i, 0, pos, 0, 0))
-        ks = cache_k[i]                    # [B,S,H,Dh] | [N,bs,H,Dh]
-        vs = cache_v[i]
+            cache_k = _kv_dus(cache_k, k, i, pos)
+            cache_v = _kv_dus(cache_v, v, i, pos)
+        if _kv_q(cache_k):                 # layer views: payload+scales
+            ks, ksc = cache_k[0][i], cache_k[1][i]
+            vs, vsc = cache_v[0][i], cache_v[1][i]
+        else:
+            ks, vs = cache_k[i], cache_v[i]   # [B,S,H,Dh] | [N,bs,H,Dh]
+            ksc = vsc = None
         if paged and attn == "ragged":
             o = paged_block_decode_attention(
-                q, ks, vs, lens, block_tables).reshape(B, hdim)
+                q, ks, vs, lens, block_tables, k_scale=ksc,
+                v_scale=vsc).reshape(B, hdim)
         elif paged:
             kg = ks[block_tables].reshape(B, T * bs_blk, H, Dh)
             vg = vs[block_tables].reshape(B, T * bs_blk, H, Dh)
+            if ksc is not None:
+                # masked-gather reference: dequantize the gathered view
+                kg = kg.astype(jnp.float32) * ksc[block_tables].reshape(
+                    B, T * bs_blk, H)[..., None]
+                vg = vg.astype(jnp.float32) * vsc[block_tables].reshape(
+                    B, T * bs_blk, H)[..., None]
             s = jnp.einsum("bhd,bshd->bhs", q, kg) * (Dh ** -0.5)
             s = jnp.where(live, s, NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhs,bshd->bhd", p, vg).reshape(B, hdim)
         elif attn == "ragged":
-            o = paged_decode_attention(q, ks, vs, lens).reshape(B, hdim)
+            o = paged_decode_attention(
+                q, ks, vs, lens, k_scale=ksc,
+                v_scale=vsc).reshape(B, hdim)
         else:
+            if ksc is not None:
+                ks = kv_decode(ks, ksc)
+                vs = kv_decode(vs, vsc)
             s = jnp.einsum("bhd,bshd->bhs", q, ks) * (Dh ** -0.5)
             s = jnp.where(live, s, NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
@@ -207,14 +310,18 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
     return logits, cache_k, cache_v
 
 
-def _prep_param(v, dtype=jnp.float32):
+def _prep_param(v, dtype=None):
     """``dtype`` on device, PRESERVING any existing placement: a
     tp_shard_params NamedSharding must survive into the scan (a
     np.asarray round-trip would gather the shards to host and re-place
     them replicated on one device, silently killing tensor-parallel
-    decode)."""
+    decode).  ``dtype=None`` KEEPS the param's own dtype — bf16 params
+    stay bf16, so the cache that "follows the weights" actually does
+    (the old f32 default silently upcast bf16 weights AND doubled the
+    cache); f64 numpy inputs still land as f32 via jax's default dtype
+    canonicalization."""
     if isinstance(v, jax.Array):
-        return v if v.dtype == dtype else v.astype(dtype)
+        return v if dtype is None or v.dtype == dtype else v.astype(dtype)
     return jnp.asarray(np.asarray(v), dtype)
 
 
@@ -440,10 +547,8 @@ def _serve_prefill(params, cfg_tuple, cache_k, cache_v, slot, prompt,
     name, L, H, Dh, S_max = cfg_tuple
     P_b = prompt.shape[0]
     V = params[f"{name}_wte_table"].shape[0]
-    ck = jax.lax.dynamic_slice(cache_k, (0, slot, 0, 0, 0),
-                               (L, 1, S_max, H, Dh))
-    cv = jax.lax.dynamic_slice(cache_v, (0, slot, 0, 0, 0),
-                               (L, 1, S_max, H, Dh))
+    ck = _kv_slot_slice(cache_k, slot, (L, 1, S_max, H, Dh))
+    cv = _kv_slot_slice(cache_v, slot, (L, 1, S_max, H, Dh))
 
     def step(carry, t):
         def live(carry):
@@ -456,8 +561,8 @@ def _serve_prefill(params, cfg_tuple, cache_k, cache_v, slot, prompt,
 
     (ck, cv, last), _ = jax.lax.scan(
         step, (ck, cv, jnp.zeros((V,), jnp.float32)), jnp.arange(P_b))
-    cache_k = jax.lax.dynamic_update_slice(cache_k, ck, (0, slot, 0, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, cv, (0, slot, 0, 0, 0))
+    cache_k = _kv_slot_update(cache_k, ck, slot)
+    cache_v = _kv_slot_update(cache_v, cv, slot)
     rng_key, sub = jax.random.split(rng_key)
     first = _sample_slot(last, temperature, top_k, sub)
     return first, cache_k, cache_v, rng_key
@@ -478,9 +583,10 @@ def _serve_prefill_batch(params, cfg_tuple, cache_k, cache_v, slots,
     N, P_b = prompts.shape
     logits, ks, vs = _prefill_forward(params, cfg_tuple, prompts,
                                       prompt_lens)
-    cdtype = cache_k.dtype
-    cache_k = cache_k.at[:, slots, :P_b].set(ks.astype(cdtype))
-    cache_v = cache_v.at[:, slots, :P_b].set(vs.astype(cdtype))
+    cache_k = _kv_scatter(cache_k,
+                          (slice(None), slots, slice(0, P_b)), ks)
+    cache_v = _kv_scatter(cache_v,
+                          (slice(None), slots, slice(0, P_b)), vs)
     splits = jax.vmap(jax.random.split)(rng_keys)          # [N,2,2]
     new_keys, subs = splits[:, 0], splits[:, 1]
     first = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
@@ -543,7 +649,7 @@ def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
     name, L, H, Dh, S_max = cfg_tuple
     C_b = tokens.shape[0]
     T = table_row.shape[0]
-    bs_blk = cache_k.shape[2]
+    bs_blk = _kv_shape(cache_k)[2]
     hdim = H * Dh
     wpe = params[f"{name}_wpe"]
     posns = pos_off + jnp.arange(C_b)
@@ -563,8 +669,8 @@ def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
              + params[f"{us}_attn_k_bias"]).reshape(C_b, H, Dh)
         v = (x @ params[f"{us}_attn_v_weight"]
              + params[f"{us}_attn_v_bias"]).reshape(C_b, H, Dh)
-        kc = cache_k[i][table_row].reshape(T * bs_blk, H, Dh)
-        vc = cache_v[i][table_row].reshape(T * bs_blk, H, Dh)
+        kc = _kv_gather_row(cache_k, i, table_row, T * bs_blk, H, Dh)
+        vc = _kv_gather_row(cache_v, i, table_row, T * bs_blk, H, Dh)
         s1 = jnp.einsum("chd,shd->chs", q, kc) * scale
         s1 = jnp.where(ctx_live[:, None, :], s1, NEG_INF)
         s2 = jnp.einsum("chd,jhd->chj", q, k) * scale
@@ -581,9 +687,8 @@ def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
                        + params[f"{us}_ffn_wi_bias"])
         f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
         h = h + f
-        cdtype = cache_k.dtype
-        cache_k = cache_k.at[i, wblk, woff].set(k.astype(cdtype))
-        cache_v = cache_v.at[i, wblk, woff].set(v.astype(cdtype))
+        cache_k = _kv_scatter(cache_k, (i, wblk, woff), k)
+        cache_v = _kv_scatter(cache_v, (i, wblk, woff), v)
     hf = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
     last = hf[jnp.maximum(n_tok - 1, 0)]
     logits = (last @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
@@ -605,9 +710,8 @@ def _serve_prefill_batch_paged(params, cfg_tuple, cache_k, cache_v,
     new_rng_keys)."""
     logits, ks, vs = _prefill_forward(params, cfg_tuple, prompts,
                                       prompt_lens)
-    cdtype = cache_k.dtype
-    cache_k = cache_k.at[:, wblk, woff].set(ks.astype(cdtype))
-    cache_v = cache_v.at[:, wblk, woff].set(vs.astype(cdtype))
+    cache_k = _kv_scatter(cache_k, (slice(None), wblk, woff), ks)
+    cache_v = _kv_scatter(cache_v, (slice(None), wblk, woff), vs)
     splits = jax.vmap(jax.random.split)(rng_keys)          # [N,2,2]
     new_keys, subs = splits[:, 0], splits[:, 1]
     first = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
@@ -682,6 +786,63 @@ def serve_prefill_batch_paged_fn(donate=True):
     return jax.jit(_serve_prefill_batch_paged, **kw)
 
 
+def teacher_forced_logits(params, config, seq, kv_fake_quant=False,
+                          name=None):
+    """Per-position next-token logits [P, V] of ONE sequence under
+    teacher forcing, optionally with every layer's K/V FAKE-QUANTIZED
+    (``quant.kv_encode`` → ``kv_decode``) before attention.
+
+    Storing KV as int8 and dequantizing inside the decode kernel is
+    arithmetically identical to fake-quantizing K/V here, so this is
+    the margin-gate ORACLE for ``HETU_KV_QUANT``: measure
+    ``delta = max |logits_q - logits_exact|`` over a corpus, and every
+    position whose exact top-2 logit margin exceeds ``2 * delta`` is
+    GUARANTEED top-1-identical under int8 KV — the "tolerance-tested
+    threshold" the quant_ab quality gate asserts.  Positions inside the
+    threshold are genuine near-ties where either token is defensible.
+    """
+    c = config
+    name = _infer_name(params, name)
+    params = {k: _prep_param(v) for k, v in params.items()
+              if k.startswith(name + "_")}
+    L, H = c.num_hidden_layers, c.num_attention_heads
+    Dh = c.hidden_size // H
+    seq = jnp.asarray(seq, jnp.int32)
+    P = seq.shape[0]
+    hdim = H * Dh
+    h = params[f"{name}_wte_table"][seq] \
+        + params[f"{name}_wpe"][jnp.arange(P)]
+    causal = jnp.tril(jnp.ones((P, P), bool))
+    for i in range(L):
+        us = f"{name}_h{i}"
+        x = _ln(h, params[f"{us}_ln1_scale"], params[f"{us}_ln1_bias"])
+        q = (x @ params[f"{us}_attn_q_weight"]
+             + params[f"{us}_attn_q_bias"]).reshape(P, H, Dh)
+        k = (x @ params[f"{us}_attn_k_weight"]
+             + params[f"{us}_attn_k_bias"]).reshape(P, H, Dh)
+        v = (x @ params[f"{us}_attn_v_weight"]
+             + params[f"{us}_attn_v_bias"]).reshape(P, H, Dh)
+        if kv_fake_quant:
+            k = kv_decode(*kv_encode(k)).astype(k.dtype)
+            v = kv_decode(*kv_encode(v)).astype(v.dtype)
+        s = jnp.einsum("phd,shd->hps", q, k) * (Dh ** -0.5)
+        s = jnp.where(causal[None], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hps,shd->phd", p, v).reshape(P, hdim)
+        o = o @ params[f"{us}_attn_proj_weight"] \
+            + params[f"{us}_attn_proj_bias"]
+        h = h + o
+        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
+                       + params[f"{us}_ffn_wi_bias"])
+        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
+        h = h + f
+    h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
+    logits = (h @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
+        + params.get(f"{name}_head_bias", 0.0)
+    return logits
+
+
 def _infer_name(params, name=None):
     """The model's parameter-name prefix; explicit ``name`` wins, else
     inferred when exactly one ``*_wte_table`` is present."""
@@ -743,7 +904,8 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
       [B, P] array); name: the model's parameter-name prefix — inferred
       when the params hold exactly one ``*_wte_table``; dtype:
       ``jnp.bfloat16`` halves weights AND the KV cache and takes the
-      fast MXU path (logits/sampling stay f32); default float32;
+      fast MXU path (logits/sampling stay f32); default FOLLOWS the
+      params' own dtype (bf16 weights → bf16 cache);
       eos_id: a row that samples this id past its prompt emits it, then
       ``pad_id`` for the rest of the requested span (and per-step
       compute short-circuits once every row is done) — both traced, so
@@ -773,7 +935,9 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
     Dh = c.hidden_size // c.num_attention_heads
     cfg_tuple = (name, c.num_hidden_layers, c.num_attention_heads,
                  Dh, S_max)
-    dtype = dtype or jnp.float32
+    # dtype=None FOLLOWS the params (bf16 weights decode bf16 with a
+    # bf16 cache — the "follow the weights" contract; the old default
+    # silently upcast everything to f32)
     params = {k: _prep_param(v, dtype)
               for k, v in params.items() if k.startswith(name + "_")}
     common = dict(eos_id=jnp.int32(-1 if eos_id is None else eos_id),

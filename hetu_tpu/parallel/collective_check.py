@@ -80,14 +80,31 @@ def _collect(closed_or_open, seq):
         if prim in _COLLECTIVE_PRIMS:
             shapes = tuple(tuple(v.aval.shape) for v in eqn.invars
                            if hasattr(v, "aval"))
-            seq.append((prim, _axes_of(eqn), shapes))
+            # dtypes ride in the signature so a QUANTIZED collective
+            # (int8 payload — the ops_comm quantize→gather→dequantize
+            # pair) is distinguishable from its f32 twin: cond branches
+            # disagreeing on quantized-vs-unquantized aggregation are a
+            # sequence divergence like any other
+            dtypes = tuple(str(v.aval.dtype) for v in eqn.invars
+                           if hasattr(v, "aval"))
+            seq.append((prim, _axes_of(eqn), shapes, dtypes))
     return seq
+
+
+def quantized_collectives(seq):
+    """The int8-carrying entries of a recorded collective sequence —
+    the wire legs of ops_comm's quantize→collective→dequantize pairs.
+    Lets a test/validator assert that an intended quantized program
+    actually moves int8 on the interconnect (and vice versa)."""
+    return [s for s in seq
+            if len(s) > 3 and any(d == "int8" for d in s[3])]
 
 
 def check_collective_order(fn, mesh, in_specs, out_specs, example_args):
     """Trace ``shard_map(fn)`` and validate its collective ordering.
-    Returns the collective sequence [(prim, axes, shapes), ...] on
-    success; raises CollectiveOrderError on cond-branch divergence."""
+    Returns the collective sequence [(prim, axes, shapes, dtypes), ...]
+    on success; raises CollectiveOrderError on cond-branch divergence
+    (including branches disagreeing on quantized-vs-f32 payloads)."""
     from jax import shard_map
 
     args = [

@@ -25,17 +25,58 @@ class DataParallel(Strategy):
     """Batch sharded over 'dp'; params replicated; XLA psums grads.
     Reference: distributed_strategies/simple.py:6-39 + OptimizerOp
     backward_hook AllReduce splicing (optimizer.py:154-159) — both collapse
-    into sharding annotations here."""
+    into sharding annotations here.
+
+    ``aggregate``: None/'allreduce' keeps the plain XLA psum;
+    'quant_allreduce' (or 'int8'/'quant', default taken from
+    ``$HETU_COMM_QUANT``) splices the quantize→all_gather→dequantize
+    comm-op trio (``graph/ops_comm.quantized_allreduce_op``) onto every
+    DENSE gradient entering each optimizer — the reference OptimizerOp
+    backward_hook splice, quantized.  Sparse (IndexedSlices) adjoints
+    keep their structural path.  The pair is statically verified by
+    ``analysis/shard_check.check_quantized_collectives`` before any
+    compile; 'ps'/'hybrid' remain parity args for the PS comm modes."""
+
+    _QUANT_MODES = ("quant", "int8", "quant_allreduce")
 
     def __init__(self, aggregate=None, num_devices=None):
         self.aggregate = aggregate  # parity arg ('allreduce'/'ps'/'hybrid')
         self.num_devices = num_devices
+
+    def _quantized(self):
+        if self.aggregate is not None:
+            return str(self.aggregate).lower() in self._QUANT_MODES
+        from .. import quant
+        return quant.comm_quant() == "int8"
 
     def configure(self, executor):
         if executor.config.mesh is None:
             n = self.num_devices or jax.device_count()
             executor.config.mesh = make_mesh({"dp": n})
         # params replicated (default spec None -> P())
+        if self._quantized():
+            self._splice_quantized_aggregation(executor)
+
+    @staticmethod
+    def _splice_quantized_aggregation(executor, axis="dp"):
+        """Rewire every OptimizerOp's dense grads through the quantized
+        comm-op pair.  Runs at configure time, BEFORE the subexecutors
+        topo-sort, so the trio lands in every trace and in the static
+        checkers' view of the graph."""
+        from ..graph.ops_comm import quantized_allreduce_op
+        from ..optimizer import OptimizerOp
+        done = set()
+        for nodes in executor.eval_node_dict.values():
+            for n in nodes:
+                if not isinstance(n, OptimizerOp) or id(n) in done:
+                    continue
+                done.add(id(n))
+                for i, g in enumerate(n.inputs):
+                    if i in n.sparse_inputs:
+                        continue      # sparse adjoints stay structural
+                    var = n.var_list[i]
+                    n.inputs[i] = quantized_allreduce_op(
+                        g, axis=axis, shape=var.shape)
 
 
 class ShardingPlan(Strategy):

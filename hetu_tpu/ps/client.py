@@ -21,7 +21,7 @@ import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor, Future
 
-from .. import envvars
+from .. import envvars, quant
 
 import numpy as np
 
@@ -35,6 +35,46 @@ class PSConnectionError(ConnectionError):
     """A PS request could not be completed after retries.  Raised instead
     of hanging — the failure mode VERDICT r2 flagged (a dropped packet or
     dead server mid-training surfaced as a hang or pickle error)."""
+
+
+# ---------------- wire quantization (HETU_PS_QUANT=int8) ---------------- #
+#
+# Gradients quantize CLIENT-side into a quant.QuantArray right before
+# wire.dumps and dequantize SERVER-side before the optimizer step; pulls
+# run the same pair in reverse (the client passes quant=... and decodes
+# the response).  The ~3.7x wire reduction shows up directly in the
+# per-shard ps.rpc.bytes_sent/recv counters; ps.rpc.bytes_saved records
+# the delta.  Everything below is a no-op with the knob unset — the
+# default wire stays byte-identical.
+
+def _q_encode(arr):
+    """QuantArray when int8 wire quantization is on and ``arr``
+    qualifies (float, >= quant.WIRE_MIN_SIZE elements); else ``arr``
+    unchanged.  Counts the saved bytes."""
+    if quant.ps_quant() != "int8" or not quant.should_quantize(arr):
+        return arr
+    qa = quant.QuantArray.encode(arr, quant.wire_chunk())
+    from .. import telemetry
+    if telemetry.enabled():
+        telemetry.inc("ps.rpc.bytes_saved", quant.wire_savings(qa))
+    return qa
+
+
+def _q_decode(value):
+    """Decode a quantized response payload (pull half of the pair);
+    plain arrays pass through.  Counts the saved bytes."""
+    if isinstance(value, quant.QuantArray):
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.inc("ps.rpc.bytes_saved",
+                          quant.wire_savings(value))
+        return value.decode()
+    return value
+
+
+def _q_mode():
+    """The quant argument verbs forward to the server (None = exact)."""
+    return quant.ps_quant()
 
 
 class _TCPTransport:
@@ -356,17 +396,28 @@ class PSClient:
                            arg2, seed, opt, opt_args, param_type)
 
     def param_set(self, key, value, opt=None, opt_args=None):
-        """Create-or-overwrite with an explicit value (executor bridge)."""
-        return self.t.call("param_set", key, np.asarray(value, np.float32),
+        """Create-or-overwrite with an explicit value (executor bridge).
+        Rides the quantized wire when HETU_PS_QUANT is set (the resync/
+        replication paths move big tables through here), so replica
+        rebuilds pay int8 bytes too; small control-plane arrays stay
+        exact (quant.WIRE_MIN_SIZE floor)."""
+        return self.t.call("param_set", key,
+                           _q_encode(np.asarray(value, np.float32)),
                            opt, opt_args)
 
     def pull(self, key, async_=False):
         if async_:
-            return self._pool.submit(self.t.call, "pull", key)
+            return self._pool.submit(self._pull_sync, key)
+        return self._pull_sync(key)
+
+    def _pull_sync(self, key):
+        q = _q_mode()
+        if q:
+            return _q_decode(self.t.call("pull", key, quant=q))
         return self.t.call("pull", key)
 
     def push(self, key, grad, async_=False):
-        grad = np.asarray(grad, np.float32)
+        grad = _q_encode(np.asarray(grad, np.float32))
         if async_:
             return self._pool.submit(self.t.call, "push", key, grad)
         return self.t.call("push", key, grad)
@@ -374,7 +425,14 @@ class PSClient:
     def dd_pushpull(self, key, grad, async_=False):
         grad = np.asarray(grad, np.float32)
         if async_:
-            return self._pool.submit(self.t.call, "dd_pushpull", key, grad)
+            return self._pool.submit(self._dd_pushpull_sync, key, grad)
+        return self._dd_pushpull_sync(key, grad)
+
+    def _dd_pushpull_sync(self, key, grad):
+        q = _q_mode()
+        if q:
+            return _q_decode(self.t.call(
+                "dd_pushpull", key, _q_encode(grad), quant=q))
         return self.t.call("dd_pushpull", key, grad)
 
     # The three sparse verbs route through the server's native C++ van
@@ -472,6 +530,10 @@ class PSClient:
                 # frame): nothing was applied and the connection is
                 # healthy — the python tier is the authority
                 pass
+        q = _q_mode()
+        if q:
+            return _q_decode(self.t.call("sparse_pull", key, ids,
+                                         quant=q))
         return self.t.call("sparse_pull", key, ids)
 
     def sparse_push(self, key, ids, rows, async_=False):
@@ -492,7 +554,7 @@ class PSClient:
                 self._van_push_failed(key, e)   # raises if maybe-applied
             except RuntimeError:
                 pass   # van rejected the frame: NOT applied, safe retry
-        return self.t.call("sparse_push", key, ids, rows)
+        return self.t.call("sparse_push", key, ids, _q_encode(rows))
 
     def sd_pushpull(self, key, ids, rows, pull_ids=None, async_=False):
         ids = np.asarray(ids, np.int64)
@@ -524,6 +586,11 @@ class PSClient:
                 # through the pull route, which has its own fallback
                 return self._sparse_pull_sync(
                     key, np.asarray(pull_ids, np.int64))
+        q = _q_mode()
+        if q:
+            return _q_decode(self.t.call(
+                "sd_pushpull", key, ids, _q_encode(rows), pull_ids,
+                quant=q))
         return self.t.call("sd_pushpull", key, ids, rows, pull_ids)
 
     def ss_pushpull(self, key, ids, rows, pull_ids, async_=False):

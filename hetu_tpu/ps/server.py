@@ -32,6 +32,7 @@ import numpy as np
 
 from . import faults, wire
 from .. import envvars
+from ..quant import QuantArray, maybe_decode, should_quantize, wire_chunk
 
 
 # ----------------------------------------------------------------- #
@@ -530,7 +531,8 @@ class PSServer:
 
         Always copies: np.asarray over a jax CPU array is zero-copy, and a
         donated step buffer would silently corrupt the stored table."""
-        value = np.array(value, np.float32, order="C", copy=True)
+        value = np.array(maybe_decode(value), np.float32, order="C",
+                         copy=True)
         optimizer = None
         if opt is not None:
             optimizer = SERVER_OPTIMIZERS[opt](**(opt_args or {}))
@@ -574,7 +576,7 @@ class PSServer:
         """In-place value overwrite that PRESERVES the server-side
         optimizer and its slot state (param_set would reset them) — the
         checkpoint-restore path."""
-        value = np.asarray(value, np.float32)
+        value = np.asarray(maybe_decode(value), np.float32)
         with self.lock:
             p = self.params.get(key)
             if p is None:
@@ -602,14 +604,28 @@ class PSServer:
         with p.lock:
             p.value[...] = np.load(os.path.join(path, f"ps_param_{key}.npy"))
 
-    def pull(self, key):
+    @staticmethod
+    def _q_out(value, quant):
+        """Quantize a pull response when the client asked for it (the
+        pull half of the HETU_PS_QUANT pair); qualifying values only —
+        tiny/integer payloads stay exact."""
+        if quant == "int8" and should_quantize(value):
+            return QuantArray.encode(value, wire_chunk())
+        return value
+
+    def pull(self, key, quant=None):
         p = self.params[key]
         with p.lock:
-            return p.value.copy()
+            return self._q_out(p.value.copy(), quant)
 
     def push(self, key, grad):
         """DensePush: apply grad through the server optimizer (or raw add
-        when no optimizer, matching reference kDensePush accumulate)."""
+        when no optimizer, matching reference kDensePush accumulate).
+        Quantized payloads (QuantArray) are dequantized HERE, before the
+        optimizer step — the server optimizes over the dequantized grad,
+        so primary and replica (which replays the same quantized frame)
+        walk identical trajectories."""
+        grad = maybe_decode(grad)
         p = self.params[key]
         with p.lock:
             if p.optimizer is not None:
@@ -617,16 +633,17 @@ class PSServer:
             else:
                 p.value += np.asarray(grad)
 
-    def dd_pushpull(self, key, grad):
+    def dd_pushpull(self, key, grad, quant=None):
+        grad = maybe_decode(grad)
         p = self.params[key]
         with p.lock:
             if p.optimizer is not None:
                 p.optimizer.apply_dense(p.value, np.asarray(grad), p.state)
             else:
                 p.value += np.asarray(grad)
-            return p.value.copy()
+            return self._q_out(p.value.copy(), quant)
 
-    def sparse_pull(self, key, ids):
+    def sparse_pull(self, key, ids, quant=None):
         p = self.params[key]
         ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
         with p.lock:
@@ -635,10 +652,11 @@ class PSServer:
                 out = np.empty((len(ids), p.value.shape[1]), np.float32)
                 _NATIVE.ps_sparse_gather(_fp(p.value), _ip(ids), _fp(out),
                                          len(ids), p.value.shape[1])
-                return out
-            return p.value[ids]
+                return self._q_out(out, quant)
+            return self._q_out(p.value[ids], quant)
 
     def sparse_push(self, key, ids, rows):
+        rows = maybe_decode(rows)
         p = self.params[key]
         ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
         rows = np.ascontiguousarray(
@@ -660,12 +678,13 @@ class PSServer:
                 else:
                     p.versions[np.unique(ids)] += 1
 
-    def sd_pushpull(self, key, ids, rows, pull_ids=None):
+    def sd_pushpull(self, key, ids, rows, pull_ids=None, quant=None):
         self.sparse_push(key, ids, rows)
-        return self.sparse_pull(key, pull_ids if pull_ids is not None else ids)
+        return self.sparse_pull(
+            key, pull_ids if pull_ids is not None else ids, quant=quant)
 
-    def ss_pushpull(self, key, ids, rows, pull_ids):
-        return self.sd_pushpull(key, ids, rows, pull_ids)
+    def ss_pushpull(self, key, ids, rows, pull_ids, quant=None):
+        return self.sd_pushpull(key, ids, rows, pull_ids, quant=quant)
 
     # ---------------- cache sync (HET protocol) ---------------- #
 

@@ -63,7 +63,8 @@ Quickstart (greedy results are token-identical to ``generate_fast``):
 from ..telemetry.slo import SLO, SLOMonitor
 from .request import Request, Result
 from .kv_manager import (
-    KVCacheManager, PagedKVManager, resolve_kv_block, round_up_pow2,
+    KVCacheManager, PagedKVManager, resolve_kv_block, resolve_kv_quant,
+    round_up_pow2,
 )
 from .metrics import COMPONENTS, ServingMetrics
 from .engine import ServingEngine, QueueFull
@@ -75,5 +76,5 @@ __all__ = [
     "RouterShed", "Request", "Result",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",
     "COMPONENTS", "SLO", "SLOMonitor",
-    "resolve_kv_block", "round_up_pow2",
+    "resolve_kv_block", "resolve_kv_quant", "round_up_pow2",
 ]

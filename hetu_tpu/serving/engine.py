@@ -39,7 +39,8 @@ from ..models.gpt_decode import (
     serve_prefill_batch_paged_fn, serve_prefill_chunk_fn,
     serve_prefill_fn,
 )
-from .kv_manager import KVCacheManager, PagedKVManager, resolve_kv_block
+from .kv_manager import (KVCacheManager, PagedKVManager, resolve_kv_block,
+                         resolve_kv_quant)
 from .metrics import ServingMetrics
 from .request import Request, Result
 
@@ -64,7 +65,14 @@ class ServingEngine:
     admission queue — ``submit`` raises QueueFull beyond it;
     max_seq_len: cap on prompt+generation (defaults to the model's
     max_position_embeddings; bucketed, so nearby deployments share
-    compiles); dtype: jnp.bfloat16 halves weights AND cache; log_path:
+    compiles); dtype: jnp.bfloat16 halves weights AND cache — default
+    FOLLOWS the params' own dtype (bf16 params → bf16 cache);
+    kv_quant: "int8" (default ``$HETU_KV_QUANT``) stores the KV cache
+    as int8 + per-(position, head) f32 scales, ~3.7x more tokens per
+    HBM byte — the decode kernels dequantize inside the online-softmax
+    loop, greedy outputs stay top-1-identical on the parity gates, and
+    the capacity win composes multiplicatively with paged prefix
+    sharing; log_path:
     JSONL event stream (default ``$HETU_SERVE_LOG``); donate: donate the
     cache pair to the jitted steps so XLA updates it in place (default
     True — without it every step copies the whole cache, ~3ms per 100MB;
@@ -95,11 +103,13 @@ class ServingEngine:
                  max_seq_len=None, name=None, dtype=None, log_path=None,
                  donate=True, fast_path=None, paged=None, kv_block=None,
                  pool_blocks=None, prefix_share=None, prefill_chunk=None,
-                 slo=None, tags=None):
+                 kv_quant=None, slo=None, tags=None):
         c = config
         self._name = _infer_name(params, name)
-        dt_ = dtype or jnp.float32
-        self.params = {k: _prep_param(v, dt_) for k, v in params.items()
+        # dtype=None FOLLOWS the params: bf16 weights stay bf16 and the
+        # cache below inherits that dtype (the old f32 default silently
+        # upcast bf16 params and doubled the cache)
+        self.params = {k: _prep_param(v, dtype) for k, v in params.items()
                        if k.startswith(self._name + "_")}
         # static checks (HETU_VALIDATE=1): params/config consistency
         # validated BEFORE the cache allocation and jit compiles below
@@ -109,6 +119,11 @@ class ServingEngine:
         Dh = c.hidden_size // c.num_attention_heads
         want = int(max_seq_len or c.max_position_embeddings)
         cdtype = self.params[f"{self._name}_wte_table"].dtype
+        # kv_quant="int8" (or $HETU_KV_QUANT) stores the cache as int8
+        # payload + per-(position, head) f32 scales — ~3.7x more tokens
+        # per HBM byte, dequantized inside the decode kernels
+        self.kv_quant = resolve_kv_quant(kv_quant)
+        kv_dtype = self.kv_quant or cdtype
         block = resolve_kv_block(paged, kv_block)
         self.paged = block > 0
         self.fast_path = _resolve_fast(fast_path)
@@ -116,7 +131,7 @@ class ServingEngine:
             self.kv = PagedKVManager(
                 layers=c.num_hidden_layers, heads=c.num_attention_heads,
                 head_dim=Dh, slots=slots, max_seq_len=want,
-                pos_cap=c.max_position_embeddings, dtype=cdtype,
+                pos_cap=c.max_position_embeddings, dtype=kv_dtype,
                 block=block, pool_blocks=pool_blocks,
                 prefix_share=prefix_share)
             chunk = (prefill_chunk if prefill_chunk is not None
@@ -132,7 +147,7 @@ class ServingEngine:
             self.kv = KVCacheManager(
                 layers=c.num_hidden_layers, heads=c.num_attention_heads,
                 head_dim=Dh, slots=slots, max_seq_len=want,
-                pos_cap=c.max_position_embeddings, dtype=cdtype)
+                pos_cap=c.max_position_embeddings, dtype=kv_dtype)
             self.chunk = 0
             self._prefill = serve_prefill_fn(donate)
             self._prefill_batch = (serve_prefill_batch_fn(donate)

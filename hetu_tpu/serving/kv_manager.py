@@ -29,7 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import envvars, telemetry
+from .. import envvars, quant, telemetry
 
 
 def round_up_pow2(n, floor=1):
@@ -49,6 +49,47 @@ def _bucket_prompt(p, s_max, pos_cap):
     if pos_cap is not None:
         b = min(b, int(pos_cap))
     return b
+
+
+def _is_int8(dtype):
+    """True when ``dtype`` selects the quantized int8 cache layout
+    (the string sentinel "int8" or jnp.int8 itself)."""
+    if dtype is None:
+        return False
+    if isinstance(dtype, str):
+        return dtype.strip().lower() == "int8"
+    try:
+        return jnp.dtype(dtype) == jnp.int8
+    except TypeError:
+        return False
+
+
+def resolve_kv_quant(kv_quant=None, dtype=None):
+    """Serving KV quantization selection shared by the engine and
+    bench: an explicit ``kv_quant`` ("int8"/None) wins, then an int8
+    ``dtype``, then ``$HETU_KV_QUANT``.  Returns "int8" or None."""
+    if _is_int8(dtype):
+        return "int8"
+    return quant.resolve_quant(kv_quant, "HETU_KV_QUANT")
+
+
+def _alloc_cache(shape, dtype, quantized):
+    """One cache array — or, quantized, the ``(int8 data, f32 scales)``
+    pair with one scale per (layer, slot/block, position, head): the
+    payload keeps ``shape``, the scales drop the head_dim axis.  The
+    pair is a pytree, so it threads through the jitted decode/prefill
+    functions (and their donation) exactly like a plain array."""
+    if quantized:
+        return (jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape[:-1], jnp.float32))
+    return jnp.zeros(shape, dtype)
+
+
+def cache_nbytes(cache):
+    """HBM bytes of a cache value (plain array or quantized pair)."""
+    if isinstance(cache, (tuple, list)):
+        return sum(int(a.nbytes) for a in cache)
+    return int(cache.nbytes)
 
 
 def resolve_kv_block(paged=None, block=None):
@@ -80,8 +121,12 @@ class KVCacheManager:
     sequences (bucketed up to a power of two); max_seq_len: longest
     prompt+generation to admit (bucketed, then capped at ``pos_cap`` —
     the model's max_position_embeddings, since the position table can't
-    index past it); dtype: cache dtype (follow the weights: bf16 halves
-    the cache).  Memory: L*B*S*H*Dh * itemsize * 2.
+    index past it); dtype: cache dtype — follow the weights (the engine
+    passes its param dtype, so bf16 params mean a bf16 cache), or
+    "int8"/jnp.int8 for the QUANTIZED layout: an int8 payload with one
+    f32 scale per (layer, slot, position, head), ~3.7x more tokens per
+    HBM byte, dequantized inside the decode kernels.  Memory:
+    L*B*S*H*Dh * itemsize * 2 (+ the scale planes when quantized).
     """
 
     def __init__(self, *, layers, heads, head_dim, slots, max_seq_len,
@@ -100,9 +145,10 @@ class KVCacheManager:
         self.n_slots = int(slots)
         self.s_max = int(s)
         self.pos_cap = int(pos_cap) if pos_cap is not None else self.s_max
-        self.cache_k = jnp.zeros(
-            (layers, self.n_slots, self.s_max, heads, head_dim), dtype)
-        self.cache_v = jnp.zeros_like(self.cache_k)
+        self.quant = "int8" if _is_int8(dtype) else None
+        shape = (layers, self.n_slots, self.s_max, heads, head_dim)
+        self.cache_k = _alloc_cache(shape, dtype, self.quant)
+        self.cache_v = _alloc_cache(shape, dtype, self.quant)
         self._free = list(range(self.n_slots))
         self.lengths = np.zeros(self.n_slots, np.int32)
         self.owner = [None] * self.n_slots
@@ -115,6 +161,13 @@ class KVCacheManager:
     @property
     def occupancy(self):
         return 1.0 - len(self._free) / self.n_slots
+
+    @property
+    def cache_bytes(self):
+        """Total HBM bytes of the cache pair (scales included when
+        quantized) — the equal-bytes denominator every capacity A/B
+        uses."""
+        return cache_nbytes(self.cache_k) + cache_nbytes(self.cache_v)
 
     def live(self):
         """Slot indices currently holding a sequence (ascending)."""
@@ -236,9 +289,10 @@ class PagedKVManager:
         if prefix_share is None:
             prefix_share = envvars.get_bool("HETU_KV_PREFIX_SHARE")
         self.prefix_share = bool(prefix_share)
-        self.cache_k = jnp.zeros(
-            (layers, self.n_blocks, self.block, heads, head_dim), dtype)
-        self.cache_v = jnp.zeros_like(self.cache_k)
+        self.quant = "int8" if _is_int8(dtype) else None
+        shape = (layers, self.n_blocks, self.block, heads, head_dim)
+        self.cache_k = _alloc_cache(shape, dtype, self.quant)
+        self.cache_v = _alloc_cache(shape, dtype, self.quant)
         self._free = list(range(1, self.n_blocks))   # 0 = scratch
         self.ref = np.zeros(self.n_blocks, np.int32)
         self.tables = np.zeros((self.n_slots, self.table_width), np.int32)
@@ -273,6 +327,12 @@ class PagedKVManager:
         """Blocks referenced by more than one holder (requests and/or
         the prefix cache)."""
         return int(np.sum(self.ref > 1))
+
+    @property
+    def cache_bytes(self):
+        """Total HBM bytes of the pool pair (scales included when
+        quantized)."""
+        return cache_nbytes(self.cache_k) + cache_nbytes(self.cache_v)
 
     @property
     def occupancy(self):
@@ -405,8 +465,9 @@ class PagedKVManager:
             self.ref[dst] = 1
             # device-side block copy: the forked block starts as an
             # exact copy of the shared one, then takes private writes
-            self.cache_k = self.cache_k.at[:, dst].set(self.cache_k[:, src])
-            self.cache_v = self.cache_v.at[:, dst].set(self.cache_v[:, src])
+            # (a quantized pool copies payload AND scale planes)
+            self.cache_k = self._block_copy(self.cache_k, src, dst)
+            self.cache_v = self._block_copy(self.cache_v, src, dst)
             row.append(dst)
             self.cow_copies += 1
             telemetry.inc("serve.cow_copies")
@@ -425,6 +486,16 @@ class PagedKVManager:
             telemetry.inc("serve.prefix_hits")
         self._gauges()
         return slot, cached
+
+    @staticmethod
+    def _block_copy(cache, src, dst):
+        """Copy pool block ``src`` onto ``dst`` (plain array or the
+        quantized (data, scale) pair — both leaves move together so a
+        COW fork never mixes one block's payload with another's
+        scales)."""
+        if isinstance(cache, (tuple, list)):
+            return tuple(a.at[:, dst].set(a[:, src]) for a in cache)
+        return cache.at[:, dst].set(cache[:, src])
 
     def advance(self, slot, n=1):
         """Record ``n`` more filled positions (blocks were reserved at
@@ -462,5 +533,6 @@ class PagedKVManager:
             "prefix_hits": self.prefix_hits,
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
-            "cache_bytes": int(self.cache_k.nbytes + self.cache_v.nbytes),
+            "quant": self.quant or "off",
+            "cache_bytes": self.cache_bytes,
         }

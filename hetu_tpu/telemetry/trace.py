@@ -39,6 +39,11 @@ REPLICA, with requests the router requeued off a dead replica
 (``router_hop`` records) exempt — they must finish on *some* replica.
 Balance is skipped when the input contains a ``flight_dump`` header —
 a flight recording is by definition a mid-flight snapshot.
+
+``--check`` also enforces the mixed-quantization rule: every
+``bench_row`` in the stream must carry the same ``quant`` stamp
+(``hetu_tpu.quant.active_modes()``) — quantized and exact measurements
+can never be compared silently.
 """
 
 from __future__ import annotations
@@ -255,6 +260,29 @@ def check_span_balance(events):
     return problems
 
 
+def check_quant_consistency(events):
+    """The mixed-quantization rule: every ``bench_row`` record in one
+    stream must carry the SAME ``quant`` stamp (rows predating the
+    stamp count as "off" — they were measured exact).  A stream mixing
+    int8-wire/int8-KV rows with exact rows is not comparable: the
+    quantized run moves ~4x fewer bytes, so ranking them side by side
+    silently rewards the lossy configuration.  Returns problem strings;
+    empty when consistent (or when there are no bench rows)."""
+    by_quant = {}
+    for e in events:
+        if e.get("event") != "bench_row":
+            continue
+        by_quant.setdefault(str(e.get("quant") or "off"), []).append(
+            str(e.get("config")))
+    if len(by_quant) <= 1:
+        return []
+    detail = "; ".join(f"{q}: {sorted(set(c))}"
+                       for q, c in sorted(by_quant.items()))
+    return [f"quant-mix: bench rows were measured under different "
+            f"quantization modes and cannot be compared ({detail}) — "
+            f"re-run one side or split the streams"]
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="hetu_trace",
@@ -296,11 +324,14 @@ def main(argv=None):
                                 f"{json.dumps(rec)[:160]}")
         balance = check_span_balance(events)
         problems.extend(balance)
+        qmix = check_quant_consistency(events)
+        problems.extend(qmix)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
                           "contract_violations": len(problems),
-                          "span_balance_violations": len(balance)}))
+                          "span_balance_violations": len(balance),
+                          "quant_mix_violations": len(qmix)}))
         return 1 if problems or bad else 0
 
     if args.export:
