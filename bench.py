@@ -1228,6 +1228,8 @@ def bench_serve(platform, reduced):
                                vocab, n_req)
     quant_ab = _serve_quant_ab(params, cfg, dt_, slots, s_max, vocab,
                                n_req)
+    spec_ab = _serve_spec_ab(params, cfg, dt_, platform, slots, s_max,
+                             vocab, n_req)
 
     art = {
         "platform": platform,
@@ -1257,6 +1259,7 @@ def bench_serve(platform, reduced):
         "paged_ab": paged_ab,
         "fleet_ab": fleet_ab,
         "quant_ab": quant_ab,
+        "spec_ab": spec_ab,
         "trace": {"seed": 1234, "n_requests": n_req,
                   "prompt_len": "4..16", "short_new_tokens": "8..32",
                   "straggler_every": 8, "straggler_new_tokens": straggle,
@@ -1635,6 +1638,176 @@ def _serve_fleet_ab(params, cfg, dt_, platform, slots, vocab, n_req):
                 "contract is scheduling + recovery, per-host fleets "
                 "are the chip story",
     }
+
+
+def _serve_spec_ab(params, cfg, dt_, platform, slots, s_max, vocab,
+                   n_req):
+    """Speculative vs plain decoding at EQUAL slots (ISSUE 10).
+
+    High-acceptance point: the measured model is the bench model with
+    every layer PAST the draft output-zeroed (attn_proj/ffn_wo weights
+    and biases set to 0; the reduced 2-layer CPU model is additionally
+    DEEPENED to 6 layers by replicating the zeroed block, so the
+    target:draft cost ratio resembles a real deployment instead of
+    2:1), so the truncated-layer draft's logits equal the target's
+    bitwise — greedy acceptance is 1.0 by construction while the
+    target still pays full-depth compute per verify, which is the
+    regime speculation exists for.  The temperature sweep then
+    degrades acceptance honestly: the target SAMPLES while the draft
+    proposes greedily, so hotter requests accept fewer drafts — a real
+    acceptance-rate sweep on one model.  Token identity spec-vs-plain
+    is asserted at EVERY sweep point (greedy and sampled alike: the
+    engine's accepted tokens are the target's own sequential samples),
+    the wall-clock tok/s floor is asserted at the high-acceptance
+    point, and TPOT percentiles come from real per-step token counts in
+    both modes.  CPU numbers are stamped live; the on-chip stage 4c
+    invocation records this section on chip — the A/B of record."""
+    from hetu_tpu.models import GPTConfig
+    from hetu_tpu.models.gpt_decode import _infer_name
+    from hetu_tpu.serving import Request, ServingEngine
+
+    name = _infer_name(params)
+    draft_layers = 1
+    spec_k = 4
+    L = max(cfg.num_hidden_layers, 6)
+    zeroed = ("attn_proj_weight", "attn_proj_bias",
+              "ffn_wo_weight", "ffn_wo_bias")
+    sp = dict(params)
+    for i in range(draft_layers, L):
+        src = min(i, cfg.num_hidden_layers - 1)
+        for suffix in ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias",
+                       "attn_q_weight", "attn_q_bias", "attn_k_weight",
+                       "attn_k_bias", "attn_v_weight", "attn_v_bias",
+                       "ffn_wi_weight", "ffn_wi_bias", *zeroed):
+            v = np.asarray(params[f"{name}_h{src}_{suffix}"])
+            sp[f"{name}_h{i}_{suffix}"] = (np.zeros_like(v)
+                                           if suffix in zeroed else v)
+    if L != cfg.num_hidden_layers:
+        cfg = GPTConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            num_hidden_layers=L,
+            num_attention_heads=cfg.num_attention_heads,
+            max_position_embeddings=cfg.max_position_embeddings,
+            batch_size=cfg.batch_size, seq_len=cfg.seq_len,
+            dropout_rate=0.0)
+
+    rng = np.random.RandomState(888)
+    trace = []
+    for _ in range(n_req):
+        P = int(rng.randint(4, 13))
+        trace.append((rng.randint(0, vocab, P).astype(np.int32),
+                      int(rng.randint(16, 33))))
+    useful = sum(g for _, g in trace)
+
+    def run(spec, temperature):
+        kw = dict(slots=slots, queue_limit=n_req, dtype=dt_,
+                  spec=(spec_k if spec else 0), spec_adapt=False,
+                  spec_draft_layers=draft_layers)
+        mk = lambda: [Request(prompt=p, max_new_tokens=g,  # noqa: E731
+                              temperature=temperature, seed=i)
+                      for i, (p, g) in enumerate(trace)]
+        warm = ServingEngine(sp, cfg, **kw)
+        warm.run(mk())
+        # best of two measured replays: the speedup floor below is
+        # ASSERTED, so a single background-load hiccup must not be
+        # able to fail the gate
+        best = None
+        for _ in range(2):
+            e_ = ServingEngine(sp, cfg, **kw)
+            t0 = time.perf_counter()
+            res_ = e_.run(mk())
+            w_ = time.perf_counter() - t0
+            if best is None or w_ < best[0]:
+                best = (w_, e_, res_)
+        wall, e, res = best
+        snap = e.metrics.snapshot()
+        row = {
+            "tokens_per_sec": round(useful / wall, 1),
+            "wall_s": round(wall, 3),
+            "steps": e.steps,
+            "tokens_per_step_mean": (round(snap["tokens_per_step_mean"],
+                                           3)
+                                     if snap["tokens_per_step_mean"]
+                                     else None),
+            # TPOT percentiles from REAL per-step emitted-token counts
+            # (serving/metrics.py step_tokens) in BOTH modes
+            "tpot_p50_s": snap["tpot_p50_s"],
+            "tpot_p99_s": snap["tpot_p99_s"],
+        }
+        if spec:
+            row.update({
+                "spec_k": spec_k,
+                "draft_layers": draft_layers,
+                "proposed": e.spec_proposed,
+                "accepted": e.spec_accepted,
+                "acceptance_rate": round(e.spec_acceptance or 0.0, 4),
+                "mean_k": round(e.spec_mean_k or 0.0, 2),
+                "waves": e.spec_waves,
+            })
+        return row, sorted(r.tokens.tolist() for r in res.values())
+
+    plain, out_p = run(False, 0.0)
+    spec_hi, out_s = run(True, 0.0)
+    speedup = (round(spec_hi["tokens_per_sec"]
+                     / plain["tokens_per_sec"], 3)
+               if plain["tokens_per_sec"] else None)
+
+    # acceptance-rate sweep via temperature: hotter target sampling
+    # accepts fewer greedy draft proposals; token identity must hold
+    # at every point (accepted tokens ARE the target's samples).  The
+    # greedy headline above is the acceptance-1.0 endpoint; one hot
+    # point bounds the other end (more temperatures on chip if wanted)
+    sweep = []
+    for t in (1.0,):
+        srow, souts = run(True, t)
+        _, pouts = run(False, t)
+        sweep.append({
+            "temperature": t,
+            "acceptance_rate": srow["acceptance_rate"],
+            "tokens_per_sec": srow["tokens_per_sec"],
+            "tokens_per_step_mean": srow["tokens_per_step_mean"],
+            "identical": souts == pouts,
+        })
+
+    result = {
+        "provenance": "live",
+        "platform": platform,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC",
+                                     time.gmtime()),
+        "trace": {"seed": 888, "n_requests": n_req,
+                  "prompt_len": "4..12", "new_tokens": "16..32",
+                  "useful_tokens": useful},
+        "spec_k": spec_k,
+        "draft_layers": draft_layers,
+        "target_layers": L,
+        "plain": plain,
+        "spec": spec_hi,
+        "speedup": speedup,
+        "greedy_identical": out_p == out_s,
+        "acceptance_sweep": sweep,
+        "note": "equal slots; layers past the draft output-zeroed (and "
+                "the reduced model deepened to 6 layers) so draft "
+                "logits == target logits (acceptance 1.0 at greedy) "
+                "while verify pays full depth — the high-acceptance "
+                "endpoint; sweep temperatures degrade acceptance "
+                "honestly (target samples vs greedy draft); CPU "
+                "harness runs the verify kernels in interpret mode — "
+                "stage 4c on chip is the A/B of record",
+    }
+    # acceptance floors asserted HERE so a speculative-path regression
+    # can never bank a spec_ab silently
+    assert result["greedy_identical"], (
+        "speculative greedy outputs diverged from the plain engine")
+    assert all(r["identical"] for r in sweep), (
+        f"speculative sampled outputs diverged in the sweep: {sweep}")
+    assert spec_hi["acceptance_rate"] >= 0.95, (
+        f"high-acceptance point accepted only "
+        f"{spec_hi['acceptance_rate']} of drafts: {spec_hi}")
+    assert speedup is not None and speedup >= 1.05, (
+        f"speculation at acceptance "
+        f"{spec_hi['acceptance_rate']} shows no wall-clock win "
+        f"(speedup {speedup}): {plain} vs {spec_hi}")
+    return result
 
 
 def _serve_phase_ab(params, cfg, dt_, reduced):

@@ -215,6 +215,77 @@ python bin/hetu_trace.py "$LOG/router_flight.jsonl" --check \
   exit 1
 }
 
+# 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
+#     must pass BEFORE any chip time is spent).  Speculative-decoding
+#     trace-replay gate: the draft-propose / batched-verify path must
+#     produce GREEDY TOKEN-IDENTICAL outputs vs the plain engine at
+#     acceptance 1.0 (layers past the draft output-zeroed so draft
+#     logits == target logits), retire every request in fewer waves
+#     than tokens, and leave a serve stream that passes the
+#     spec-attribution rule (hetu_trace --check: accepted + bonus + 1
+#     == n_generated per request).  The on-chip HETU_BENCH_SERVE run
+#     (stage 4c) banks spec_ab with native kernels — that run is the
+#     A/B of record; this gate proves the path before it is trusted.
+run spec_gate 900 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/spec_trace.jsonl" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.serving import Request, ServingEngine
+
+rng, hd, L = np.random.RandomState(0), 16, 2
+p = {"spg_wte_table": rng.randn(61, hd) * 0.05,
+     "spg_wpe": rng.randn(64, hd) * 0.05,
+     "spg_ln_f_scale": np.ones(hd), "spg_ln_f_bias": np.zeros(hd)}
+for i in range(L):
+    for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+                   ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+                   ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+        p[f"spg_h{i}_{w}_weight"] = rng.randn(*shp) * 0.05
+        p[f"spg_h{i}_{w}_bias"] = np.zeros(shp[1])
+    for ln in ("ln1", "ln2"):
+        p[f"spg_h{i}_{ln}_scale"] = np.ones(hd)
+        p[f"spg_h{i}_{ln}_bias"] = np.zeros(hd)
+# zero the post-draft layer's outputs: draft logits == target logits,
+# acceptance 1.0 — the high-acceptance endpoint of the A/B
+for wn in ("attn_proj_weight", "attn_proj_bias",
+           "ffn_wo_weight", "ffn_wo_bias"):
+    p[f"spg_h1_{wn}"] = np.zeros_like(p[f"spg_h1_{wn}"])
+cfg = GPTConfig(vocab_size=61, hidden_size=hd, num_hidden_layers=L,
+                num_attention_heads=2, max_position_embeddings=64,
+                batch_size=1, seq_len=64, dropout_rate=0.0)
+treq = np.random.RandomState(11)
+mk = lambda: [Request(prompt=[int(t) for t in treq.randint(0, 61, 4)],
+                      max_new_tokens=12, seed=s) for s in range(6)]
+treq = np.random.RandomState(11)
+plain = ServingEngine(p, cfg, slots=2, fast_path=False).run(mk())
+treq = np.random.RandomState(11)
+eng = ServingEngine(p, cfg, slots=2, fast_path=False, spec=3,
+                    spec_adapt=False, spec_draft_layers=1)
+res = eng.run(mk())
+a = sorted(r.tokens.tolist() for r in plain.values())
+b = sorted(r.tokens.tolist() for r in res.values())
+assert a == b, "speculative greedy diverged from the plain engine"
+assert eng.spec_proposed > 0 and \
+    eng.spec_accepted == eng.spec_proposed, \
+    (eng.spec_accepted, eng.spec_proposed)
+total = sum(r.n_generated for r in res.values())
+assert eng.spec_waves < total, (eng.spec_waves, total)
+print("spec gate OK: waves", eng.spec_waves, "of", total, "tokens,",
+      "accepted", eng.spec_accepted, "/", eng.spec_proposed)
+PYEOF
+if ! grep -q 'spec gate OK' "$LOG/spec_gate.log"; then
+  echo "speculative-decoding gate FAILED — see $LOG/spec_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/spec_trace.jsonl" --check \
+    > "$LOG/spec_trace_contract.log" || {
+  echo "spec-attribution/contract check FAILED — see" \
+       "$LOG/spec_trace_contract.log" >&2
+  exit 1
+}
+
 # 0. the rows a mid-capture wedge has previously cost us: the Aug-2
 #    recovery window measured bert_base/bert4l/gpt/resnet18 fresh, then
 #    the tunnel wedged INSIDE ctr_hybrid — so a fresh window banks the
@@ -250,8 +321,13 @@ HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 #     of record (paged_ab: prefix-heavy trace at equal cache bytes —
 #     block-table pool + prefix sharing vs slot rows; on chip the
 #     block-table decode kernel runs native and HETU_KV_BLOCK=auto
-#     selects paged).  Runs after decode so the scan compile is
-#     already in the shared compilation cache.
+#     selects paged), PLUS the speculative-decoding A/B of record
+#     (spec_ab: draft-propose / batched-verify vs plain decoding at
+#     equal slots, acceptance-rate sweep via temperature, greedy
+#     token-identity and the tok/s floor asserted in-bench; the
+#     multi-token verify kernel runs native here — the CPU stage-4e
+#     gate only proves the path).  Runs after decode so the scan
+#     compile is already in the shared compilation cache.
 HETU_BENCH_SERVE=1 run serve 3600 python bench.py
 
 # 4d. quantized-bytes A/Bs of record (ISSUE 9).  The serving half rides

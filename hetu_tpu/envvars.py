@@ -236,6 +236,22 @@ _reg("HETU_KV_PREFIX_SHARE", "bool", True,
      "prefixes — N requests with the same system prompt store its KV "
      "blocks once (registered prefixes are LRU-evicted under pool "
      "pressure).", "serving")
+_reg("HETU_SPEC_K", "int", 0,
+     "Speculative decoding: a truncated-layer draft proposes up to this "
+     "many tokens per slot per wave and the target verifies all k+1 "
+     "positions in ONE batched step (longest-prefix acceptance + bonus "
+     "token; outputs token-identical to plain decoding).  0 = off; "
+     "ServingEngine(spec=)/generate_fast(spec=) override.", "serving")
+_reg("HETU_SPEC_ADAPT", "bool", True,
+     "Adaptive speculation depth: a sliding acceptance-rate window "
+     "moves the per-wave draft length through the pow2 ladder "
+     "1..HETU_SPEC_K (raise on sustained high acceptance, back off on "
+     "low).  0 pins the configured k.", "serving")
+_reg("HETU_SPEC_DRAFT_LAYERS", "int", 0,
+     "Truncated-layer draft depth: the draft model is the target's "
+     "first N blocks plus the shared final LN and tied embedding head "
+     "(no separate weights or tokenizer).  0 = auto: max(1, L // 4).",
+     "serving")
 _reg("HETU_KV_CHUNK", "int", 0,
      "Paged KV chunked prefill: prompts fill their blocks in chunks of "
      "this many tokens interleaved with decode waves, so a long prompt "

@@ -31,6 +31,16 @@ int8 in HBM and dequantizes INSIDE the online-softmax loop (per
 (position, head) scales ride the same revisit index maps, so dead
 blocks skip their DMA too); no f32 pool is ever materialized, which is
 what lets ~3.7x more tokens fit per HBM byte.
+
+MULTI-TOKEN VERIFY (speculative decoding, ISSUE 10): the ``*_verify_*``
+kernels generalize q_len=1 to a ``k+1``-position q-block per slot —
+the target model's batched verification of a draft's proposals.  Same
+grid, same scalar-prefetched lengths/tables, same revisit-index DMA
+skipping; the q-block is causal INSIDE itself (query ``jq`` at absolute
+position ``lens - q_len + jq`` admits kv positions up to itself), so
+one kernel call scores all proposed positions exactly as ``k+1``
+sequential decode steps would.  Accumulators widen to one online-softmax
+state per (head, query) pair; everything else is unchanged.
 """
 
 from __future__ import annotations
@@ -354,6 +364,329 @@ def paged_block_decode_reference(q, pool_k, pool_v, lengths,
         k = k.astype(jnp.float32) * ks[..., None]
         v = v.astype(jnp.float32) * vs[..., None]
     return masked_decode_reference(q, k, v, lengths)
+
+
+# ------------------------------------------------------------------- #
+# multi-token verify kernels (speculative decoding)
+# ------------------------------------------------------------------- #
+
+
+def _query_positions(filled, qlen, nq):
+    """Absolute position of each query in a slot's verify q-block:
+    query ``jq`` sits at ``filled - qlen + jq``; dead queries
+    (``jq >= qlen``) clip to the last live position so their (discarded)
+    softmax rows stay finite, and a fully-inert slot (filled 0) clips
+    to 0 — the ``l == 0`` finalize guard zeroes its output anyway."""
+    qidx = jax.lax.broadcasted_iota(jnp.int32, (1, nq, 1), 1)
+    return jnp.clip(filled - qlen + qidx, 0,
+                    jnp.maximum(filled - 1, 0))
+
+
+def _online_softmax_multi(q, k, v, filled, qlen, j, bk, scale, m_ref,
+                          l_ref, acc_ref):
+    """One KV block's contribution to a VERIFY q-block's online softmax:
+    ``q`` [Q, H, Dh] against ``k``/``v`` [bk, H, Dh], one accumulator
+    row per (head, query).  The causal mask inside the q-block falls out
+    of the per-query absolute positions — query jq admits kv positions
+    up to ``filled - qlen + jq``, which for qlen=1 degenerates to the
+    single-query kernel's ``< filled`` mask."""
+    Q, H, Dh = q.shape
+    R = H * Q
+    # s[h, qj, s] = q[qj, h] . k[s, h] — batched over heads
+    qt = jnp.swapaxes(q, 0, 1)                            # [H, Q, Dh]
+    s = jax.lax.dot_general(
+        qt, k, (((2,), (2,)), ((0,), (1,))),
+        precision=_prec(q.dtype),
+        preferred_element_type=jnp.float32) * scale       # [H, Q, bk]
+    kv_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (H, Q, bk), 2)
+    posq = _query_positions(filled, qlen, Q)              # [1, Q, 1]
+    s = jnp.where(kv_pos <= posq, s, NEG_INF)
+    s = s.reshape(R, bk)
+    m_prev = m_ref[:, 0:1]
+    l_prev = l_ref[:, 0:1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - safe_m)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    alpha = jnp.exp(jnp.clip(m_prev - m_new, max=0.0))
+    alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.reshape(H, Q, bk).astype(v.dtype), v,
+        (((2,), (0,)), ((0,), (1,))),
+        precision=_prec(v.dtype),
+        preferred_element_type=jnp.float32)               # [H, Q, Dh]
+    acc_ref[:] = acc_ref[:] * alpha + pv.reshape(R, Dh)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _verify_finalize(o_ref, m_ref, l_ref, acc_ref, nq, heads, dh):
+    l = l_ref[:, 0:1]
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o = (acc_ref[:] / denom).reshape(heads, nq, dh)
+    o_ref[0] = jnp.swapaxes(o, 0, 1).astype(o_ref.dtype)
+
+
+def _verify_kernel(lens_ref, qlens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, scale, bk, n_kv, nq):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    filled = lens_ref[b]
+
+    @pl.when(j * bk < filled)
+    def _compute():
+        _online_softmax_multi(q_ref[0], k_ref[0], v_ref[0], filled,
+                              qlens_ref[b], j, bk, scale, m_ref, l_ref,
+                              acc_ref)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        _verify_finalize(o_ref, m_ref, l_ref, acc_ref, nq,
+                         q_ref.shape[2], q_ref.shape[3])
+
+
+def _verify_kernel_int8(lens_ref, qlens_ref, q_ref, k_ref, ks_ref,
+                        v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                        scale, bk, n_kv, nq):
+    """Int8 twin of ``_verify_kernel`` (see ``_decode_kernel_int8`` for
+    the dequant-inside-the-loop rationale)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    filled = lens_ref[b]
+
+    @pl.when(j * bk < filled)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32) * ks_ref[0][..., None]
+        v = v_ref[0].astype(jnp.float32) * vs_ref[0][..., None]
+        _online_softmax_multi(q_ref[0].astype(jnp.float32), k, v,
+                              filled, qlens_ref[b], j, bk, scale,
+                              m_ref, l_ref, acc_ref)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        _verify_finalize(o_ref, m_ref, l_ref, acc_ref, nq,
+                         q_ref.shape[2], q_ref.shape[3])
+
+
+def paged_verify_attention(q, k, v, lengths, q_lens, *, block_k=128,
+                           k_scale=None, v_scale=None, interpret=None):
+    """A ``Q``-position verify q-block per slot over the slot-contiguous
+    ragged cache.
+
+    q: [B, Q, H, Dh] — this wave's q-block per slot (the draft's k
+    proposals plus the carried token, already written to the cache);
+    k, v: [B, S_max, H, Dh]; lengths: [B] int32 — the slot's filled
+    count INCLUDING the q-block's live positions; q_lens: [B] int32 —
+    live queries per slot (rows jq >= q_lens[b] are inert: their output
+    is finite garbage the host discards).  Returns o [B, Q, H, Dh].
+    Each slot still fetches only ``ceil(lengths[b] / block_k)`` KV
+    blocks; the causal structure inside the q-block is enforced by
+    per-query position masks, so the call scores exactly what q_lens[b]
+    sequential decode steps would.  Int8 caches: pass
+    ``k_scale``/``v_scale`` [B, S_max, H] f32 as in
+    :func:`paged_decode_attention`."""
+    B, Q, H, Dh = q.shape
+    S = k.shape[1]
+    bk = _fit_block(block_k, S)
+    n_kv = S // bk
+    scale = Dh ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    quantized = k_scale is not None
+
+    def kv_idx(b, j, lens_ref, qlens_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bk
+        return (b, jnp.minimum(j, last), 0, 0)
+
+    def sc_idx(b, j, lens_ref, qlens_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bk
+        return (b, jnp.minimum(j, last), 0)
+
+    if quantized:
+        kernel = _verify_kernel_int8
+        in_specs = [
+            pl.BlockSpec((1, Q, H, Dh),
+                         lambda b, j, lens, qlens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+            pl.BlockSpec((1, bk, H), sc_idx),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+            pl.BlockSpec((1, bk, H), sc_idx),
+        ]
+        operands = (q, k, k_scale, v, v_scale)
+    else:
+        kernel = _verify_kernel
+        in_specs = [
+            pl.BlockSpec((1, Q, H, Dh),
+                         lambda b, j, lens, qlens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+            pl.BlockSpec((1, bk, H, Dh), kv_idx),
+        ]
+        operands = (q, k, v)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_kv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Q, H, Dh),
+                               lambda b, j, lens, qlens: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H * Q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((H * Q, _LANES), jnp.float32),   # running denom
+            pltpu.VMEM((H * Q, Dh), jnp.float32),       # output acc
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(kernel, scale=scale, bk=bk, n_kv=n_kv, nq=Q),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Q, H, Dh), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q_lens.astype(jnp.int32), *operands)
+
+
+def _block_verify_kernel(lens_ref, qlens_ref, bt_ref, q_ref, k_ref,
+                         v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale,
+                         bk, n_kv, nq):
+    del bt_ref
+    _verify_kernel(lens_ref, qlens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, scale=scale, bk=bk,
+                   n_kv=n_kv, nq=nq)
+
+
+def _block_verify_kernel_int8(lens_ref, qlens_ref, bt_ref, q_ref,
+                              k_ref, ks_ref, v_ref, vs_ref, o_ref,
+                              m_ref, l_ref, acc_ref, *, scale, bk,
+                              n_kv, nq):
+    del bt_ref
+    _verify_kernel_int8(lens_ref, qlens_ref, q_ref, k_ref, ks_ref,
+                        v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+                        scale=scale, bk=bk, n_kv=n_kv, nq=nq)
+
+
+def paged_block_verify_attention(q, pool_k, pool_v, lengths, q_lens,
+                                 block_tables, *, k_scale=None,
+                                 v_scale=None, interpret=None):
+    """``paged_verify_attention`` over the BLOCK-TABLE paged pool: the
+    verify q-block reads each slot's live pool blocks through its
+    scalar-prefetched table row, exactly like
+    :func:`paged_block_decode_attention` (dead entries revisit = DMA
+    skipped; shared prefix blocks stored once), with the q-block causal
+    masks of the contiguous verify kernel.  q: [B, Q, H, Dh]; pools
+    [N_blocks, bs, H, Dh]; lengths/q_lens [B]; block_tables [B, T].
+    Int8 pools pass ``k_scale``/``v_scale`` [N_blocks, bs, H] f32."""
+    B, Q, H, Dh = q.shape
+    bs = pool_k.shape[1]
+    T = block_tables.shape[1]
+    scale = Dh ** -0.5
+    if interpret is None:
+        interpret = _use_interpret()
+    quantized = k_scale is not None
+
+    def kv_idx(b, j, lens_ref, qlens_ref, bt_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bs
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0, 0)
+
+    def sc_idx(b, j, lens_ref, qlens_ref, bt_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bs
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0)
+
+    if quantized:
+        kernel = _block_verify_kernel_int8
+        in_specs = [
+            pl.BlockSpec((1, Q, H, Dh),
+                         lambda b, j, lens, qlens, bt: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+            pl.BlockSpec((1, bs, H), sc_idx),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+            pl.BlockSpec((1, bs, H), sc_idx),
+        ]
+        operands = (q, pool_k, k_scale, pool_v, v_scale)
+    else:
+        kernel = _block_verify_kernel
+        in_specs = [
+            pl.BlockSpec((1, Q, H, Dh),
+                         lambda b, j, lens, qlens, bt: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+            pl.BlockSpec((1, bs, H, Dh), kv_idx),
+        ]
+        operands = (q, pool_k, pool_v)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, T),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Q, H, Dh),
+                               lambda b, j, lens, qlens, bt:
+                               (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H * Q, _LANES), jnp.float32),
+            pltpu.VMEM((H * Q, _LANES), jnp.float32),
+            pltpu.VMEM((H * Q, Dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(kernel, scale=scale, bk=bs, n_kv=T, nq=Q),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Q, H, Dh), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q_lens.astype(jnp.int32),
+      block_tables.astype(jnp.int32), *operands)
+
+
+def masked_verify_reference(q, k, v, lengths, q_lens, k_scale=None,
+                            v_scale=None):
+    """Exact masked oracle (f32) for the verify kernels: per-query
+    causal masks over the full padded cache — the same arithmetic
+    ``_verify_step``'s einsum path runs."""
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[..., None]
+        v = v.astype(jnp.float32) * v_scale[..., None]
+    B, Q = q.shape[:2]
+    S = k.shape[1]
+    posq = jnp.clip(
+        (lengths - q_lens)[:, None] + jnp.arange(Q)[None, :], 0,
+        jnp.maximum(lengths - 1, 0)[:, None])              # [B, Q]
+    s = jnp.einsum("bqhd,bshd->bqhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
+    live = jnp.arange(S)[None, None, None, :] <= posq[:, :, None, None]
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bqhs,bshd->bqhd", p, v.astype(jnp.float32))
+    return out * (lengths > 0)[:, None, None, None]
+
+
+def paged_block_verify_reference(q, pool_k, pool_v, lengths, q_lens,
+                                 block_tables, k_scale=None,
+                                 v_scale=None):
+    """Gather-then-mask oracle for the block-table verify kernel."""
+    B = q.shape[0]
+    bs = pool_k.shape[1]
+    T = block_tables.shape[1]
+    k = pool_k[block_tables].reshape(B, T * bs, *pool_k.shape[2:])
+    v = pool_v[block_tables].reshape(B, T * bs, *pool_v.shape[2:])
+    if k_scale is not None:
+        ks = k_scale[block_tables].reshape(B, T * bs,
+                                           *k_scale.shape[2:])
+        vs = v_scale[block_tables].reshape(B, T * bs,
+                                           *v_scale.shape[2:])
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
+    return masked_verify_reference(q, k, v, lengths, q_lens)
 
 
 def masked_decode_reference(q, k, v, lengths, k_scale=None,

@@ -159,6 +159,29 @@ def _resolve_fast(mode=None):
     return jax.default_backend() == "tpu"
 
 
+def resolve_spec_k(spec=None):
+    """Speculative-decoding depth shared by the engine and offline
+    ``generate_fast``: an explicit ``spec`` wins (None falls back to
+    ``$HETU_SPEC_K``); 0 = off.  The value is the MAXIMUM draft tokens
+    per wave — the adaptive controller moves within [1, k]."""
+    if spec is None:
+        spec = envvars.get_int("HETU_SPEC_K")
+    return max(int(spec or 0), 0)
+
+
+def resolve_draft_layers(layers, total_layers):
+    """Truncated-layer draft depth: explicit ``layers`` wins, then
+    ``$HETU_SPEC_DRAFT_LAYERS``, then the auto policy max(1, L // 4).
+    The draft IS the target's first ``layers`` blocks plus the shared
+    final LN and tied embedding head — no separate weights, tokenizer,
+    or loading path to maintain (early-exit style drafting)."""
+    if not layers:
+        layers = envvars.get_int("HETU_SPEC_DRAFT_LAYERS")
+    if not layers or int(layers) <= 0:
+        layers = max(1, int(total_layers) // 4)
+    return min(int(layers), int(total_layers))
+
+
 def _ln(x, scale, bias, eps=1e-5):
     # statistics in f32 regardless of the compute dtype: bf16 mean/var
     # over outlier channels (GPT-2 residual streams have them) loses
@@ -630,6 +653,210 @@ def _serve_decode_paged(params, cfg_tuple, cache_k, cache_v, tables,
     return sampled, cache_k, cache_v, new_keys
 
 
+# ---------------------- speculative decoding ---------------------- #
+#
+# Draft-propose / batched-verify (ISSUE 10): a truncated-layer DRAFT —
+# the target's first ``L_draft`` blocks plus the shared final LN and
+# tied embedding head, i.e. the same param dict under a shorter
+# cfg_tuple — proposes ``k`` greedy tokens per slot inside ONE scanned
+# dispatch (``_spec_propose``), and the target scores all ``k+1``
+# positions in ONE batched step (``_verify_step``: the teacher-forced
+# forward over a per-slot ragged q-block, causal inside the block).
+# Longest-prefix acceptance plus the bonus token keeps outputs
+# TOKEN-IDENTICAL to the non-speculative path — greedy trivially, and
+# sampled too, because every emitted token is the target's OWN
+# sequential sample: position j consumes the j-th split of the
+# request's rng stream (``_spec_sample`` returns the key after every
+# split so the host can resume the stream at exactly the accepted
+# count), and the logits at the first mismatch are conditioned on an
+# all-accepted prefix, so the "bonus" sample is the true next token.
+
+
+def _verify_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
+                 q_len, attn="masked", block_tables=None):
+    """Multi-position verify: slot b consumes ``tokens[b, :q_len[b]]``
+    at positions ``pos[b] .. pos[b]+q_len[b]-1`` in ONE batched step.
+    Returns (logits [B, Q, V] f32, new cache_k, new cache_v) — row
+    ``logits[b, j]`` is the next-token distribution after input j,
+    exactly what ``j+1`` sequential ``_decode_step`` calls would yield
+    (each query attends to the whole written prefix INCLUDING the
+    q-block's own causal positions).
+
+    Dead positions (``j >= q_len[b]``): contiguous caches write them
+    at their natural ``pos+j`` slots — beyond the slot's live length,
+    never admitted by a mask, overwritten before use — with the writes
+    issued LAST-LIVE-WINS (descending j), so a dead tail clipped to
+    ``S_max-1`` can never clobber a live boundary write; paged caches
+    route them to scratch block 0 like every other inert write.
+    ``attn``/``block_tables`` select the implementation and layout as
+    in ``_decode_step``."""
+    name, L, H, Dh, S_max = cfg_tuple
+    B, Q = tokens.shape
+    hdim = H * Dh
+    paged = block_tables is not None
+    bidx = jnp.arange(B)
+    posns = pos[:, None] + jnp.arange(Q)[None, :]          # [B, Q]
+    valid = jnp.arange(Q)[None, :] < q_len[:, None]        # [B, Q]
+    lens = (pos + q_len).astype(jnp.int32)   # filled after the writes
+    wpe = params[f"{name}_wpe"]
+    h = params[f"{name}_wte_table"][tokens] \
+        + wpe[jnp.clip(posns, 0, wpe.shape[0] - 1)]        # [B, Q, hd]
+    if attn == "ragged":
+        from ..kernels.decode_attention import (
+            paged_block_verify_attention, paged_verify_attention,
+        )
+    if paged:
+        bs_blk = _kv_shape(cache_k)[2]
+        T = block_tables.shape[1]
+        posc = jnp.clip(posns, 0, S_max - 1)
+        wblk = jnp.where(valid,
+                         block_tables[bidx[:, None], posc // bs_blk], 0)
+        woff = posc % bs_blk
+        span = T * bs_blk
+        ctx = jnp.arange(span)[None, None, :]
+    else:
+        ctx = jnp.arange(S_max)[None, None, :]
+    live = ctx <= posns[:, :, None]                        # [B, Q, S]
+    for i in range(L):
+        us = f"{name}_h{i}"
+        x = _ln(h, params[f"{us}_ln1_scale"], params[f"{us}_ln1_bias"])
+        q = (x @ params[f"{us}_attn_q_weight"]
+             + params[f"{us}_attn_q_bias"]).reshape(B, Q, H, Dh)
+        k = (x @ params[f"{us}_attn_k_weight"]
+             + params[f"{us}_attn_k_bias"]).reshape(B, Q, H, Dh)
+        v = (x @ params[f"{us}_attn_v_weight"]
+             + params[f"{us}_attn_v_bias"]).reshape(B, Q, H, Dh)
+        if paged:
+            cache_k = _kv_scatter(cache_k, (i, wblk, woff), k)
+            cache_v = _kv_scatter(cache_v, (i, wblk, woff), v)
+        else:
+            # descending j so the (clipped) dead tail is written FIRST
+            # and any live boundary write lands last and wins
+            for jq in reversed(range(Q)):
+                pw = jnp.minimum(posns[:, jq], S_max - 1)
+                cache_k = _kv_scatter(cache_k, (i, bidx, pw), k[:, jq])
+                cache_v = _kv_scatter(cache_v, (i, bidx, pw), v[:, jq])
+        if _kv_q(cache_k):
+            ks, ksc = cache_k[0][i], cache_k[1][i]
+            vs, vsc = cache_v[0][i], cache_v[1][i]
+        else:
+            ks, vs = cache_k[i], cache_v[i]
+            ksc = vsc = None
+        if paged and attn == "ragged":
+            o = paged_block_verify_attention(
+                q, ks, vs, lens, q_len, block_tables, k_scale=ksc,
+                v_scale=vsc).reshape(B, Q, hdim)
+        elif paged:
+            kg = ks[block_tables].reshape(B, span, H, Dh)
+            vg = vs[block_tables].reshape(B, span, H, Dh)
+            if ksc is not None:
+                kg = kg.astype(jnp.float32) * ksc[block_tables].reshape(
+                    B, span, H)[..., None]
+                vg = vg.astype(jnp.float32) * vsc[block_tables].reshape(
+                    B, span, H)[..., None]
+            s = jnp.einsum("bqhd,bshd->bqhs", q, kg) * (Dh ** -0.5)
+            s = jnp.where(live[:, :, None, :], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bqhs,bshd->bqhd", p, vg).reshape(B, Q, hdim)
+        elif attn == "ragged":
+            o = paged_verify_attention(
+                q, ks, vs, lens, q_len, k_scale=ksc,
+                v_scale=vsc).reshape(B, Q, hdim)
+        else:
+            if ksc is not None:
+                ks = kv_decode(ks, ksc)
+                vs = kv_decode(vs, vsc)
+            s = jnp.einsum("bqhd,bshd->bqhs", q, ks) * (Dh ** -0.5)
+            s = jnp.where(live[:, :, None, :], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bqhs,bshd->bqhd", p, vs).reshape(B, Q, hdim)
+        o = o @ params[f"{us}_attn_proj_weight"] \
+            + params[f"{us}_attn_proj_bias"]
+        h = h + o
+        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
+                       + params[f"{us}_ffn_wi_bias"])
+        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
+        h = h + f
+    h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
+    logits = (h @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
+        + params.get(f"{name}_head_bias", 0.0)
+    return logits, cache_k, cache_v
+
+
+def _spec_sample(logits, temperature, top_k, rng_keys):
+    """Sequential per-position sampling over a verify q-block: position
+    j's token comes from the (j+1)-th split of each slot's rng stream —
+    EXACTLY the splits j+1 non-speculative steps would consume — and
+    ``keys_after[b, j]`` is the stream state after those splits, so the
+    host resumes at the accepted count and the stream stays aligned
+    with the non-speculative path token for token."""
+    B, Q = logits.shape[:2]
+    toks, after = [], []
+    keys = rng_keys
+    for j in range(Q):
+        splits = jax.vmap(jax.random.split)(keys)          # [B,2,2]
+        keys, subs = splits[:, 0], splits[:, 1]
+        toks.append(jax.vmap(_sample_slot)(logits[:, j], temperature,
+                                           top_k, subs))
+        after.append(keys)
+    return jnp.stack(toks, 1), jnp.stack(after, 1)
+
+
+def _serve_verify(params, cfg_tuple, cache_k, cache_v, pos, tokens,
+                  q_len, temperature, top_k, rng_keys, attn="masked"):
+    """One fused VERIFY wave over all slots (contiguous layout): write
+    + score the q-block, then sample every position from each slot's
+    own rng stream.  Returns (sampled [B, Q], cache_k, cache_v,
+    keys_after [B, Q, 2])."""
+    logits, cache_k, cache_v = _verify_step(
+        params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
+        attn=attn)
+    sampled, after = _spec_sample(logits, temperature, top_k, rng_keys)
+    return sampled, cache_k, cache_v, after
+
+
+def _serve_verify_paged(params, cfg_tuple, cache_k, cache_v, tables,
+                        pos, tokens, q_len, temperature, top_k,
+                        rng_keys, attn="masked"):
+    """``_serve_verify`` over the block-table paged pool (``q_len`` 0
+    marks inert slots — mid-prefill or free — whose writes are routed
+    to scratch and whose samples/keys the host discards)."""
+    logits, cache_k, cache_v = _verify_step(
+        params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
+        attn=attn, block_tables=tables)
+    sampled, after = _spec_sample(logits, temperature, top_k, rng_keys)
+    return sampled, cache_k, cache_v, after
+
+
+def _spec_propose(params, cfg_tuple, cache_k, cache_v, pos, token, k):
+    """``k`` greedy draft steps inside ONE dispatch: a lax.scan over
+    the (truncated-layer) draft's ``_decode_step``, each step feeding
+    its own argmax forward — one jitted call per wave instead of k
+    sequential dispatches, which is what makes drafting cheap enough
+    to pay for itself even off-chip.  The draft always proposes
+    greedily (no rng): acceptance, not sampling fidelity, is its job —
+    the target's verify pass owns the actual sampling.  Returns
+    (draft_tokens [B, k], cache_k, cache_v).
+
+    The scan runs k+1 steps and DISCARDS the last proposal: step k
+    exists to write the k-th draft token's OWN K/V into the draft
+    cache, so that after a fully-accepted wave (all k drafts kept) the
+    draft's history has no hole at position pos+k — without it the
+    next wave's proposals attend over stale garbage there and the
+    acceptance rate quietly collapses."""
+    def step(carry, _):
+        ck, cv, tok, p = carry
+        logits, ck, cv = _decode_step(params, cfg_tuple, ck, cv, p, tok)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (ck, cv, nxt, p + 1), nxt
+
+    (cache_k, cache_v, _, _), toks = jax.lax.scan(
+        step, (cache_k, cache_v, token, pos.astype(jnp.int32)), None,
+        length=k + 1)
+    return jnp.swapaxes(toks, 0, 1)[:, :k], cache_k, cache_v
+
+
 def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
                          tokens, pos_off, n_tok, temperature, top_k,
                          rng_key, wblk, woff):
@@ -766,6 +993,41 @@ def serve_decode_paged_fn(donate=True, attn="masked"):
 
 
 @functools.lru_cache(maxsize=None)
+def serve_verify_fn(donate=True, attn="masked"):
+    """Jitted ``_serve_verify`` — the speculative wave's batched
+    verification step over the contiguous cache (see
+    ``serve_prefill_fn`` for the donation rationale).  Compiles per
+    q-block width Q = spec_k + 1; adaptive k varies per-slot ``q_len``
+    INSIDE one compile, so the ladder is one entry per engine."""
+    kw = {"static_argnames": ("cfg_tuple", "attn")}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    fn = jax.jit(_serve_verify, **kw)
+    return functools.partial(fn, attn=attn)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_verify_paged_fn(donate=True, attn="masked"):
+    """Jitted ``_serve_verify_paged`` — the block-table verify wave."""
+    kw = {"static_argnames": ("cfg_tuple", "attn")}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    fn = jax.jit(_serve_verify_paged, **kw)
+    return functools.partial(fn, attn=attn)
+
+
+@functools.lru_cache(maxsize=None)
+def spec_propose_fn(donate=True):
+    """Jitted ``_spec_propose`` (draft cache pair donated).  Compiles
+    per draft length k — the adaptive controller moves k through a
+    pow2 ladder, so at most log2(spec_k)+1 entries exist."""
+    kw = {"static_argnames": ("cfg_tuple", "k")}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    return jax.jit(_spec_propose, **kw)
+
+
+@functools.lru_cache(maxsize=None)
 def serve_prefill_chunk_fn(donate=True):
     """Jitted ``_serve_prefill_chunk``; compiles per (chunk bucket,
     table width) — the engine pads chunks to one fixed pow2 bucket, so
@@ -892,9 +1154,98 @@ def tp_shard_params(params, mesh, config, axis="tp", name=None):
             for k, v in params.items() if k.startswith(name + "_")}
 
 
+def _generate_spec(params, cfg_tuple, draft_layers, prompts, num_tokens,
+                   temperature, top_k, seed, eos_id, pad_id, spec_k):
+    """Offline speculative generation: the serving building blocks
+    (batched flash prefill, scanned draft propose, batched verify)
+    driven by a host loop over the whole batch in lockstep — rows whose
+    acceptance differs simply sit at different positions (the per-slot
+    position vectors are the hard part, and they already exist).
+    Greedy outputs are token-identical to the non-speculative
+    ``generate_fast`` paths; sampling draws per-row rng streams
+    (PRNGKey(seed + row)), so sampled outputs match the serving
+    engine's per-request streams, not the offline batch-keyed scan.
+    Finished rows ride along with q_len 0 (their state frozen)."""
+    name, L, H, Dh, S_max = cfg_tuple
+    cfg_d = (name, draft_layers, H, Dh, S_max)
+    B, P = prompts.shape
+    cdtype = params[f"{name}_wte_table"].dtype
+    Q = spec_k + 1
+    ck = jnp.zeros((L, B, S_max, H, Dh), cdtype)
+    cv = jnp.zeros((L, B, S_max, H, Dh), cdtype)
+    dck = jnp.zeros((draft_layers, B, S_max, H, Dh), cdtype)
+    dcv = jnp.zeros((draft_layers, B, S_max, H, Dh), cdtype)
+    P_b = min(_pow2(P, floor=8), S_max)
+    padb = np.zeros((B, P_b), np.int32)
+    padb[:, :P] = prompts
+    padb = jnp.asarray(padb)
+    slots = np.arange(B, dtype=np.int32)
+    lens = np.full(B, P, np.int32)
+    temps = np.full(B, temperature, np.float32)
+    topks = np.full(B, top_k, np.int32)
+    keys = np.stack([np.asarray(jax.random.PRNGKey(seed + r), np.uint32)
+                     for r in range(B)])
+    prefill = serve_prefill_batch_fn(True)
+    first, ck, cv, keys = prefill(params, cfg_tuple, ck, cv, slots,
+                                  padb, lens, temps, topks, keys)
+    # draft cache prefill: same prompts, truncated depth; its sampled
+    # tokens and key splits are discarded (the draft never samples)
+    _, dck, dcv, _ = prefill(params, cfg_d, dck, dcv, slots, padb,
+                             lens, temps, topks, np.array(keys))
+    propose = spec_propose_fn(True)
+    verify = serve_verify_fn(True)
+    first = np.asarray(first, np.int32)
+    keys = np.array(keys, np.uint32)
+    pos = np.full(B, P, np.int32)
+    tok = first.copy()
+    total = P + int(num_tokens)
+    out = np.full((B, total), pad_id, np.int32)
+    out[:, :P] = prompts
+    out[:, P] = first
+    emitted = np.ones(B, np.int32)
+    done = emitted >= num_tokens
+    if eos_id is not None:
+        done |= first == eos_id
+    while not done.all():
+        draft, dck, dcv = propose(params, cfg_d, dck, dcv, pos, tok,
+                                  k=spec_k)
+        draft = np.asarray(draft)
+        tokens = np.zeros((B, Q), np.int32)
+        tokens[:, 0] = tok
+        tokens[:, 1:] = draft
+        qlen = np.where(done, 0,
+                        np.minimum(Q, num_tokens - emitted)).astype(
+                            np.int32)
+        tgt, ck, cv, after = verify(params, cfg_tuple, ck, cv, pos,
+                                    tokens, qlen, temps, topks, keys)
+        tgt = np.asarray(tgt)
+        after = np.array(after, np.uint32)
+        for b in range(B):
+            if done[b]:
+                continue
+            ql = int(qlen[b])
+            a = 0
+            while a < ql - 1 and tgt[b, a] == tokens[b, a + 1]:
+                a += 1
+            emit = [int(t) for t in tgt[b, :a + 1]]
+            if eos_id is not None and eos_id in emit:
+                emit = emit[:emit.index(eos_id) + 1]
+            n = len(emit)
+            out[b, P + emitted[b]:P + emitted[b] + n] = emit
+            emitted[b] += n
+            pos[b] += n
+            tok[b] = emit[-1]
+            keys[b] = after[b, n - 1]
+            if emitted[b] >= num_tokens or \
+                    (eos_id is not None and emit[-1] == eos_id):
+                done[b] = True
+    return out
+
+
 def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
                   top_k=0, seed=0, name=None, dtype=None, eos_id=None,
-                  pad_id=0, prefill=None):
+                  pad_id=0, prefill=None, spec=None,
+                  spec_draft_layers=None):
     """KV-cached generation.
 
     params: {name: array} (e.g. ``executor.var_values`` — pass it
@@ -914,7 +1265,15 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
       attention, pow2-bucketed prompt length), "scan" teacher-forces it
       token by token inside the scan (the reference), default consults
       ``$HETU_SERVE_FAST`` then auto-selects flash on TPU — greedy
-      outputs are identical either way.
+      outputs are identical either way; spec: > 0 enables speculative
+      decoding (default ``$HETU_SPEC_K``): a truncated-layer draft
+      (``spec_draft_layers`` of this model's own blocks, default
+      ``$HETU_SPEC_DRAFT_LAYERS`` / max(1, L // 4)) proposes ``spec``
+      tokens per wave and the target verifies them in one batched
+      step — greedy outputs stay token-identical to the
+      non-speculative paths; sampled outputs draw per-ROW rng streams
+      (seed + row), matching the serving engine rather than the
+      batch-keyed offline scan.
       Returns [B, P + num_tokens] numpy int32.
     """
     prompts = np.asarray(prompts, np.int32)
@@ -940,6 +1299,13 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
     # silently upcast everything to f32)
     params = {k: _prep_param(v, dtype)
               for k, v in params.items() if k.startswith(name + "_")}
+    spec_k = resolve_spec_k(spec)
+    if spec_k:
+        dl = resolve_draft_layers(spec_draft_layers, c.num_hidden_layers)
+        return _generate_spec(params, cfg_tuple, dl, prompts,
+                              int(num_tokens), float(temperature),
+                              int(top_k), int(seed), eos_id,
+                              int(pad_id), spec_k)
     common = dict(eos_id=jnp.int32(-1 if eos_id is None else eos_id),
                   pad_id=jnp.int32(pad_id), use_eos=eos_id is not None)
     if _resolve_fast(prefill):

@@ -44,6 +44,16 @@ explicit ``slo=``), ``bin/hetu_top.py`` renders the live dashboard, and
 the flight recorder (telemetry/flight.py) dumps the records leading
 into an engine exception or QueueFull storm to ``$HETU_FLIGHT_LOG``.
 
+Speculative decoding (``spec=``/``$HETU_SPEC_K``): a truncated-layer
+draft — the target's own first blocks, no separate weights — proposes
+up to k tokens per slot in one scanned dispatch, the target verifies
+all k+1 positions in ONE batched step (the multi-token verify kernels
+in kernels/decode_attention.py), longest-prefix acceptance + a bonus
+token emit 1..k+1 tokens per wave, and rejected positions roll back via
+``kv.truncate`` — outputs stay token-identical to plain decoding
+(greedy AND sampled), with an adaptive-k controller riding a sliding
+acceptance-rate window (``$HETU_SPEC_ADAPT``).
+
 Both phases have a ragged fast path (``fast_path=``/``$HETU_SERVE_FAST``,
 auto-on on TPU): admission prefills whole same-bucket GROUPS in one
 batched flash-attention pass, and the fused decode step runs the paged

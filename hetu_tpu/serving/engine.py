@@ -34,10 +34,11 @@ from .. import envvars, telemetry
 from ..telemetry import flight
 from ..telemetry import slo as slo_mod
 from ..models.gpt_decode import (
-    _infer_name, _prep_param, _pow2, _resolve_fast, serve_decode_fn,
-    serve_decode_paged_fn, serve_prefill_batch_fn,
-    serve_prefill_batch_paged_fn, serve_prefill_chunk_fn,
-    serve_prefill_fn,
+    _infer_name, _prep_param, _pow2, _resolve_fast, resolve_draft_layers,
+    resolve_spec_k, serve_decode_fn, serve_decode_paged_fn,
+    serve_prefill_batch_fn, serve_prefill_batch_paged_fn,
+    serve_prefill_chunk_fn, serve_prefill_fn, serve_verify_fn,
+    serve_verify_paged_fn, spec_propose_fn,
 )
 from .kv_manager import (KVCacheManager, PagedKVManager, resolve_kv_block,
                          resolve_kv_quant)
@@ -83,7 +84,22 @@ class ServingEngine:
     instead of streaming all of S_max) — False the masked/scan
     reference, default consults ``$HETU_SERVE_FAST`` then auto-selects
     fast on TPU (greedy outputs are identical either way; the parity
-    suite pins it in interpret mode).
+    suite pins it in interpret mode); spec: > 0 enables SPECULATIVE
+    DECODING (default ``$HETU_SPEC_K``) — a truncated-layer draft
+    (``spec_draft_layers`` of the target's own blocks + the shared
+    final LN/tied head; default ``$HETU_SPEC_DRAFT_LAYERS`` or
+    max(1, L // 4)) proposes up to ``spec`` tokens per slot per wave
+    in ONE scanned dispatch, the target verifies all proposals plus
+    the carried token in ONE batched step, and longest-prefix
+    acceptance + the bonus token emit 1..spec+1 tokens per wave —
+    outputs stay TOKEN-IDENTICAL to the non-speculative engine (greedy
+    and sampled alike: accepted tokens are the target's own sequential
+    samples from each request's rng stream), rejected positions roll
+    back via ``kv.truncate``; spec_adapt (``$HETU_SPEC_ADAPT``, default
+    on) moves the per-wave draft length through the pow2 ladder
+    1..spec on a sliding acceptance-rate window.  Speculation composes
+    with paged/prefix-shared/chunked/int8 KV, the fast path, TP, and
+    the fleet router; the draft keeps its own small contiguous cache.
 
     Composes with ``tp_shard_params``: pass the placed dict and the
     fused step runs tensor-parallel (``_prep_param`` preserves the
@@ -103,7 +119,8 @@ class ServingEngine:
                  max_seq_len=None, name=None, dtype=None, log_path=None,
                  donate=True, fast_path=None, paged=None, kv_block=None,
                  pool_blocks=None, prefix_share=None, prefill_chunk=None,
-                 kv_quant=None, slo=None, tags=None):
+                 kv_quant=None, slo=None, tags=None, spec=None,
+                 spec_adapt=None, spec_draft_layers=None):
         c = config
         self._name = _infer_name(params, name)
         # dtype=None FOLLOWS the params: bf16 weights stay bf16 and the
@@ -191,6 +208,46 @@ class ServingEngine:
         self._prefill_off = np.zeros(B, np.int32)  # paged: next prompt
         self._prompt_arr = [None] * B              # position to prefill
         self.steps = 0
+        # ---- speculative decoding (spec=/$HETU_SPEC_K) ---- #
+        self.spec_k = resolve_spec_k(spec)
+        self.spec_adapt = False
+        if self.spec_k:
+            dl = resolve_draft_layers(spec_draft_layers,
+                                      c.num_hidden_layers)
+            self.spec_draft_layers = dl
+            self.cfg_tuple_draft = (self._name, dl,
+                                    c.num_attention_heads, Dh,
+                                    self.kv.s_max)
+            adapt = (spec_adapt if spec_adapt is not None
+                     else envvars.get_bool("HETU_SPEC_ADAPT"))
+            self.spec_adapt = bool(adapt) and self.spec_k > 1
+            # adaptive runs ramp up from mid-ladder; pinned runs start
+            # (and stay) at the configured k
+            self._spec_kcur = (max(1, self.spec_k // 2)
+                               if self.spec_adapt else self.spec_k)
+            # the draft's OWN cache: always the small contiguous
+            # layout (L_draft rows, never quantized) regardless of the
+            # target's paging/quant — rollback there is pure position
+            # bookkeeping, rejected rows are masked until overwritten
+            dshape = (dl, B, self.kv.s_max, c.num_attention_heads, Dh)
+            self._draft_ck = jnp.zeros(dshape, cdtype)
+            self._draft_cv = jnp.zeros(dshape, cdtype)
+            self._propose = spec_propose_fn(donate)
+            self._draft_prefill = serve_prefill_fn(donate)
+            attn = "ragged" if self.fast_path else "masked"
+            self._verify = (serve_verify_paged_fn(donate, attn)
+                            if self.paged else
+                            serve_verify_fn(donate, attn))
+            self._acc_window = collections.deque(maxlen=32)
+            self.spec_proposed = 0    # draft tokens scored
+            self.spec_accepted = 0    # draft tokens emitted
+            self.spec_emitted = 0     # tokens emitted by verify waves
+            self.spec_waves = 0
+            self.spec_k_sum = 0       # sum of per-wave k (mean_k)
+            self.spec_draft_prefills = 0
+            self._spec_acc = np.zeros(B, np.int64)
+            self._spec_prop = np.zeros(B, np.int64)
+            self._spec_bonus = np.zeros(B, np.int64)
 
     # ------------------------------------------------------------- #
 
@@ -301,6 +358,12 @@ class ServingEngine:
                 for req, _slot in group:
                     self.metrics.lc_prefill(req.request_id, dt)
                 for (req, slot), tok0, key in zip(group, firsts, keys):
+                    if self.spec_k:
+                        t_d = time.perf_counter()
+                        self._draft_prefill_slot(slot, req.prompt)
+                        d_dt = time.perf_counter() - t_d
+                        prefill_s += d_dt
+                        self.metrics.lc_prefill(req.request_id, d_dt)
                     now = time.perf_counter()
                     req.first_token_at = now
                     self._pos[slot] = len(req.prompt)
@@ -321,7 +384,9 @@ class ServingEngine:
         # ---- one fused decode step over all live slots ---- #
         live = self.kv.live()
         self.peak_live = max(self.peak_live, len(live))
-        if live:
+        if live and self.spec_k:
+            done.extend(self._spec_wave(live, prefill_s))
+        elif live:
             wave_reqs = [self._reqs[s].request_id for s in live]
             t0 = time.perf_counter()
             sampled, ck, cv, keys = self._decode(
@@ -444,7 +509,9 @@ class ServingEngine:
         live = self.kv.live()
         decoding = [s for s in live if self._gen[s] is not None]
         self.peak_live = max(self.peak_live, len(live))
-        if decoding:
+        if decoding and self.spec_k:
+            done.extend(self._spec_wave(decoding, prefill_s))
+        elif decoding:
             wave_reqs = [self._reqs[s].request_id for s in decoding]
             B = self.kv.n_slots
             mask = np.zeros(B, bool)
@@ -600,6 +667,11 @@ class ServingEngine:
         retires right here on max_new_tokens=1/instant EOS).  Registers
         the prompt's blocks for prefix sharing."""
         req = self._reqs[slot]
+        if self.spec_k:
+            t_d = time.perf_counter()
+            self._draft_prefill_slot(slot, self._prompt_arr[slot])
+            self.metrics.lc_prefill(req.request_id,
+                                    time.perf_counter() - t_d)
         now = time.perf_counter()
         req.first_token_at = now
         P = len(self._prompt_arr[slot])
@@ -700,6 +772,165 @@ class ServingEngine:
         return ([int(first[i]) for i in range(n)],
                 [new_keys[i] for i in range(n)])
 
+    # ------------------------------------------------------------- #
+    # speculative decoding (spec=/$HETU_SPEC_K)
+    # ------------------------------------------------------------- #
+
+    def _draft_prefill_slot(self, slot, prompt):
+        """Prefill the truncated-layer draft's contiguous cache row for
+        a newly admitted slot (one teacher-forced scan over the prompt
+        bucket; the sampled token and rng split are discarded — the
+        draft only ever proposes greedily from its own cache).  Also
+        zeroes the slot's per-request speculation attribution: this is
+        the one point both schedulers pass through exactly once per
+        admission."""
+        P = len(prompt)
+        pb = self.kv.bucket_prompt(P)
+        arr = np.zeros(pb, np.int32)
+        arr[:P] = [int(t) for t in prompt]
+        _, dck, dcv, _ = self._draft_prefill(
+            self.params, self.cfg_tuple_draft,
+            self._draft_ck, self._draft_cv,
+            np.int32(slot), arr, np.int32(P), np.float32(0.0),
+            np.int32(0), np.asarray(jax.random.PRNGKey(0), np.uint32))
+        self._draft_ck, self._draft_cv = dck, dcv
+        self.spec_draft_prefills += 1
+        self._spec_acc[slot] = 0
+        self._spec_prop[slot] = 0
+        self._spec_bonus[slot] = 0
+
+    def _adapt_k(self):
+        """Sliding-window acceptance-rate controller: raise the draft
+        length through the pow2 ladder while acceptance stays high
+        (more free tokens per wave), back off while it stays low (a
+        rejected draft is a wasted draft step AND a rolled-back verify
+        position).  The window clears on every move so the new k is
+        judged on its own evidence."""
+        if not self.spec_adapt or len(self._acc_window) < 8:
+            return
+        prop = sum(p for _, p in self._acc_window)
+        if prop == 0:
+            return
+        rate = sum(a for a, _ in self._acc_window) / prop
+        if rate >= 0.75 and self._spec_kcur < self.spec_k:
+            self._spec_kcur = min(self._spec_kcur * 2, self.spec_k)
+            self._acc_window.clear()
+        elif rate <= 0.35 and self._spec_kcur > 1:
+            self._spec_kcur = max(self._spec_kcur // 2, 1)
+            self._acc_window.clear()
+
+    def _spec_wave(self, decoding, prefill_s):
+        """One speculative wave over the decoding slots: draft-propose
+        (k_cur greedy steps in ONE scanned dispatch), batched verify
+        (ONE target step over all k_cur+1 positions), longest-prefix
+        acceptance + bonus token, KV rollback of rejected positions.
+        Emits 1..k_cur+1 tokens per slot; outputs are token-identical
+        to the non-speculative wave (greedy AND sampled — accepted
+        tokens are the target's own sequential samples, and the slot's
+        rng stream resumes at exactly the accepted count via the
+        per-position keys the verify returns).  Returns the Results
+        finished this wave."""
+        B = self.kv.n_slots
+        Q = self.spec_k + 1
+        k_cur = self._spec_kcur
+        wave_reqs = [self._reqs[s].request_id for s in decoding]
+        t0 = time.perf_counter()
+        draft, dck, dcv = self._propose(
+            self.params, self.cfg_tuple_draft,
+            self._draft_ck, self._draft_cv,
+            self._pos.copy(), self._tok.copy(), k=k_cur)
+        self._draft_ck, self._draft_cv = dck, dcv
+        draft = np.asarray(draft)
+        tokens = np.zeros((B, Q), np.int32)
+        tokens[:, 0] = self._tok
+        tokens[:, 1:1 + k_cur] = draft
+        qlen = np.zeros(B, np.int32)
+        for s in decoding:
+            rem = self._reqs[s].max_new_tokens - len(self._gen[s])
+            qlen[s] = min(k_cur + 1, rem,
+                          self.kv.s_max - int(self._pos[s]))
+        if self.paged:
+            sampled, ck, cv, after = self._verify(
+                self.params, self.cfg_tuple,
+                self.kv.cache_k, self.kv.cache_v,
+                self.kv.tables.copy(), self._pos, tokens, qlen,
+                self._temp, self._topk, self._keys)
+        else:
+            sampled, ck, cv, after = self._verify(
+                self.params, self.cfg_tuple,
+                self.kv.cache_k, self.kv.cache_v,
+                self._pos, tokens, qlen, self._temp, self._topk,
+                self._keys)
+        self.kv.cache_k, self.kv.cache_v = ck, cv
+        sampled = np.asarray(sampled)
+        after = np.array(after, np.uint32)
+        dt = time.perf_counter() - t0
+        done = []
+        wave_emit = wave_acc = wave_prop = 0
+        for s in decoding:
+            req = self._reqs[s]
+            ql = int(qlen[s])
+            a = 0
+            while a < ql - 1 and sampled[s, a] == tokens[s, a + 1]:
+                a += 1
+            emit = [int(t) for t in sampled[s, :a + 1]]
+            if req.eos_id is not None and req.eos_id in emit:
+                emit = emit[:emit.index(req.eos_id) + 1]
+            n_emit = len(emit)
+            accepted = min(a, n_emit)   # emitted tokens that WERE the
+            # draft's (the rest — at most one — is the bonus sample)
+            wave_emit += n_emit
+            wave_acc += accepted
+            wave_prop += ql - 1
+            self._spec_acc[s] += accepted
+            self._spec_prop[s] += ql - 1
+            self._spec_bonus[s] += n_emit - accepted
+            base = int(self._pos[s])
+            # the verify wrote all ql positions; keep the accepted
+            # prefix + bonus, roll the rejected tail back
+            self.kv.advance(s, ql)
+            self.kv.truncate(s, base + n_emit)
+            self._pos[s] = base + n_emit
+            self._tok[s] = emit[-1]
+            self._keys[s] = after[s, n_emit - 1]
+            self._gen[s].extend(emit)
+            if req.stream_cb:
+                for t in emit:
+                    req.stream_cb(req, t)
+            r = self._maybe_finish(s, emit[-1])
+            if r:
+                done.append(r)
+        self.steps += 1
+        self.spec_waves += 1
+        self.spec_k_sum += k_cur
+        self.spec_proposed += wave_prop
+        self.spec_accepted += wave_acc
+        self.spec_emitted += wave_emit
+        self._acc_window.append((wave_acc, wave_prop))
+        self._adapt_k()
+        self.metrics.record_step(
+            live=len(decoding), slots=B,
+            queue_depth=len(self._queue), dt_s=dt,
+            new_tokens=wave_emit, prefill_s=prefill_s,
+            step=self.steps, requests=wave_reqs, end_perf=t0 + dt,
+            spec={"k": k_cur, "proposed": wave_prop,
+                  "accepted": wave_acc})
+        return done
+
+    @property
+    def spec_acceptance(self):
+        """Lifetime draft acceptance rate (None before any proposal)."""
+        if not self.spec_k or not self.spec_proposed:
+            return None
+        return self.spec_accepted / self.spec_proposed
+
+    @property
+    def spec_mean_k(self):
+        """Mean per-wave draft length (adaptation's observable)."""
+        if not self.spec_k or not self.spec_waves:
+            return None
+        return self.spec_k_sum / self.spec_waves
+
     def run(self, requests=()):
         """Submit ``requests`` then step until everything (including
         already-pending work) drains; returns {request_id: Result}."""
@@ -726,13 +957,26 @@ class ServingEngine:
         tokens = np.concatenate([
             np.asarray(req.prompt, np.int32),
             np.asarray(self._gen[slot], np.int32)])
+        spec = None
+        if self.spec_k:
+            # per-request speculation attribution: every generated
+            # token is the prefill sample, an accepted draft, or a
+            # bonus sample — accepted + bonus + 1 == n_generated, the
+            # invariant hetu_trace --check enforces (rejected drafts,
+            # proposed - accepted, are exempt: they cost compute, not
+            # sequence length)
+            spec = {"accepted": int(self._spec_acc[slot]),
+                    "proposed": int(self._spec_prop[slot]),
+                    "bonus": int(self._spec_bonus[slot])}
         res = Result(
             request_id=req.request_id, tokens=tokens,
             prompt_len=len(req.prompt), finish_reason=reason,
             n_generated=n, ttft_s=req.first_token_at - req.submitted_at,
-            latency_s=now - req.submitted_at, slot=slot)
+            latency_s=now - req.submitted_at, slot=slot,
+            spec_accepted=spec["accepted"] if spec else 0,
+            spec_proposed=spec["proposed"] if spec else 0)
         self.metrics.record_finish(req.request_id, reason, n,
-                                   res.latency_s)
+                                   res.latency_s, spec=spec)
         decode_s = now - req.first_token_at
         self.slo.observe(
             request_id=req.request_id, ttft_ms=res.ttft_s * 1e3,

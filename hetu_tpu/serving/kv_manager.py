@@ -205,6 +205,23 @@ class KVCacheManager:
         """Record ``n`` more filled positions in ``slot``."""
         self.lengths[slot] += n
 
+    def truncate(self, slot, n):
+        """Roll ``slot`` back to ``n`` filled positions (speculative-
+        decode rejection rollback).  Contiguous rows need only the
+        length decrement: positions at or past ``n`` are never admitted
+        by the per-slot attention masks and are overwritten in place by
+        the next writes at those positions — and a quantized cache's
+        scale planes share the position axis, so they truncate in
+        lockstep by the same argument."""
+        n = int(n)
+        if self.owner[slot] is None:
+            raise ValueError(f"slot {slot} is free")
+        if not 0 <= n <= int(self.lengths[slot]):
+            raise ValueError(
+                f"cannot truncate slot {slot} to {n} "
+                f"(filled {int(self.lengths[slot])})")
+        self.lengths[slot] = n
+
     def release(self, slot):
         """Return a retired sequence's slot to the free list (its cache
         rows are left as-is — recycled content is masked/overwritten)."""
@@ -501,6 +518,57 @@ class PagedKVManager:
         """Record ``n`` more filled positions (blocks were reserved at
         admission — nothing to allocate)."""
         self.lengths[slot] += n
+
+    def truncate(self, slot, n):
+        """Roll ``slot`` back to ``n`` filled positions at refcount
+        discipline (speculative-decode rejection rollback).  The slot's
+        whole-span reservation is KEPT — a never-speculated replay
+        holds the same blocks, so rollback must not shrink it — but any
+        reserved block the slot will now REWRITE (covering positions at
+        or past ``n``) that is still SHARED (refcount > 1: attached
+        from the prefix cache or another request) is detached and
+        replaced with a private block: the boundary block still holding
+        live positions below ``n`` is copy-on-write FORKED (content
+        preserved), wholly-dead trailing blocks are swapped for fresh
+        blocks with no copy.  A shared block is NEVER freed here — its
+        refcount drops by one and every other holder keeps it.  In the
+        engine's speculative path this loop is a no-op (generation
+        never writes into a shared block: ``match_prefix`` caps sharing
+        below the last prompt position, so every writable block is
+        already private), but the discipline holds for any caller.
+        Quantized pools move payload and scale planes together
+        (``_block_copy``)."""
+        if self.owner[slot] is None:
+            raise ValueError(f"slot {slot} is free")
+        old = int(self.lengths[slot])
+        n = int(n)
+        if not 0 <= n <= old:
+            raise ValueError(
+                f"cannot truncate slot {slot} to {n} (filled {old})")
+        first_w = n // self.block   # first block future writes touch
+        for j in range(first_w, int(self.n_table[slot])):
+            b = int(self.tables[slot, j])
+            if self.ref[b] <= 1:
+                continue
+            partial = j == first_w and n % self.block != 0
+            if not self._free:
+                self._evict_for(1)
+            if not self._free:
+                raise RuntimeError(
+                    f"pool exhausted un-COWing rollback of slot {slot} "
+                    f"(block {b} shared at ref {int(self.ref[b])})")
+            dst = self._free.pop()
+            self.ref[dst] = 1
+            self.ref[b] -= 1
+            if partial:
+                # live positions below n survive in the private fork
+                self.cache_k = self._block_copy(self.cache_k, b, dst)
+                self.cache_v = self._block_copy(self.cache_v, b, dst)
+                self.cow_copies += 1
+                telemetry.inc("serve.cow_copies")
+            self.tables[slot, j] = dst
+        self.lengths[slot] = n
+        self._gauges()
 
     def release(self, slot):
         """Retire a sequence: decrement each held block's refcount and

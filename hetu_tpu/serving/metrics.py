@@ -98,10 +98,13 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.ttfts = []            # seconds, submit -> first token
         self.latencies = []        # seconds, submit -> finish
-        self.tpots = []            # seconds/token after the first
+        self.tpots = []            # per-request decode s/token means
         self.step_live = []        # live slots per fused step
         self.step_queue = []       # queue depth per fused step
         self.step_dt = []          # seconds per fused decode step
+        self.step_tokens = []      # tokens EMITTED per fused step (==
+        # live without speculation; 1..(k+1)*live with it — the TPOT
+        # percentiles are computed from these real per-step counts)
         self.step_prefill = []     # prefill seconds folded into a step
         self.prefill_dt = []       # seconds per prefill dispatch
         self.prefill_reqs = 0      # requests prefilled
@@ -132,11 +135,21 @@ class ServingMetrics:
             self._t0 = now
         self._t_last = now
 
-    @staticmethod
-    def _epoch(perf_t):
+    # ONE epoch<->perf_counter offset for the whole process: deriving
+    # it per call (time.time() - perf_counter() read back to back) let
+    # scheduler preemption between the two clock reads skew req_span
+    # stamps against serve_step stamps by milliseconds, which pushed
+    # flow-arrow bindings outside their wave spans on loaded boxes —
+    # a shared offset makes every exported timestamp mutually
+    # consistent by construction (long-run clock drift is irrelevant
+    # at trace granularity)
+    _PERF_TO_EPOCH = time.time() - time.perf_counter()
+
+    @classmethod
+    def _epoch(cls, perf_t):
         """Map a perf_counter stamp onto the epoch clock the telemetry
         stream uses (so req_span tracks align with span tracks)."""
-        return time.time() - (time.perf_counter() - perf_t)
+        return cls._PERF_TO_EPOCH + perf_t
 
     # ------------------------------------------------------------- #
     # lifecycle marks (the engine calls these at phase boundaries)
@@ -212,7 +225,7 @@ class ServingMetrics:
 
     def record_step(self, live, slots, queue_depth, dt_s, new_tokens,
                     prefill_s=0.0, step=None, requests=None,
-                    end_perf=None):
+                    end_perf=None, spec=None):
         """One fused decode step; ``prefill_s`` is the prefill wall time
         this scheduler iteration paid before decoding, so the per-step
         JSONL event attributes the phases separately (the masked vs
@@ -222,14 +235,24 @@ class ServingMetrics:
         ``end_perf`` is the decode's end perf-stamp: the event's ``t``
         then marks the true phase end (the exporter backdates the wave
         start by ``decode_ms``) instead of the emission time, which
-        trails it by the retire loop."""
+        trails it by the retire loop.
+
+        ``new_tokens`` is the step's REAL emitted-token count (a
+        speculative wave emits up to k+1 per slot): it lands in the
+        event, in ``step_tokens``, and in the ``serve.tokens_per_step``
+        histogram — TPOT is computed from these, never from a
+        one-token-per-step assumption.  ``spec`` (a
+        {k, proposed, accepted} dict) stamps a speculative wave's
+        draft accounting onto the event."""
         self._mark()
         self._slots = slots
         self.step_live.append(live)
         self.step_queue.append(queue_depth)
         self.step_dt.append(dt_s)
         self.step_prefill.append(prefill_s)
+        self.step_tokens.append(int(new_tokens))
         self.tokens_generated += new_tokens
+        telemetry.observe("serve.tokens_per_step", int(new_tokens))
         fields = {}
         if step is not None:
             fields["step"] = step
@@ -237,23 +260,32 @@ class ServingMetrics:
             fields["requests"] = list(requests)
         if end_perf is not None:
             fields["t"] = self._epoch(end_perf)
+        if spec is not None:
+            fields["spec_k"] = int(spec.get("k", 0))
+            fields["spec_proposed"] = int(spec.get("proposed", 0))
+            fields["spec_accepted"] = int(spec.get("accepted", 0))
         self.event("serve_step", live=live, queue_depth=queue_depth,
-                   slots=slots, prefill_ms=round(prefill_s * 1e3, 3),
+                   slots=slots, new_tokens=int(new_tokens),
+                   prefill_ms=round(prefill_s * 1e3, 3),
                    decode_ms=round(dt_s * 1e3, 3), **fields)
 
-    def record_finish(self, request_id, reason, n_generated, latency_s):
+    def record_finish(self, request_id, reason, n_generated, latency_s,
+                      spec=None):
+        """``spec`` ({accepted, proposed, bonus}, speculative engines
+        only) rides into the req_retire record so hetu_trace --check
+        can assert accepted + bonus + 1 == n_generated per request."""
         self._mark()
         self.finished += 1
         self.latencies.append(latency_s)
         self.event("serve_finish", request=request_id, reason=reason,
                    n_generated=n_generated, latency_s=round(latency_s, 6))
-        return self._retire(request_id, n_generated)
+        return self._retire(request_id, n_generated, spec=spec)
 
     # ------------------------------------------------------------- #
     # retirement: component breakdown + per-phase req_span records
     # ------------------------------------------------------------- #
 
-    def _retire(self, request_id, n_generated):
+    def _retire(self, request_id, n_generated, spec=None):
         lc = self._lc.pop(request_id, None)
         if lc is None or lc.t_claim is None or lc.t_first is None:
             return None
@@ -278,6 +310,9 @@ class ServingMetrics:
         for k, v in comp.items():
             self.components[k].append(v)
         if n_generated > 1 and decode_ms > 0:
+            # per-request decode MEAN (wall over tokens) — a valid
+            # average either way, but NOT the TPOT percentile source:
+            # snapshot() builds that from real per-step token counts
             self.tpots.append(decode_ms / 1e3 / (n_generated - 1))
         breakdown = {"request": request_id, "ttft_ms": ttft_ms,
                      **{k: round(v, 3) for k, v in comp.items()}}
@@ -304,9 +339,15 @@ class ServingMetrics:
         for phase, t_start, ms, extra in phases:
             self.event("req_span", request=request_id, phase=phase,
                        ms=round(ms, 3), t=self._epoch(t_start), **extra)
+        spec_fields = {}
+        if spec is not None:
+            spec_fields = {"spec_accepted": int(spec.get("accepted", 0)),
+                           "spec_proposed": int(spec.get("proposed", 0)),
+                           "spec_bonus": int(spec.get("bonus", 0))}
         self.event("req_retire", request=request_id,
                    ttft_ms=round(ttft_ms, 3),
-                   n_generated=n_generated, **breakdown_fields(comp))
+                   n_generated=n_generated, **spec_fields,
+                   **breakdown_fields(comp))
         return breakdown
 
     # ------------------------------------------------------------- #
@@ -320,6 +361,15 @@ class ServingMetrics:
                 else None)
         occ = ([l / self._slots for l in self.step_live]
                if self._slots else [])
+        # TPOT from REAL per-step emitted-token counts: a step emitting
+        # n tokens contributes n samples of dt/n — correct with and
+        # without speculation (the old per-request decode_ms/(n-1)
+        # assumed one token per wave and skewed the percentiles the
+        # moment waves emitted more)
+        tpot = []
+        for dt, n in zip(self.step_dt, self.step_tokens):
+            if n > 0:
+                tpot.extend([dt / n] * n)
         comps = {}
         for name, xs in self.components.items():
             if xs:
@@ -342,8 +392,11 @@ class ServingMetrics:
             "ttft_p99_s": _pct(self.ttfts, 99),
             "ttft_mean_s": (float(np.mean(self.ttfts))
                             if self.ttfts else None),
-            "tpot_p50_s": _pct(self.tpots, 50),
-            "tpot_p99_s": _pct(self.tpots, 99),
+            "tpot_p50_s": _pct(tpot, 50),
+            "tpot_p99_s": _pct(tpot, 99),
+            "tpot_req_mean_p50_s": _pct(self.tpots, 50),
+            "tokens_per_step_mean": (float(np.mean(self.step_tokens))
+                                     if self.step_tokens else None),
             "step_p50_s": _pct(self.step_dt, 50),
             "step_p99_s": _pct(self.step_dt, 99),
             "decode_ms_p50": (round(_pct(self.step_dt, 50) * 1e3, 3)

@@ -85,6 +85,11 @@ class Result:
     ttft_s: float
     latency_s: float
     slot: int
+    # speculative-decoding attribution (0/0 on a non-speculative
+    # engine): drafted tokens this request accepted vs was proposed —
+    # accepted + bonus samples + the prefill token == n_generated
+    spec_accepted: int = 0
+    spec_proposed: int = 0
 
     @property
     def generated(self) -> List[int]:

@@ -7,7 +7,14 @@ the environment) and renders the serving engine's vitals in place:
 - engine: batch occupancy, live slots, queue depth, fused-step count;
 - paged KV pool: blocks free / shared, registered prefixes (the
   ``gauge`` records kv_manager emits);
-- latency: TTFT and TPOT percentiles over the visible window;
+- latency: TTFT and TPOT percentiles over the visible window (TPOT
+  from per-step emitted-token counts — ``serve_step.new_tokens`` — so
+  speculative waves emitting several tokens per step are weighted
+  correctly; old logs without the field fall back to per-request
+  retire records);
+- speculation: drafted vs accepted token counts, acceptance rate, and
+  mean per-wave draft length (the ``spec_*`` fields speculative
+  engines stamp on every ``serve_step``);
 - SLO: current health state (ok/degraded/breach), burn rate, violation
   count — the same signal ``ServingEngine.health()`` returns;
 - incidents: flight-recorder dumps and queue rejections.
@@ -88,7 +95,32 @@ def summarize(events, window=512):
     if len(steps) >= 2:
         span = steps[-1].get("t", 0) - steps[0].get("t", 0)
         if span > 0:
-            tok_s = round(sum(s.get("live", 0) for s in steps) / span, 1)
+            tok_s = round(sum(s.get("new_tokens", s.get("live", 0))
+                              for s in steps) / span, 1)
+    # TPOT from real per-step token counts (a speculative wave emits
+    # up to k+1 per slot); retire-record fallback for old logs
+    step_tpot = []
+    for s in steps:
+        n, d = s.get("new_tokens"), s.get("decode_ms")
+        if isinstance(n, int) and n > 0 and isinstance(d, (int, float)):
+            step_tpot.extend([d / n] * n)
+    if step_tpot:
+        tpot_ms = step_tpot
+    drafted = accepted = 0
+    spec_ks = []
+    for s in steps:
+        if isinstance(s.get("spec_proposed"), int):
+            drafted += s["spec_proposed"]
+            accepted += s.get("spec_accepted", 0)
+            if isinstance(s.get("spec_k"), int):
+                spec_ks.append(s["spec_k"])
+    spec = {
+        "drafted": drafted,
+        "accepted": accepted,
+        "acceptance": round(accepted / drafted, 4) if drafted else None,
+        "mean_k": (round(sum(spec_ks) / len(spec_ks), 2)
+                   if spec_ks else None),
+    }
     if slo["burn_rate"] is None:
         slo["burn_rate"] = gauges.get("serve.slo_burn")
     if slo["state"] is None:
@@ -111,6 +143,7 @@ def summarize(events, window=512):
         "tpot_p50_ms": _pct_ms(tpot_ms, 50),
         "tpot_p99_ms": _pct_ms(tpot_ms, 99),
         "requests": counts,
+        "spec": spec,
         "slo": slo,
         "flight_dumps": flight_dumps,
     }
@@ -130,7 +163,7 @@ def summarize_fleet(events, window=4096):
             "live": None, "slots": None, "queue_depth": None,
             "steps": 0, "breaker": "closed", "routed": 0,
             "requeued": 0, "rejects": 0, "deaths": 0, "restarts": 0,
-            "finished": 0,
+            "finished": 0, "drafted": 0, "accepted": 0,
         })
 
     shed = {"latency": 0, "throughput": 0}
@@ -145,6 +178,9 @@ def summarize_fleet(events, window=4096):
             r["slots"] = e.get("slots")
             r["queue_depth"] = e.get("queue_depth")
             r["steps"] += 1
+            if isinstance(e.get("spec_proposed"), int):
+                r["drafted"] += e["spec_proposed"]
+                r["accepted"] += e.get("spec_accepted", 0)
         elif kind == "slo_health" and rep is not None:
             row(rep)["health"] = e.get("state")
         elif kind == "serve_finish" and rep is not None:
@@ -186,6 +222,8 @@ def summarize_fleet(events, window=4096):
             r["occupancy"] = round(r["live"] / r["slots"], 4)
         else:
             r["occupancy"] = None
+        r["acceptance"] = (round(r["accepted"] / r["drafted"], 4)
+                           if r["drafted"] else None)
     return {
         "records": len(events),
         "replicas": [per[k] for k in sorted(per)],
@@ -204,7 +242,8 @@ def render_fleet(stats, clock=None):
         "-" * 72,
         f"{'rep':>3} {'state':<7} {'health':<9} {'occ':>5} "
         f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
-        f"{'requeued':>8} {'rejects':>7} {'deaths':>6}",
+        f"{'requeued':>8} {'rejects':>7} {'deaths':>6} "
+        f"{'drafted':>7} {'acc':>5}",
     ]
     for r in stats["replicas"]:
         lines.append(
@@ -212,7 +251,8 @@ def render_fleet(stats, clock=None):
             f"{_fmt(r['occupancy'], nd=2):>5} {_fmt(r['live']):>4} "
             f"{_fmt(r['queue_depth']):>5} {r['breaker']:<9} "
             f"{r['routed']:>6} {r['requeued']:>8} {r['rejects']:>7} "
-            f"{r['deaths']:>6}")
+            f"{r['deaths']:>6} {r['drafted']:>7} "
+            f"{_fmt(r['acceptance'], nd=2):>5}")
     shed = stats["shed"]
     lines.append("-" * 72)
     lines.append(
@@ -263,6 +303,13 @@ def render(stats, clock=None):
         f"  violations {slo['violations']}"
         f"  flight_dumps {s['flight_dumps']}",
     ]
+    sp = s.get("spec") or {}
+    if sp.get("drafted"):
+        lines.insert(-1, (
+            f"spec      drafted {sp['drafted']}"
+            f"  accepted {sp['accepted']}"
+            f"  acceptance {_fmt(sp['acceptance'], nd=2)}"
+            f"  mean_k {_fmt(sp['mean_k'], nd=1)}"))
     return "\n".join(lines)
 
 

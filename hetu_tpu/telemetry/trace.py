@@ -44,6 +44,13 @@ a flight recording is by definition a mid-flight snapshot.
 ``bench_row`` in the stream must carry the same ``quant`` stamp
 (``hetu_tpu.quant.active_modes()``) — quantized and exact measurements
 can never be compared silently.
+
+``--check`` also enforces the speculative-attribution rule: a
+``req_retire`` record carrying spec fields must satisfy
+``spec_accepted + spec_bonus + 1 == n_generated`` — every retired
+token is the prefill sample, an accepted draft, or a bonus sample.
+Rejected drafts (``spec_proposed - spec_accepted``) are exempt: they
+cost compute, never sequence length.
 """
 
 from __future__ import annotations
@@ -283,6 +290,37 @@ def check_quant_consistency(events):
             f"re-run one side or split the streams"]
 
 
+def check_spec_attribution(events):
+    """The speculative-attribution rule: per retired request, accepted
+    draft tokens + bonus samples + the prefill token must equal the
+    retired sequence length (``n_generated``) — a mismatch means the
+    engine emitted tokens it never accounted for, or rolled back tokens
+    it already reported.  Records WITHOUT spec fields (non-speculative
+    engines) are skipped; rejected drafts are exempt by construction
+    (they are not part of the sum).  Returns problem strings."""
+    problems = []
+    for e in events:
+        if e.get("event") != "req_retire":
+            continue
+        acc = e.get("spec_accepted")
+        if acc is None:
+            continue
+        bonus = e.get("spec_bonus", 0)
+        n = e.get("n_generated")
+        if not all(isinstance(v, int) for v in (acc, bonus, n)):
+            problems.append(
+                f"spec-attribution: request {e.get('request')!r} "
+                f"carries non-integer spec fields")
+            continue
+        if acc + bonus + 1 != n:
+            problems.append(
+                f"spec-attribution: request {e.get('request')!r} "
+                f"retired {n} tokens but accounts for "
+                f"{acc} accepted + {bonus} bonus + 1 prefill "
+                f"= {acc + bonus + 1}")
+    return problems
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="hetu_trace",
@@ -302,8 +340,11 @@ def main(argv=None):
     ap.add_argument("--check", action="store_true",
                     help="validate every record against the event "
                          "contract AND the request span-balance rule "
-                         "(every serve_admit has a serve_finish); "
-                         "exit 1 on violations")
+                         "(every serve_admit has a serve_finish), the "
+                         "quant-mix rule, and the speculative-"
+                         "attribution rule (accepted + bonus + 1 == "
+                         "n_generated per retired request); exit 1 on "
+                         "violations")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()
@@ -326,12 +367,15 @@ def main(argv=None):
         problems.extend(balance)
         qmix = check_quant_consistency(events)
         problems.extend(qmix)
+        spec = check_spec_attribution(events)
+        problems.extend(spec)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
                           "contract_violations": len(problems),
                           "span_balance_violations": len(balance),
-                          "quant_mix_violations": len(qmix)}))
+                          "quant_mix_violations": len(qmix),
+                          "spec_attribution_violations": len(spec)}))
         return 1 if problems or bad else 0
 
     if args.export:

@@ -138,10 +138,13 @@ def test_gpt_example_script_runs():
 
 def test_serve_gpt_example_chains_decode():
     """Serving demo: the trained +1 chain decodes correctly through the
-    continuous-batching engine for every request in the mixed burst."""
+    continuous-batching engine for every request in the mixed burst —
+    with --spec on (speculative decoding is token-identical by
+    construction, so the chain must survive it; the plain engine path
+    is pinned by tests/test_serving.py and suite stage 00c)."""
     mod = _load("nlp/serve_gpt.py", "ex_serve")
     frac = _run_main(mod, ["--train-steps", "250", "--requests", "5",
-                           "--slots", "2"])
+                           "--slots", "2", "--spec", "2"])
     assert frac == 1.0
 
 

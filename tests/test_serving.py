@@ -381,6 +381,25 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     assert ov["shed_by_class"]["latency"] == 0
     assert ov["shed_by_class"]["throughput"] == ov["shed"]
     assert ov["latency_within_slo"] is True
+    # speculative A/B (ISSUE 10): greedy token-identity spec-vs-plain,
+    # a wall-clock tok/s win at the acceptance-1.0 endpoint (floor also
+    # asserted in-bench), acceptance + mean-k stamped on the row, the
+    # temperature sweep degrading acceptance with identity intact, and
+    # TPOT percentiles from real per-step token counts in both modes
+    sa = art["spec_ab"]
+    assert sa["provenance"] == "live" and sa["platform"] == "cpu"
+    assert sa["greedy_identical"] is True
+    assert sa["speedup"] >= 1.05
+    assert sa["spec"]["acceptance_rate"] >= 0.95
+    assert sa["spec"]["mean_k"] > 0
+    assert sa["spec"]["tokens_per_step_mean"] > \
+        sa["plain"]["tokens_per_step_mean"]
+    for row in (sa["plain"], sa["spec"]):
+        assert row["tpot_p50_s"] is not None
+        assert row["tpot_p99_s"] >= row["tpot_p50_s"]
+    for srow in sa["acceptance_sweep"]:
+        assert srow["identical"] is True
+        assert srow["acceptance_rate"] <= sa["spec"]["acceptance_rate"]
     with open(tmp_path / "BENCH_SERVE.json") as f:
         on_disk = json.load(f)
     assert on_disk["continuous"]["tokens_per_sec"] == cont
