@@ -1226,6 +1226,8 @@ def bench_serve(platform, reduced):
                                n_req)
     fleet_ab = _serve_fleet_ab(params, cfg, dt_, platform, slots,
                                vocab, n_req)
+    fleet_prefix_ab = _serve_fleet_prefix_ab(params, cfg, dt_, platform,
+                                             slots, s_max, vocab, n_req)
     quant_ab = _serve_quant_ab(params, cfg, dt_, slots, s_max, vocab,
                                n_req)
     spec_ab = _serve_spec_ab(params, cfg, dt_, platform, slots, s_max,
@@ -1258,6 +1260,7 @@ def bench_serve(platform, reduced):
         "phase_ab": phase_ab,
         "paged_ab": paged_ab,
         "fleet_ab": fleet_ab,
+        "fleet_prefix_ab": fleet_prefix_ab,
         "quant_ab": quant_ab,
         "spec_ab": spec_ab,
         "trace": {"seed": 1234, "n_requests": n_req,
@@ -1638,6 +1641,134 @@ def _serve_fleet_ab(params, cfg, dt_, platform, slots, vocab, n_req):
                 "contract is scheduling + recovery, per-host fleets "
                 "are the chip story",
     }
+
+
+def _serve_fleet_prefix_ab(params, cfg, dt_, platform, slots, s_max,
+                           vocab, n_req):
+    """Fleet prefix intelligence at EQUAL fleet slots (ISSUE 12): a
+    prefix-storm trace (two long shared system prompts, every request
+    a DISTINCT session so PR 8 affinity hashing scatters them) replayed
+    through three N=2 fleets:
+
+    - ``affinity``  — PR 8 behavior (``directory=False``): each replica
+      prefills each system prompt for itself;
+    - ``directory`` — the PrefixDirectory routes matching prompts to
+      the replica already HOLDING the prefix, so the fleet prefills
+      each system prompt once;
+    - ``roles``     — directory + prefill/decode disaggregation
+      (``roles="prefill,decode"``): cold long prompts prefill on the
+      prefill-heavy replica and the KV span hands off to its decode
+      home over the int8-capable wire.
+
+    Requests are replayed in WAVES (the storm shape: tenants arriving
+    over time, not one atomic batch) so later waves can actually
+    consult what earlier waves registered.  Greedy outputs must be
+    token-identical across all three arms, and the acceptance floors
+    are asserted HERE so a regression can never bank the artifact
+    silently: directory tok/s >= affinity tok/s and directory TTFT p99
+    <= 1.25x affinity's."""
+    from hetu_tpu.serving import Request, ServingEngine, ServingRouter
+
+    n_rep = 2
+    per = max(slots // n_rep, 1)
+    sys_len = s_max // 2 - 8          # long, deliberately NOT aligned
+    rng = np.random.RandomState(777)
+    sys_a = rng.randint(0, vocab, sys_len).astype(np.int32)
+    sys_b = rng.randint(0, vocab, sys_len).astype(np.int32)
+    trace = []
+    for i in range(n_req):
+        base = sys_a if i % 2 == 0 else sys_b
+        tail = rng.randint(0, vocab, 2).astype(np.int32)
+        trace.append((np.concatenate([base, tail]),
+                      int(rng.randint(4, 9))))
+    useful = sum(g for _, g in trace)
+    wave = max(n_req // 4, 1)
+
+    def mk():
+        return [Request(prompt=p, max_new_tokens=g,
+                        session_id=f"tenant-{i}")
+                for i, (p, g) in enumerate(trace)]
+
+    def factory(**kw):
+        return lambda i: ServingEngine(
+            params, cfg, slots=per, queue_limit=n_req, dtype=dt_,
+            paged=True, prefix_share=True, **kw)
+
+    def run_arm(**router_kw):
+        warm = ServingRouter(factory(), replicas=n_rep, **router_kw)
+        warm.run(mk())
+        r = ServingRouter(factory(), replicas=n_rep, **router_kw)
+        reqs = mk()
+        out = {}
+        t0 = time.perf_counter()
+        for i in range(0, n_req, wave):
+            out.update(r.run(reqs[i:i + wave]))
+        wall = time.perf_counter() - t0
+        snap = r.snapshot()
+        row = {
+            "tokens_per_sec": round(useful / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_p99_s": snap["ttft_p99_s"],
+            "directory": ({k: snap["directory"][k] for k in
+                           ("hits", "misses", "steals", "stale",
+                            "hit_rate")}
+                          if snap["directory"] else None),
+            "directory_hit_rate": snap["directory_hit_rate"],
+            "handoffs": snap["handoffs"],
+            "handoff_bytes": snap["handoff_bytes"],
+        }
+        return row, sorted(v.tokens.tolist() for v in out.values())
+
+    affinity, out_a = run_arm(directory=False)
+    directory, out_d = run_arm()
+    roles, out_r = run_arm(roles="prefill,decode")
+
+    speedup = (round(directory["tokens_per_sec"]
+                     / affinity["tokens_per_sec"], 3)
+               if affinity["tokens_per_sec"] else None)
+    result = {
+        "provenance": "live",
+        "platform": platform,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC",
+                                     time.gmtime()),
+        "trace": {"seed": 777, "n_requests": n_req,
+                  "system_prompts": 2, "system_prompt_len": sys_len,
+                  "new_tokens": "4..8", "wave": wave,
+                  "useful_tokens": useful},
+        "affinity_only": affinity,
+        "directory": directory,
+        "directory_roles": roles,
+        "speedup_directory": speedup,
+        "speedup_roles": (round(roles["tokens_per_sec"]
+                                / affinity["tokens_per_sec"], 3)
+                          if affinity["tokens_per_sec"] else None),
+        "greedy_identical": out_a == out_d == out_r,
+        "note": "equal fleet slots across all arms; the affinity arm "
+                "still has PER-REPLICA prefix caching (PR 6) — the "
+                "directory's win is fleet-level placement, each "
+                "system prompt prefilled once per FLEET instead of "
+                "once per replica",
+    }
+    # acceptance floors (ISSUE 12): the directory must not lose to
+    # affinity-only on its home turf, and greedy outputs must match
+    assert result["greedy_identical"], (
+        "fleet_prefix_ab arms diverged: directory/role routing "
+        "changed greedy tokens")
+    assert directory["tokens_per_sec"] >= affinity["tokens_per_sec"], (
+        f"directory routing lost throughput on a prefix storm: "
+        f"{directory['tokens_per_sec']} vs {affinity['tokens_per_sec']}"
+        f" tok/s (floor: >= 1.0x affinity-only)")
+    if affinity["ttft_p99_s"] and directory["ttft_p99_s"]:
+        assert directory["ttft_p99_s"] <= affinity["ttft_p99_s"] * 1.25, (
+            f"directory routing degraded TTFT p99: "
+            f"{directory['ttft_p99_s']}s vs affinity "
+            f"{affinity['ttft_p99_s']}s (floor: <= 1.25x)")
+    assert (directory["directory"] or {}).get("hits", 0) > 0, (
+        "prefix storm produced zero directory hits — the directory "
+        "is not being consulted")
+    assert roles["handoffs"] > 0, (
+        "role-split arm produced zero KV handoffs")
+    return result
 
 
 def _serve_spec_ab(params, cfg, dt_, platform, slots, s_max, vocab,

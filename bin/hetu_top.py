@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """Live terminal dashboard for the telemetry streams: occupancy,
-queue depth, KV pool, TTFT/TPOT percentiles, SLO health.  Logic lives
-in hetu_tpu/telemetry/top.py; see its docstring for the panels."""
+queue depth, KV pool, TTFT/TPOT percentiles, SLO health; --fleet adds
+per-replica role + directory hit-rate columns and fleet prefix/handoff
+totals.  Logic lives in hetu_tpu/telemetry/top.py; see its docstring
+for the panels."""
 
 import os
 import sys

@@ -215,6 +215,82 @@ python bin/hetu_trace.py "$LOG/router_flight.jsonl" --check \
   exit 1
 }
 
+# 00e. fleet-KV gate (ISSUE 12): a role-split N=2 CPU fleet with the
+#      prefix directory on and a seeded chaos kill of the DIRECTORY
+#      mid-trace must retire every request token-identical to offline
+#      generate_fast (the handoff payloads in flight still land; the
+#      fleet degrades to PR 8 affinity routing), record the kill
+#      (failure event + flight dump), and leave a serve stream that
+#      passes the KV-handoff pairing rule (hetu_trace --check: every
+#      kv_handoff_out has its kv_handoff_in, one retirement per
+#      admission) — the fleet-KV contract proven before chip time.
+run fleet_kv_gate 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/fleet_kv_trace.jsonl" \
+    HETU_FAILURE_LOG="$LOG/fleet_kv_failure.jsonl" \
+    HETU_FLIGHT_LOG="$LOG/fleet_kv_flight.jsonl" \
+    HETU_CHAOS="seed=5,kill=3,role=directory" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.models.gpt_decode import generate_fast
+from hetu_tpu.serving import Request, ServingEngine, ServingRouter
+
+rng, hd = np.random.RandomState(0), 16
+p = {"fg_wte_table": rng.randn(61, hd) * 0.05,
+     "fg_wpe": rng.randn(32, hd) * 0.05,
+     "fg_ln_f_scale": np.ones(hd), "fg_ln_f_bias": np.zeros(hd)}
+for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+               ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+               ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+    p[f"fg_h0_{w}_weight"] = rng.randn(*shp) * 0.05
+    p[f"fg_h0_{w}_bias"] = np.zeros(shp[1])
+for ln in ("ln1", "ln2"):
+    p[f"fg_h0_{ln}_scale"] = np.ones(hd)
+    p[f"fg_h0_{ln}_bias"] = np.zeros(hd)
+cfg = GPTConfig(vocab_size=61, hidden_size=hd, num_hidden_layers=1,
+                num_attention_heads=2, max_position_embeddings=32,
+                batch_size=1, seq_len=32, dropout_rate=0.0)
+router = ServingRouter(
+    lambda i: ServingEngine(p, cfg, slots=2, fast_path=False,
+                            paged=True, kv_block=8, prefix_share=True),
+    replicas=2, roles="prefill,decode")
+sys_p = list(range(1, 18))          # shared long prompt (> one block)
+reqs = [Request(prompt=sys_p + [20 + i], max_new_tokens=4,
+                session_id=f"t{i}") for i in range(10)]
+res = {}
+for i in range(0, 10, 5):           # two waves: warm, then consult
+    res.update(router.run(reqs[i:i + 5]))
+snap = router.snapshot()
+assert len(res) == 10, f"retired {len(res)}/10"
+assert snap["lost"] == 0 and snap["duplicates"] == 0, snap
+assert snap["directory_killed"], "the chaos kill never fired"
+assert snap["handoffs"] > 0, "role-split fleet moved zero KV spans"
+for r in reqs:                      # zero token loss, bit-for-bit
+    want = generate_fast(p, cfg, [r.prompt], num_tokens=4)[0].tolist()
+    got = res[r.request_id].tokens.tolist()
+    assert got == want, (r.request_id, got, want)
+print("fleet kv gate OK: finished", snap["finished"],
+      "handoffs", snap["handoffs"], "killed", snap["directory_killed"])
+PYEOF
+if ! grep -q 'fleet kv gate OK' "$LOG/fleet_kv_gate.log"; then
+  echo "fleet KV gate FAILED — see $LOG/fleet_kv_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/fleet_kv_trace.jsonl" \
+    "$LOG/fleet_kv_failure.jsonl" --check \
+    > "$LOG/fleet_kv_contract.log" || {
+  echo "fleet KV handoff/contract check FAILED — see" \
+       "$LOG/fleet_kv_contract.log" >&2
+  exit 1
+}
+python bin/hetu_trace.py "$LOG/fleet_kv_flight.jsonl" --check \
+    > "$LOG/fleet_kv_flight_contract.log" || {
+  echo "fleet KV flight-dump contract check FAILED — see" \
+       "$LOG/fleet_kv_flight_contract.log" >&2
+  exit 1
+}
+
 # 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
 #     must pass BEFORE any chip time is spent).  Speculative-decoding
 #     trace-replay gate: the draft-propose / batched-verify path must
@@ -326,8 +402,14 @@ HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 #     equal slots, acceptance-rate sweep via temperature, greedy
 #     token-identity and the tok/s floor asserted in-bench; the
 #     multi-token verify kernel runs native here — the CPU stage-4e
-#     gate only proves the path).  Runs after decode so the scan
-#     compile is already in the shared compilation cache.
+#     gate only proves the path), PLUS the fleet prefix A/B
+#     (fleet_prefix_ab: affinity-only vs PrefixDirectory routing vs
+#     directory + prefill/decode roles with KV handoff on a
+#     prefix-storm trace at equal fleet slots — tok/s and TTFT p99
+#     floors and greedy token-identity asserted in-bench; the CPU
+#     stage-00e gate proves the chaos-kill degradation path).  Runs
+#     after decode so the scan compile is already in the shared
+#     compilation cache.
 HETU_BENCH_SERVE=1 run serve 3600 python bench.py
 
 # 4d. quantized-bytes A/Bs of record (ISSUE 9).  The serving half rides

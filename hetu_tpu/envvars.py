@@ -297,6 +297,25 @@ _reg("HETU_ROUTER_SHED_ON_SLO", "bool", True,
      "Also shed throughput-class traffic while any replica's SLO "
      "health is at breach (frees capacity to pull latency-class TTFT "
      "back inside budget).", "router")
+_reg("HETU_ROUTER_DIRECTORY", "bool", True,
+     "Fleet prefix-cache directory: route a request whose prompt "
+     "prefix is resident on replica R to R (a directory hit) before "
+     "falling back to the session-affinity hash.  Entries are hints — "
+     "a stale hit degrades to a cold admission, and disabling (or "
+     "chaos-killing) the directory degrades the fleet to exact "
+     "affinity-only routing.", "router")
+_reg("HETU_ROUTER_ROLES", "str", None,
+     "Prefill/decode disaggregation: comma-separated role per replica "
+     "index ('prefill', 'decode', or 'mixed'; unlisted replicas are "
+     "mixed).  With both roles present, long prompts prefill on a "
+     "prefill-heavy replica and their KV blocks are handed off to a "
+     "decode-heavy one (export_blocks/import_blocks).  Unset = every "
+     "replica mixed, no handoffs.", "router")
+_reg("HETU_DIRECTORY_TTL", "float", 0.0,
+     "> 0: seconds an un-refreshed directory entry stays routable; "
+     "expired entries are skipped (counted stale) until re-registered. "
+     "0 = hints never expire (the replica's token-verified match still "
+     "catches every lie).", "router")
 
 # --------------------------------------------------------------------- #
 # quantization (hetu_tpu/quant.py — one layer, three seams)
@@ -323,6 +342,12 @@ _reg("HETU_KV_QUANT", "str", None,
 _reg("HETU_QUANT_CHUNK", "int", 256,
      "Elements per f32 scale for the flat (PS wire / comm pair) int8 "
      "codec; the KV cache always scales per (position, head).", "quant")
+_reg("HETU_HANDOFF_QUANT", "str", "auto",
+     "Replica-to-replica KV handoff wire (export_blocks/import_blocks): "
+     "'auto' ships the pool's native bytes (an int8 pool's payload + "
+     "scales already are the cheap wire), 'int8' forces quantizing an "
+     "exact pool's export through the per-head codec (~4x fewer "
+     "bytes), '0'/'off' pins the exact wire.", "quant")
 
 # --------------------------------------------------------------------- #
 # graph/ops knobs

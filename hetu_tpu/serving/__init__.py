@@ -29,6 +29,14 @@ compiled arithmetic — with the offline path.
                   block-table paged pool (free-list block allocator,
                   refcounted copy-on-write prefix sharing, chunked
                   prefill support) — paged=/$HETU_KV_BLOCK selects it
+    prefix_directory.py
+                  PrefixDirectory: the fleet-wide prefix-cache map
+                  (prefix hash -> which replica holds the KV span),
+                  fed by each replica's PagedKVManager register/evict
+                  callbacks; the router consults it BEFORE the
+                  affinity hash, so any replica's warm cache attracts
+                  matching traffic (hit/steal), with TTL staleness and
+                  graceful degradation to plain affinity when killed
     request.py    Request / Result dataclasses
     metrics.py    ServingMetrics: TTFT/TPOT percentiles, tok/s,
                   occupancy; JSONL events (per-step prefill_ms/
@@ -73,11 +81,12 @@ Quickstart (greedy results are token-identical to ``generate_fast``):
 from ..telemetry.slo import SLO, SLOMonitor
 from .request import Request, Result
 from .kv_manager import (
-    KVCacheManager, PagedKVManager, resolve_kv_block, resolve_kv_quant,
-    round_up_pow2,
+    KVCacheManager, PagedKVManager, resolve_handoff_quant,
+    resolve_kv_block, resolve_kv_quant, round_up_pow2,
 )
 from .metrics import COMPONENTS, ServingMetrics
 from .engine import ServingEngine, QueueFull
+from .prefix_directory import PrefixDirectory, prefix_hash
 from .replica import Replica
 from .router import RouterShed, ServingRouter
 
@@ -85,6 +94,7 @@ __all__ = [
     "ServingEngine", "ServingRouter", "Replica", "QueueFull",
     "RouterShed", "Request", "Result",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",
-    "COMPONENTS", "SLO", "SLOMonitor",
+    "COMPONENTS", "SLO", "SLOMonitor", "PrefixDirectory",
+    "prefix_hash", "resolve_handoff_quant",
     "resolve_kv_block", "resolve_kv_quant", "round_up_pow2",
 ]

@@ -183,6 +183,9 @@ class ServingEngine:
         # tags (e.g. replica=<k> from the fleet router) ride on every
         # event so N engines sharing one merged stream stay separable
         self.metrics = ServingMetrics(log_path, tags=tags)
+        # optional fn(request, slot) called at retirement while the
+        # slot is still live — the router's KV-handoff export seam
+        self.retire_hook = None
         # SLO monitor: explicit SLOMonitor / list of SLOs / default
         # env-declared (HETU_SLO_*; empty = always "ok").  Violations
         # and health transitions route through metrics.event so they
@@ -982,6 +985,10 @@ class ServingEngine:
             request_id=req.request_id, ttft_ms=res.ttft_s * 1e3,
             tok_s=((n - 1) / decode_s
                    if n > 1 and decode_s > 0 else None))
+        if self.retire_hook is not None:
+            # last look at the LIVE slot (the router's KV-handoff
+            # export rides this) — release frees the blocks next
+            self.retire_hook(req, slot)
         self._reqs[slot] = None
         self._gen[slot] = None
         self.kv.release(slot)

@@ -92,6 +92,59 @@ def cache_nbytes(cache):
     return int(cache.nbytes)
 
 
+def resolve_handoff_quant(mode=None):
+    """Replica-to-replica KV handoff WIRE selection.  "auto" (default,
+    ``$HETU_HANDOFF_QUANT``) ships the pool's native bytes — an int8
+    pool's (payload, scales) pair already IS the cheap wire, an exact
+    pool ships exact; "int8" forces an exact (f32/bf16) pool's export
+    through the per-head codec (:func:`quant.kv_encode`, ~4x fewer
+    bytes, small quantization error); "0"/"off" pins the exact wire.
+    Returns "auto", "int8", or None."""
+    if mode is None:
+        mode = envvars.get_str("HETU_HANDOFF_QUANT")
+    s = str(mode).strip().lower() if mode is not None else "auto"
+    if s in ("", "auto"):
+        return "auto"
+    if s in ("0", "off", "none", "false"):
+        return None
+    if s == "int8":
+        return "int8"
+    raise ValueError(f"unknown handoff quant mode {mode!r} "
+                     "(expected 'auto', 'int8', or 'off')")
+
+
+def _wire_repr(gathered, pool_quant, mode):
+    """Resolve one exported cache value to its wire form.  Returns
+    (value, wire_quant) where ``value`` is an exact host array or an
+    (int8, scales) pair and ``wire_quant`` is "int8" or None."""
+    if pool_quant:                      # native pair is already int8
+        return gathered, "int8"
+    if mode == "int8":
+        q, s = quant.kv_encode(jnp.asarray(np.asarray(gathered,
+                                                      np.float32)))
+        return (np.asarray(q), np.asarray(s)), "int8"
+    return gathered, None
+
+
+def _wire_to_pool(wire, wire_quant, pool_cache):
+    """Convert a wire value into the destination pool's representation:
+    (q, scales) for an int8 pool, an array in the pool dtype otherwise.
+    Requantizing an exact wire / dequantizing an int8 wire as needed —
+    so handoffs compose across mixed-precision fleets."""
+    if isinstance(pool_cache, (tuple, list)):           # int8 pool
+        if wire_quant:
+            q, s = wire
+        else:
+            q, s = quant.kv_encode(jnp.asarray(np.asarray(wire,
+                                                          np.float32)))
+        return jnp.asarray(q, jnp.int8), jnp.asarray(s, jnp.float32)
+    if wire_quant:
+        vals = quant.kv_decode(jnp.asarray(wire[0]), jnp.asarray(wire[1]))
+    else:
+        vals = jnp.asarray(np.asarray(wire))
+    return vals.astype(pool_cache.dtype)
+
+
 def resolve_kv_block(paged=None, block=None):
     """Paged-layout selection shared by the engine and bench: returns
     the block size in tokens (0 = slot-contiguous layout).  An explicit
@@ -232,6 +285,65 @@ class KVCacheManager:
         self._free.append(slot)
         self._gauges()
 
+    # ------------------------------------------------------------- #
+    # replica-to-replica handoff (span export — paged parity)
+    # ------------------------------------------------------------- #
+
+    def export_blocks(self, slot, quant_mode=None):
+        """Serialize ``slot``'s filled KV span to a host-side payload
+        (the contiguous parity of ``PagedKVManager.export_blocks``:
+        one dense ``[L, length, H, Dh]`` span per cache instead of a
+        block list).  Refcounts don't exist in this layout, so export
+        is a pure read.  See the paged docstring for the wire grammar."""
+        if self.owner[slot] is None:
+            raise ValueError(f"slot {slot} is free")
+        length = int(self.lengths[slot])
+        mode = resolve_handoff_quant(quant_mode)
+
+        def gather(cache):
+            if isinstance(cache, (tuple, list)):
+                return tuple(np.asarray(a[:, slot, :length]) for a in cache)
+            return np.asarray(cache[:, slot, :length])
+
+        k, kq = _wire_repr(gather(self.cache_k), self.quant, mode)
+        v, _ = _wire_repr(gather(self.cache_v), self.quant, mode)
+        nbytes = cache_nbytes(k) + cache_nbytes(v)
+        shape = (k[0] if isinstance(k, tuple) else k).shape
+        raw = 2 * 4 * int(np.prod(shape))        # f32-equivalent bytes
+        return {"layout": "contiguous", "length": length,
+                "quant": kq, "k": k, "v": v,
+                "nbytes": nbytes, "raw_nbytes": raw}
+
+    def import_blocks(self, payload, owner, *, reserve=None):
+        """Materialize an exported contiguous span into a fresh slot
+        (dequantizing/requantizing the wire into this pool's layout as
+        needed).  Returns the slot, or None when slots are short."""
+        if payload.get("layout") != "contiguous":
+            raise ValueError(
+                f"cannot import a {payload.get('layout')!r} payload "
+                "into a contiguous manager")
+        length = int(payload["length"])
+        reserve = length if reserve is None else int(reserve)
+        if reserve < length:
+            raise ValueError(
+                f"reserve {reserve} below payload length {length}")
+        slot = self.alloc(owner, reserve)
+        if slot is None:
+            return None
+        self.lengths[slot] = length
+        wq = payload["quant"]
+        for name in ("cache_k", "cache_v"):
+            cache = getattr(self, name)
+            vals = _wire_to_pool(payload["k" if name == "cache_k" else "v"],
+                                 wq, cache)
+            if isinstance(cache, (tuple, list)):
+                cache = (cache[0].at[:, slot, :length].set(vals[0]),
+                         cache[1].at[:, slot, :length].set(vals[1]))
+            else:
+                cache = cache.at[:, slot, :length].set(vals)
+            setattr(self, name, cache)
+        return slot
+
 
 class _PrefixEntry:
     """One registered prompt prefix: the tokens (collision-proof key
@@ -323,6 +435,16 @@ class PagedKVManager:
         self.cow_copies = 0
         self.prefix_hits = 0
         self.evictions = 0
+        # fleet directory feed: the router's PrefixDirectory wires
+        # these so registrations/evictions on THIS replica become
+        # fleet-visible hints (None = standalone engine, no directory)
+        self.on_prefix_register = None   # fn(tokens, entry)
+        self.on_prefix_evict = None      # fn(tokens)
+        # replica-to-replica handoff accounting
+        self.exports = 0
+        self.imports = 0
+        self.export_bytes = 0
+        self.import_bytes = 0
 
     # ------------------------------------------------------------- #
 
@@ -416,13 +538,20 @@ class PagedKVManager:
             if key in self._prefix:
                 self._clock += 1
                 self._prefix[key].used = self._clock
+                if self.on_prefix_register is not None:
+                    # re-registration refreshes the directory's
+                    # last-use stamp (TTL staleness tracks real use)
+                    self.on_prefix_register(key, self._prefix[key])
                 continue
             blocks = [int(b)
                       for b in self.tables[slot, :self.blocks_needed(n)]]
             for b in blocks:
                 self.ref[b] += 1
             self._clock += 1
-            self._prefix[key] = _PrefixEntry(key, blocks, n, self._clock)
+            e = _PrefixEntry(key, blocks, n, self._clock)
+            self._prefix[key] = e
+            if self.on_prefix_register is not None:
+                self.on_prefix_register(key, e)
         self._gauges()
 
     def _evict_for(self, need, keep=None):
@@ -441,6 +570,8 @@ class PagedKVManager:
                     self._free.append(b)
             self.evictions += 1
             telemetry.inc("serve.prefix_evictions")
+            if self.on_prefix_evict is not None:
+                self.on_prefix_evict(key)
 
     # ------------------------------------------------------------- #
     # alloc / fork / release
@@ -589,6 +720,111 @@ class PagedKVManager:
         self._gauges()
 
     # ------------------------------------------------------------- #
+    # replica-to-replica handoff (block export / import)
+    # ------------------------------------------------------------- #
+
+    def export_blocks(self, slot, quant_mode=None):
+        """Serialize ``slot``'s FILLED blocks to a host-side payload a
+        peer replica can :meth:`import_blocks`.  Ships exactly
+        ``blocks_needed(length)`` blocks (the filled span, not the
+        whole reservation), as ``[L, n, block, H, Dh]`` host arrays —
+        or the (int8, scales) pair when the pool is quantized or the
+        wire mode forces int8 (:func:`resolve_handoff_quant`), ~4x
+        fewer bytes with scale planes moving in lockstep.  A pure
+        read: refcounts, tables, and the prefix cache are untouched,
+        so COW-shared blocks stay shared on the source."""
+        if self.owner[slot] is None:
+            raise ValueError(f"slot {slot} is free")
+        length = int(self.lengths[slot])
+        n = self.blocks_needed(length)
+        idx = np.asarray([int(b) for b in self.tables[slot, :n]], np.int32)
+        mode = resolve_handoff_quant(quant_mode)
+
+        def gather(cache):
+            if isinstance(cache, (tuple, list)):
+                return tuple(np.asarray(a[:, idx]) for a in cache)
+            return np.asarray(cache[:, idx])
+
+        k, kq = _wire_repr(gather(self.cache_k), self.quant, mode)
+        v, _ = _wire_repr(gather(self.cache_v), self.quant, mode)
+        nbytes = cache_nbytes(k) + cache_nbytes(v)
+        shape = (k[0] if isinstance(k, tuple) else k).shape
+        raw = 2 * 4 * int(np.prod(shape))        # f32-equivalent bytes
+        self.exports += 1
+        self.export_bytes += nbytes
+        telemetry.inc("serve.kv_export_bytes", nbytes)
+        return {"layout": "paged", "block": self.block, "length": length,
+                "quant": kq, "k": k, "v": v,
+                "nbytes": nbytes, "raw_nbytes": raw}
+
+    def import_blocks(self, payload, owner, *, reserve=None, prompt=None):
+        """Materialize an exported span into THIS pool: claims a slot
+        plus fresh blocks for ``reserve`` positions (default: the
+        payload's filled length), writes the wire blocks (requantizing
+        an exact wire into an int8 pool / dequantizing an int8 wire
+        into an exact pool as needed), and — given ``prompt`` — re-
+        registers the prompt's prefix over the imported blocks so later
+        admissions here attach them refcounted (the whole point of a
+        prefill→decode handoff).  Returns the slot, or None when slots
+        or blocks are short (backpressure, same contract as ``alloc``).
+        Block size and layout must match; a mismatch raises."""
+        if payload.get("layout") != "paged":
+            raise ValueError(
+                f"cannot import a {payload.get('layout')!r} payload "
+                "into a paged manager")
+        if int(payload["block"]) != self.block:
+            raise ValueError(
+                f"payload block size {payload['block']} != pool block "
+                f"size {self.block}")
+        length = int(payload["length"])
+        reserve = length if reserve is None else int(reserve)
+        if reserve < length:
+            raise ValueError(
+                f"reserve {reserve} below payload length {length}")
+        if reserve > self.s_max:
+            raise ValueError(
+                f"sequence length {reserve} exceeds S_max {self.s_max}")
+        if not self._free_slots:
+            return None
+        n_pay = self.blocks_needed(length)
+        total = self.blocks_needed(reserve)
+        if len(self._free) < total:
+            self._evict_for(total)
+            if len(self._free) < total:
+                return None
+        slot = self._free_slots.pop()
+        row = []
+        for _ in range(total):
+            b = self._free.pop()
+            self.ref[b] = 1
+            row.append(b)
+        dst = np.asarray(row[:n_pay], np.int32)
+        wq = payload["quant"]
+        for name in ("cache_k", "cache_v"):
+            cache = getattr(self, name)
+            vals = _wire_to_pool(payload["k" if name == "cache_k" else "v"],
+                                 wq, cache)
+            if isinstance(cache, (tuple, list)):
+                cache = (cache[0].at[:, dst].set(vals[0]),
+                         cache[1].at[:, dst].set(vals[1]))
+            else:
+                cache = cache.at[:, dst].set(vals)
+            setattr(self, name, cache)
+        self.tables[slot, :] = 0
+        self.tables[slot, :len(row)] = row
+        self.n_table[slot] = len(row)
+        self.owner[slot] = owner
+        self.lengths[slot] = length
+        self.total_allocs += 1
+        self.imports += 1
+        self.import_bytes += int(payload["nbytes"])
+        telemetry.inc("serve.kv_import_bytes", int(payload["nbytes"]))
+        if prompt is not None and len(prompt) <= length:
+            self.register_prefix(prompt, slot)
+        self._gauges()
+        return slot
+
+    # ------------------------------------------------------------- #
 
     def stats(self):
         """JSON-able pool view (bench/telemetry surface)."""
@@ -601,6 +837,10 @@ class PagedKVManager:
             "prefix_hits": self.prefix_hits,
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
+            "exports": self.exports,
+            "imports": self.imports,
+            "export_bytes": self.export_bytes,
+            "import_bytes": self.import_bytes,
             "quant": self.quant or "off",
             "cache_bytes": self.cache_bytes,
         }

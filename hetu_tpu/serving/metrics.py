@@ -22,6 +22,11 @@ full component breakdown:
                     engine saw the request (the fleet router requeued
                     it off a dead/wedged replica — serving/router.py
                     credits the hop at re-submission); 0 un-routed
+    handoff_ms      prefill->decode disaggregation detour before this
+                    engine saw the request: clone prefill on the
+                    prefill-heavy replica + KV export/import (the
+                    router credits it when the imported blocks land);
+                    0 without a handoff
     kv_alloc_ms     slot + block-table claim
     prefill_ms      prompt compute actually dispatched for this request
     chunk_stall_ms  prefill-phase wall not spent computing (chunked
@@ -48,8 +53,8 @@ from ..telemetry.metrics import percentile
 
 import numpy as np
 
-COMPONENTS = ("queue_ms", "requeue_ms", "router_hop_ms", "kv_alloc_ms",
-              "prefill_ms", "chunk_stall_ms", "decode_ms")
+COMPONENTS = ("queue_ms", "requeue_ms", "router_hop_ms", "handoff_ms",
+              "kv_alloc_ms", "prefill_ms", "chunk_stall_ms", "decode_ms")
 
 
 def _pct(xs, q):
@@ -64,7 +69,8 @@ class _Lifecycle:
     """Perf-counter timeline of one request, engine-side."""
 
     __slots__ = ("t_submit", "t_blocked", "t_claim", "kv_alloc_ms",
-                 "prefill_ms", "t_first", "n_prefills", "hop_ms")
+                 "prefill_ms", "t_first", "n_prefills", "hop_ms",
+                 "handoff_ms")
 
     def __init__(self, t_submit):
         self.t_submit = t_submit
@@ -75,6 +81,7 @@ class _Lifecycle:
         self.n_prefills = 0       # dispatches (chunks) it rode in
         self.t_first = None       # first token landed
         self.hop_ms = 0.0         # router requeue hops before us
+        self.handoff_ms = 0.0     # prefill->decode handoff detour
 
 
 class ServingMetrics:
@@ -186,6 +193,15 @@ class ServingMetrics:
         lc = self._lc.get(request_id)
         if lc is not None:
             lc.hop_ms += float(hop_ms)
+
+    def lc_handoff(self, request_id, handoff_ms):
+        """Credit the prefill->decode disaggregation detour: wall time
+        between the router flipping this request into its prefill
+        phase and the exported KV blocks landing on THIS engine's pool
+        (called by the router right after the import)."""
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.handoff_ms += float(handoff_ms)
 
     # ------------------------------------------------------------- #
 
@@ -304,7 +320,7 @@ class ServingMetrics:
             if n_generated > 1 else 0.0
         ttft_ms = max(lc.t_first - lc.t_submit, 0.0) * 1e3
         comp = {"queue_ms": queue_ms, "requeue_ms": requeue_ms,
-                "router_hop_ms": lc.hop_ms,
+                "router_hop_ms": lc.hop_ms, "handoff_ms": lc.handoff_ms,
                 "kv_alloc_ms": lc.kv_alloc_ms, "prefill_ms": prefill_ms,
                 "chunk_stall_ms": chunk_stall_ms, "decode_ms": decode_ms}
         for k, v in comp.items():
@@ -323,6 +339,12 @@ class ServingMetrics:
                   ("kv_alloc", claim_start, lc.kv_alloc_ms, {})]
         if lc.t_blocked is not None:
             phases.insert(1, ("requeue", lc.t_blocked, requeue_ms, {}))
+        if lc.handoff_ms > 0:
+            # like the hop: the detour ended at this engine's submit —
+            # backdate so the track reads handoff -> queue -> ...
+            phases.insert(0, ("handoff",
+                              lc.t_submit - lc.handoff_ms / 1e3,
+                              lc.handoff_ms, {}))
         if lc.hop_ms > 0:
             # the hop happened BEFORE this engine's submit: backdate
             # its span so the request's track reads hop -> queue -> ...

@@ -1,0 +1,202 @@
+"""Fleet-wide prefix-cache directory: WHO holds WHICH prompt prefix.
+
+PR 8's router is session-affine by hash — it keeps one session's warm
+blocks on one replica but has no idea which replica actually holds
+which prefix, so N tenants sharing a system prompt prefill it once PER
+REPLICA.  ``PrefixDirectory`` closes that gap: a fleet-shared map of
+prefix-hash → {replica: last-use} fed by each replica's refcounted
+prefix table (``PagedKVManager.register_prefix`` fires
+``on_prefix_register``/``on_prefix_evict`` callbacks the directory
+wires at :meth:`attach`).  The router consults :meth:`lookup` BEFORE
+the affinity hash — a request whose prompt prefix is resident on
+replica R routes to R (a *directory hit*) and reuses the blocks
+instead of recomputing them.
+
+Entries are HINTS, never truth: the replica's own token-verified
+``match_prefix`` is still the only thing that attaches KV, so a stale
+hit (replica restarted, prefix LRU-evicted a microsecond ago, TTL
+expired) degrades to a normal cold admission — never an error.
+Killing the directory outright (chaos role "directory") degrades the
+whole fleet to exact PR 8 session-affinity behavior.  Counters:
+
+- ``hits``    — placed on the replica the directory suggested
+- ``misses``  — no entry covered the prompt
+- ``stale``   — only TTL-expired entries covered it (skipped)
+- ``steals``  — the directory knew a holder but placement landed
+  elsewhere (holder dead/breaker-open/full); the prefix is recomputed
+  and re-registered at the new home — "stolen"
+
+Hit/steal are stamped by the router at placement time (only it knows
+where the request actually landed); miss/stale are counted here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+import numpy as np
+
+from .. import envvars
+
+
+def prefix_hash(tokens):
+    """Stable 64-bit digest of a token prefix (hex).  Collisions are
+    harmless — the replica's ``match_prefix`` verifies tokens before
+    attaching anything — so 64 bits is plenty for a routing hint."""
+    arr = np.asarray([int(t) for t in tokens], np.int64)
+    return hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()
+
+
+class _DirEntry:
+    """One known prefix: its length/block span (for introspection) and
+    the replicas holding it with per-replica last-use stamps."""
+
+    __slots__ = ("length", "blocks", "refs", "replicas")
+
+    def __init__(self, length, blocks):
+        self.length = length
+        self.blocks = blocks
+        self.refs = 0                    # lifetime registrations
+        self.replicas = {}               # replica index -> last-use t
+
+
+class PrefixDirectory:
+    """The fleet map.  ``ttl`` seconds bound how long an un-refreshed
+    entry stays routable (``$HETU_DIRECTORY_TTL``; 0 = hints never
+    expire — the token-verified degradation path still catches every
+    lie, TTL just caps how often it has to)."""
+
+    def __init__(self, *, ttl=None, now=None):
+        if ttl is None:
+            ttl = envvars.get_float("HETU_DIRECTORY_TTL")
+        self.ttl = float(ttl or 0.0)
+        self._now = now or time.perf_counter
+        self._entries = {}               # hash -> _DirEntry
+        self._block = None               # fleet block size (from attach)
+        self.hits = 0
+        self.misses = 0
+        self.stale = 0
+        self.steals = 0
+        self.registrations = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------- #
+    # replica feed
+    # ------------------------------------------------------------- #
+
+    def attach(self, replica, kv):
+        """Wire a replica's paged manager into the directory.  Called
+        on every (re)start: a respawned replica's old entries are
+        dropped first — its fresh pool holds nothing.  A contiguous or
+        non-sharing manager attaches as a no-op (the fleet then simply
+        never produces directory hits for that replica)."""
+        self.drop_replica(replica)
+        if not getattr(kv, "prefix_share", False):
+            return
+        block = getattr(kv, "block", None)
+        if block is None:
+            return
+        self._block = int(block)
+        kv.on_prefix_register = \
+            lambda toks, e, _r=replica: self.register(_r, toks, e)
+        kv.on_prefix_evict = \
+            lambda toks, _r=replica: self.evict(_r, toks)
+
+    def register(self, replica, tokens, entry=None):
+        """Record that ``replica`` now holds the prefix ``tokens``
+        (or refresh its last-use stamp)."""
+        h = prefix_hash(tokens)
+        e = self._entries.get(h)
+        if e is None:
+            blocks = len(entry.blocks) if entry is not None else 0
+            e = self._entries[h] = _DirEntry(len(tokens), blocks)
+        e.refs += 1
+        e.replicas[replica] = self._now()
+        self.registrations += 1
+
+    def evict(self, replica, tokens):
+        """Drop ``replica``'s claim on ``tokens`` (LRU eviction on the
+        replica); the entry dies with its last holder."""
+        h = prefix_hash(tokens)
+        e = self._entries.get(h)
+        if e is None:
+            return
+        e.replicas.pop(replica, None)
+        if not e.replicas:
+            del self._entries[h]
+        self.evictions += 1
+
+    def drop_replica(self, replica):
+        """Purge every entry naming ``replica`` (death/respawn)."""
+        dead = []
+        for h, e in self._entries.items():
+            e.replicas.pop(replica, None)
+            if not e.replicas:
+                dead.append(h)
+        for h in dead:
+            del self._entries[h]
+
+    # ------------------------------------------------------------- #
+    # routing consult
+    # ------------------------------------------------------------- #
+
+    def _expired(self, stamp, now):
+        return self.ttl > 0 and (now - stamp) > self.ttl
+
+    def lookup(self, prompt, now=None):
+        """Longest block-aligned registered prefix of ``prompt``.
+        Probes block-boundary cuts longest-first (registrations are
+        keyed there, and the usable share is capped below the last
+        prompt position anyway); of several holders the most recently
+        used wins.  Returns ``(hint, outcome)``: ``hint`` is
+        ``(replica, cached_len)`` or None; ``outcome`` is None when a
+        fresh holder was found (the router stamps hit/steal once it
+        knows where placement landed), else "miss" (nothing known) or
+        "stale" (only TTL-expired claims) — both counted here."""
+        if self._block is None or len(prompt) < 2:
+            self.misses += 1
+            return None, "miss"
+        now = self._now() if now is None else now
+        p = [int(t) for t in prompt]
+        top = ((len(p) - 1) // self._block) * self._block
+        saw_stale = False
+        for n in range(top, 0, -self._block):
+            e = self._entries.get(prefix_hash(p[:n]))
+            if e is None:
+                continue
+            fresh = {r: ts for r, ts in e.replicas.items()
+                     if not self._expired(ts, now)}
+            if not fresh:
+                saw_stale = True
+                continue
+            return (max(fresh, key=fresh.get), n), None
+        if saw_stale:
+            self.stale += 1
+            return None, "stale"
+        self.misses += 1
+        return None, "miss"
+
+    # ------------------------------------------------------------- #
+
+    @property
+    def lookups(self):
+        return self.hits + self.misses + self.stale + self.steals
+
+    @property
+    def hit_rate(self):
+        return self.hits / max(1, self.lookups)
+
+    def snapshot(self):
+        """JSON-able directory view (router snapshot / hetu_top)."""
+        return {
+            "entries": len(self._entries),
+            "ttl": self.ttl,
+            "hits": self.hits,
+            "misses": self.misses,
+            "stale": self.stale,
+            "steals": self.steals,
+            "hit_rate": round(self.hit_rate, 4),
+            "registrations": self.registrations,
+            "evictions": self.evictions,
+        }

@@ -59,9 +59,18 @@ class Replica:
     """
 
     def __init__(self, index, factory, *, restart_limit=None,
-                 restart_backoff=None, emit_fn=None):
+                 restart_backoff=None, emit_fn=None, kind="mixed",
+                 on_start=None):
         self.index = int(index)
         self.role = f"replica{self.index}"
+        # serving role for prefill/decode disaggregation
+        # ("prefill"/"decode"/"mixed" — HETU_ROUTER_ROLES via the
+        # router); distinct from ``role``, the chaos-plan label
+        self.kind = str(kind)
+        # per-incarnation wiring callback (router: directory feed +
+        # handoff export hook) — re-fires on every respawn so a fresh
+        # engine is never left unwired
+        self.on_start = on_start
         self.factory = factory
         self.restart_limit = (
             restart_limit if restart_limit is not None
@@ -92,12 +101,15 @@ class Replica:
         """Spawn a fresh engine incarnation (the supervisor's respawn)."""
         self.engine = self.factory(self.index)
         self.engine.metrics.tags.setdefault("replica", self.index)
+        self.engine.metrics.tags.setdefault("role", self.kind)
         self.state = UP
         self.exit_code = None
         self.exit_error = None
         self.next_at = None
         self.last_beat = time.perf_counter()
         self.drained = True
+        if self.on_start is not None:
+            self.on_start(self)
 
     def die(self, rc, error=None):
         """The incarnation is gone: its queue and in-flight slots are
@@ -247,6 +259,7 @@ class Replica:
         return {
             "replica": self.index,
             "state": self.state,
+            "role": self.kind,
             "health": self.health(),
             "restarts": self.restarts,
             "steps": self.steps,

@@ -16,7 +16,38 @@ behind this router, which owns everything a fleet adds to the problem:
   shared-prefix KV blocks (PR 6's refcounted prefix cache) stay hot on
   the replica that already holds them.  When the home replica is
   unroutable the session is remapped to the best peer and the
-  ``prefix_misses`` counter records the cold start.
+  ``affinity_prefix_misses`` counter records the cold start
+  (``prefix_misses`` is kept as a back-compat snapshot key).
+
+- **Fleet prefix-cache directory.**  Session affinity only guesses
+  where warm KV lives; the :class:`PrefixDirectory` KNOWS — each
+  replica's refcounted prefix table feeds it registration/eviction
+  events, and placement consults it BEFORE the affinity hash: a
+  request whose prompt prefix is resident on replica R routes to R (a
+  *directory hit*) and attaches the blocks instead of recomputing
+  them, falling back to affinity on miss.  Entries are hints: a stale
+  hit degrades to a cold admission (the replica's token-verified
+  ``match_prefix`` is the only thing that attaches KV), and killing
+  the directory (``kill_directory()`` / chaos role "directory")
+  degrades the fleet to exact affinity-only behavior —
+  ``HETU_ROUTER_DIRECTORY=0`` pins that mode.
+
+- **Prefill/decode disaggregation with KV handoff.**  With
+  ``HETU_ROUTER_ROLES`` marking replicas prefill-heavy or
+  decode-heavy, a long prompt with no resident prefix anywhere first
+  runs as a one-token prefill clone on a prefill-heavy replica; at its
+  retirement the router exports the slot's KV blocks
+  (``PagedKVManager.export_blocks`` — an int8 pool ships its payload +
+  scale planes natively, ~4x cheaper than f32, and
+  ``HETU_HANDOFF_QUANT=int8`` forces that wire for exact pools), then
+  places the real request on a decode-heavy replica and imports the
+  blocks there (``import_blocks`` re-registers the prompt prefix, so
+  admission attaches them refcounted).  ``kv_handoff_out``/
+  ``kv_handoff_in`` events pair per handoff (a trace --check rule),
+  the detour's wall time lands in the ``handoff_ms`` lifecycle
+  component, and every failure mode — export short, import short, no
+  decode replica up — degrades to a normal cold admission, never an
+  error.
 
 - **Supervised replicas with drain + requeue.**  Replicas die (chaos
   kill, scheduler exception) and wedge (alive, silent).  Death is
@@ -69,12 +100,15 @@ tests supervise local processes.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import hashlib
 import time
 
 from .. import envvars, telemetry
+from ..ps import faults
 from ..telemetry import flight
 from .engine import QueueFull, _STORM_REJECTS
+from .prefix_directory import PrefixDirectory
 from .replica import BACKOFF, DEAD, UP, WEDGED, Replica  # noqa: F401
 
 # health-state weights for the routing score (breach still gets a
@@ -82,6 +116,17 @@ from .replica import BACKOFF, DEAD, UP, WEDGED, Replica  # noqa: F401
 # turn a soft breach into a hard outage)
 _HEALTH_W = {"ok": 1.0, "degraded": 0.5, "breach": 0.25}
 _LEVEL = {"ok": 0, "degraded": 1, "breach": 2}
+
+# role-fit rank per placement phase (stable sort: score order is kept
+# within a rank) — a prefill-phase placement prefers prefill-heavy
+# replicas, the real (decode) placement prefers decode-heavy ones,
+# mixed replicas serve both
+_ROLE_RANK = {
+    "prefill": {"prefill": 0, "mixed": 1, "decode": 2},
+    "decode": {"decode": 0, "mixed": 1, "prefill": 2},
+}
+
+_ROLES = ("prefill", "decode", "mixed")
 
 
 class RouterShed(QueueFull):
@@ -95,7 +140,8 @@ class _Routed:
 
     __slots__ = ("request", "t_submit", "t_assigned", "replica",
                  "prev_replica", "hops", "retries", "next_at", "done",
-                 "lost", "result")
+                 "lost", "result", "phase", "prefill_req", "handoff",
+                 "handoff_src", "t_phase")
 
     def __init__(self, request, t_submit):
         self.request = request
@@ -109,6 +155,15 @@ class _Routed:
         self.done = False
         self.lost = False            # retry budget exhausted
         self.result = None
+        # prefill/decode disaggregation: "decode" is the normal
+        # lifecycle; "prefill" means a one-token clone is running (or
+        # queued) on a prefill-heavy replica and the real request
+        # places only after its KV blocks are exported
+        self.phase = "decode"
+        self.prefill_req = None      # the max_new_tokens=1 clone
+        self.handoff = None          # exported KV payload in transit
+        self.handoff_src = None      # replica the payload came from
+        self.t_phase = None          # prefill-detour start (handoff_ms)
 
 
 def _session_hash(session_id, n):
@@ -135,7 +190,8 @@ class ServingRouter:
                  breaker_cooldown=None, retry_limit=None,
                  retry_backoff=None, shed_queue=None, shed_on_slo=None,
                  restart_limit=None, restart_backoff=None,
-                 log_path=None):
+                 directory=None, directory_ttl=None, roles=None,
+                 handoff_quant=None, log_path=None):
         n = int(replicas if replicas is not None
                 else envvars.get_int("HETU_REPLICAS"))
         if n < 1:
@@ -164,10 +220,33 @@ class ServingRouter:
             shed_on_slo if shed_on_slo is not None
             else envvars.get_bool("HETU_ROUTER_SHED_ON_SLO"))
         self.log_path = log_path
+        # fleet prefix-cache directory (must exist before the replicas:
+        # each incarnation wires itself in via _wire_replica)
+        use_dir = (directory if directory is not None
+                   else envvars.get_bool("HETU_ROUTER_DIRECTORY"))
+        self.directory = (PrefixDirectory(ttl=directory_ttl)
+                          if use_dir else None)
+        self.directory_killed = False
+        # prefill/decode roles, one per replica index; unlisted = mixed
+        raw = roles if roles is not None \
+            else envvars.get_str("HETU_ROUTER_ROLES")
+        parsed = [s.strip().lower()
+                  for s in str(raw or "").split(",") if s.strip()]
+        for s in parsed:
+            if s not in _ROLES:
+                raise ValueError(
+                    f"unknown replica role {s!r} (expected one of "
+                    f"{_ROLES})")
+        self.roles = (parsed + ["mixed"] * n)[:n]
+        # handoffs need both phases represented somewhere in the fleet
+        self._roles_active = ("prefill" in self.roles
+                              and "decode" in self.roles)
+        self.handoff_quant = handoff_quant
         self.replicas = [
             Replica(i, factory, restart_limit=restart_limit,
                     restart_backoff=restart_backoff,
-                    emit_fn=self._fail_event)
+                    emit_fn=self._fail_event, kind=self.roles[i],
+                    on_start=self._wire_replica)
             for i in range(n)]
         self.s_max = self.replicas[0].engine.kv.s_max
         self._routed = {}                      # rid -> _Routed
@@ -187,12 +266,149 @@ class ServingRouter:
         self.expired = 0
         self.lost = 0
         self.duplicates = 0
-        self.prefix_misses = 0
+        self.affinity_prefix_misses = 0
+        self.handoffs = 0
+        self.handoff_failed = 0
+        self.handoffs_skipped = 0
+        self.handoff_bytes = 0
         self._placed = [0] * n
         self._rejects = [0] * n
         self._lat = []                         # fleet e2e latency (s)
         self._ttft = []                        # fleet submit->token1 (s)
         self._ttft_by_class = {"latency": [], "throughput": []}
+
+    @property
+    def prefix_misses(self):
+        """Back-compat alias: before the directory split this counter
+        (affinity remaps only) was named ``prefix_misses``."""
+        return self.affinity_prefix_misses
+
+    # ------------------------------------------------------------- #
+    # directory + handoff wiring
+    # ------------------------------------------------------------- #
+
+    def _wire_replica(self, rep):
+        """Per-incarnation wiring (fires from ``Replica._start``, so
+        respawns rewire themselves): feed the fresh engine's prefix
+        registrations into the directory and install the retire hook
+        that exports a prefill-phase slot's KV before release."""
+        eng = rep.engine
+        if eng is None:
+            return
+        if self.directory is not None:
+            self.directory.attach(rep.index, eng.kv)
+        eng.retire_hook = \
+            lambda req, slot, _rep=rep: self._on_retire(_rep, req, slot)
+
+    def _on_retire(self, rep, req, slot):
+        """Engine retire hook: a prefill-phase clone is retiring with
+        its slot still live — export the KV blocks now (release frees
+        them a moment later)."""
+        routed = self._routed.get(req.request_id)
+        if routed is None or routed.phase != "prefill":
+            return
+        try:
+            routed.handoff = rep.engine.kv.export_blocks(
+                slot, self.handoff_quant)
+            routed.handoff_src = rep.index
+        except ValueError:
+            # can't serialize (already released?): the real request
+            # admits cold — degradation, not failure
+            routed.handoff = None
+
+    def kill_directory(self, reason="killed"):
+        """Drop the directory: the fleet degrades to exact PR 8
+        session-affinity routing (and, roles aside, no new handoffs
+        start — in-flight payloads still land).  The chaos gate drives
+        this mid-trace and asserts zero token loss."""
+        if self.directory is None:
+            return
+        self.directory = None
+        self.directory_killed = True
+        self._fail_event("directory_killed", reason=reason)
+        flight.RECORDER.dump("directory_killed")
+
+    def _directory_lookup(self, req, now):
+        """One routing consult; returns (hint, outcome) — see
+        ``PrefixDirectory.lookup``.  The chaos seam lives here: a drawn
+        kill (role "directory") drops the directory mid-lookup."""
+        if self.directory is None:
+            return None, None
+        plan = faults.plan_from_env()
+        if plan is not None:
+            f = plan.draw(method="router.directory_lookup",
+                          kinds=("kill",), role="directory", inline=True)
+            if f is not None and f.kind == "kill":
+                self.kill_directory(reason="chaos")
+                return None, None
+        return self.directory.lookup(req.prompt, now)
+
+    def _handoff_applies(self, req):
+        """A prefill->decode handoff is worth starting only when both
+        roles exist in the fleet, the engines run the paged
+        prefix-sharing layout, and the prompt spans at least one full
+        block (``match_prefix`` caps sharing below the last prompt
+        position, so a sub-block prompt hands off nothing)."""
+        if not self._roles_active:
+            return False
+        for r in self.replicas:
+            if r.engine is not None:
+                kv = r.engine.kv
+                block = getattr(kv, "block", None)
+                return (getattr(kv, "prefix_share", False)
+                        and block is not None
+                        and len(req.prompt) > block)
+        return False
+
+    def _import_handoff(self, routed, rep, now):
+        """The real request just placed on ``rep``: land its prefilled
+        KV there.  Emits the paired ``kv_handoff_out``/``kv_handoff_in``
+        records only when the blocks actually move — an import the pool
+        cannot hold degrades to a cold admission (counted, flight-
+        visible, never an error)."""
+        payload, src = routed.handoff, routed.handoff_src
+        routed.handoff = None
+        req = routed.request
+        rid = req.request_id
+        if rep.index == src:
+            # placement landed back on the prefill replica: the clone
+            # already registered the prefix there — nothing to move
+            self.handoffs_skipped += 1
+            return
+        kv = rep.engine.kv
+        slot = None
+        if (getattr(kv, "prefix_share", False)
+                and payload.get("layout") == "paged"
+                and payload.get("block") == getattr(kv, "block", None)):
+            try:
+                slot = kv.import_blocks(payload, f"{rid}~handoff",
+                                        prompt=req.prompt)
+            except ValueError:
+                slot = None
+        if slot is None:
+            self.handoff_failed += 1
+            self._event("kv_handoff_drop", request=rid,
+                        replica=rep.index, from_replica=src)
+            return
+        # the import slot was only a write vehicle: release it — the
+        # re-registered prefix keeps the blocks alive (refcounted), and
+        # this request's admission attaches them
+        kv.release(slot)
+        self.handoffs += 1
+        nbytes = int(payload["nbytes"])
+        self.handoff_bytes += nbytes
+        blocks = -(-int(payload["length"]) // int(payload["block"]))
+        hand_ms = (now - (routed.t_phase
+                          if routed.t_phase is not None
+                          else routed.t_submit)) * 1e3
+        rep.engine.metrics.lc_handoff(rid, hand_ms)
+        telemetry.inc("router.handoffs")
+        self._event("kv_handoff_out", request=rid, replica=src,
+                    to_replica=rep.index, bytes=nbytes, blocks=blocks,
+                    quant=payload["quant"] or "off")
+        self._event("kv_handoff_in", request=rid, replica=rep.index,
+                    from_replica=src, bytes=nbytes,
+                    handoff_ms=round(hand_ms, 3))
 
     # ------------------------------------------------------------- #
     # events
@@ -296,13 +512,25 @@ class ServingRouter:
         return w / (1.0 + r.queue_depth + r.live)
 
     def _candidates(self, routed, now):
-        """Routable replicas, best first; the session's home replica
-        (stable hash) leads when affinity applies and it is routable."""
+        """Routable replicas, best first.  With roles active the
+        placement phase partitions first (prefill-phase -> prefill-
+        heavy replicas lead; decode -> decode-heavy; stable, so score
+        order holds within a role rank).  The session's home replica
+        (stable hash) leads a decode-phase placement when affinity
+        applies and it is routable — a prefill clone has no warmth to
+        return to, so affinity skips it, and so does a request
+        carrying an exported KV payload (the handoff brings its own
+        warmth wherever it lands; the role rank should pick a
+        decode-heavy home, not the session hash)."""
         cands = [r for r in self.replicas
                  if r.state == UP and self._breaker_allows(r.index, now)]
         cands.sort(key=lambda r: (-self._score(r), r.index))
+        if self._roles_active:
+            rank = _ROLE_RANK[routed.phase]
+            cands.sort(key=lambda r: rank.get(r.kind, 1))
         sid = routed.request.session_id
-        if self.session_affinity and sid is not None and cands:
+        if self.session_affinity and sid is not None and cands \
+                and routed.phase == "decode" and routed.handoff is None:
             home = _session_hash(sid, len(self.replicas))
             for i, r in enumerate(cands):
                 if r.index == home:
@@ -311,15 +539,41 @@ class ServingRouter:
         return cands
 
     def _place(self, routed, now):
-        """Try to put the request on a replica (best candidate first);
-        returns True on success.  Emits router_route (first placement)
-        or router_hop (requeue) and credits the hop's wall time to the
-        peer engine's lifecycle tracker."""
+        """Try to put the request on a replica; returns True on
+        success.  Placement order: directory hint first (the replica
+        that HOLDS the prompt's prefix), then role fit, then session
+        affinity, then health-weighted score.  A long prompt no
+        replica holds, in a role-split fleet, flips the record into
+        its prefill phase here (a one-token clone places instead; the
+        real request follows the exported KV).  Emits router_route
+        (first placement) or router_hop (requeue) and credits the
+        hop's wall time to the peer engine's lifecycle tracker."""
         req = routed.request
         rid = req.request_id
-        for r in self._candidates(routed, now):
+        hint = outcome = None
+        if routed.phase == "decode" and routed.handoff is None:
+            # prefill-phase placements CREATE a prefix (nothing to look
+            # up), and a request carrying a handoff payload already
+            # knows where its KV is going
+            hint, outcome = self._directory_lookup(req, now)
+            if (hint is None and routed.hops == 0
+                    and routed.retries == 0
+                    and self._handoff_applies(req)):
+                routed.phase = "prefill"
+                routed.prefill_req = dataclasses.replace(
+                    req, max_new_tokens=1, stream_cb=None)
+                routed.t_phase = now
+        wire_req = (routed.prefill_req if routed.phase == "prefill"
+                    else req)
+        cands = self._candidates(routed, now)
+        if hint is not None:
+            for i, r in enumerate(cands):
+                if r.index == hint[0]:
+                    cands.insert(0, cands.pop(i))
+                    break
+        for r in cands:
             try:
-                r.submit(req)
+                r.submit(wire_req)
             except QueueFull:
                 self._note_reject(r.index)
                 continue
@@ -330,15 +584,29 @@ class ServingRouter:
                 b["probe"] = rid
             sid = req.session_id
             affinity = None
-            if self.session_affinity and sid is not None:
+            if self.session_affinity and sid is not None \
+                    and routed.phase == "decode":
                 last = self._session_last.get(sid)
                 affinity = "hit" if last in (None, r.index) else "miss"
-                if affinity == "miss":
+                if affinity == "miss" and routed.handoff is None:
                     # the session's warm prefix blocks live elsewhere:
-                    # this placement pays the cold prefill
-                    self.prefix_misses += 1
+                    # this placement pays the cold prefill (a handoff
+                    # payload is exempt — it ships the warmth along)
+                    self.affinity_prefix_misses += 1
                     telemetry.inc("router.prefix_miss")
                 self._session_last[sid] = r.index
+            if hint is not None:
+                if r.index == hint[0]:
+                    outcome = "hit"
+                    if self.directory is not None:
+                        self.directory.hits += 1
+                else:
+                    # the directory knew a holder but placement landed
+                    # elsewhere: the prefix gets recomputed (and
+                    # re-registered) at the new home — "stolen"
+                    outcome = "steal"
+                    if self.directory is not None:
+                        self.directory.steals += 1
             self._assigned[r.index][rid] = None
             if routed.hops:
                 hop_ms = (now - (routed.t_assigned
@@ -352,10 +620,15 @@ class ServingRouter:
             else:
                 self._event("router_route", request=rid,
                             replica=r.index, slo_class=req.slo_class,
+                            phase=routed.phase,
                             **({"affinity": affinity}
-                               if affinity else {}))
+                               if affinity else {}),
+                            **({"directory": outcome}
+                               if outcome else {}))
             routed.replica = r.index
             routed.t_assigned = now
+            if routed.handoff is not None:
+                self._import_handoff(routed, r, now)
             return True
         return False
 
@@ -496,6 +769,9 @@ class ServingRouter:
         breaker notes the failure, and the supervisor schedules the
         respawn (or goes terminal)."""
         self._breaker_failure(r.index, now)
+        if self.directory is not None:
+            # its pool died with it: every hint naming it is now a lie
+            self.directory.drop_replica(r.index)
         assigned = self._assigned[r.index]
         lost = [rid for rid in assigned
                 if not self._routed[rid].done]
@@ -566,6 +842,18 @@ class ServingRouter:
         if routed.done:
             self.duplicates += 1
             return None
+        if routed.phase == "prefill":
+            # the one-token prefill clone retired (its KV export rode
+            # the retire hook): the request is NOT finished — place the
+            # real request, payload in hand, on a decode-heavy replica
+            self._assigned[idx].pop(res.request_id, None)
+            self._breaker_success(idx, res.request_id)
+            routed.phase = "decode"
+            now = time.perf_counter()
+            if not self._place(routed, now):
+                # decode side full right now: the retry loop owns it
+                self._pending.append(routed)
+            return None
         routed.done = True
         routed.result = res
         self._assigned[idx].pop(res.request_id, None)
@@ -624,7 +912,21 @@ class ServingRouter:
             "expired": self.expired,
             "lost": self.lost,
             "duplicates": self.duplicates,
-            "prefix_misses": self.prefix_misses,
+            # back-compat key: pre-directory dashboards read the
+            # affinity remap count under this name
+            "prefix_misses": self.affinity_prefix_misses,
+            "affinity_prefix_misses": self.affinity_prefix_misses,
+            "roles": list(self.roles),
+            "directory": (self.directory.snapshot()
+                          if self.directory is not None else None),
+            "directory_killed": self.directory_killed,
+            "directory_hit_rate": (
+                round(self.directory.hit_rate, 4)
+                if self.directory is not None else None),
+            "handoffs": self.handoffs,
+            "handoff_failed": self.handoff_failed,
+            "handoffs_skipped": self.handoffs_skipped,
+            "handoff_bytes": self.handoff_bytes,
             "latency_p50_s": _p(self._lat, 50),
             "latency_p95_s": _p(self._lat, 95),
             "latency_p99_s": _p(self._lat, 99),

@@ -100,6 +100,13 @@ REQUIRED_FIELDS = {
     "router_breaker": ("replica", "state"),
     "router_deadline": ("request",),
     "router_retry_exhausted": ("request",),
+    # serving fleet: KV directory + prefill/decode handoff (ISSUE 12;
+    # out/in pair per moved span — hetu_trace --check enforces the
+    # pairing; drop = a failed import that degraded to cold admission)
+    "kv_handoff_out": ("request", "replica", "to_replica"),
+    "kv_handoff_in": ("request", "replica", "from_replica"),
+    "kv_handoff_drop": ("request", "replica"),
+    "directory_killed": ("reason",),
     # flight recorder dump header (telemetry/flight.py)
     "flight_dump": ("reason",),
     # telemetry core + bench

@@ -27,11 +27,16 @@ exits (scripts, tests); otherwise the screen refreshes every
 ``--interval`` seconds until Ctrl-C.
 
 ``--fleet`` switches to the ServingRouter view: one row per replica
-(state/health, occupancy, queue depth, breaker state, routed/requeue/
-reject/death counts) assembled from the ``replica``-tagged serve
-events plus the router's ``router_route``/``router_hop``/
-``router_breaker`` and the supervisor's ``replica_*`` failure records,
-with fleet totals (shed by class, requeues, pressure) underneath.
+(state/health, prefill/decode/mixed role, occupancy, queue depth,
+breaker state, routed/requeue/reject/death counts, directory hit
+rate) assembled from the ``replica``-tagged serve events plus the
+router's ``router_route``/``router_hop``/``router_breaker`` and the
+supervisor's ``replica_*`` failure records, with fleet totals (shed
+by class, requeues, pressure, prefix-directory hits/misses/steals,
+KV handoffs) underneath.  The role column reads the ``role`` tag the
+replica's engine stamps on its serve events; the directory columns
+read the ``directory=hit/steal/miss/stale`` verdicts the router
+stamps on its ``router_route`` records (ISSUE 12).
 """
 
 from __future__ import annotations
@@ -159,19 +164,25 @@ def summarize_fleet(events, window=4096):
 
     def row(k):
         return per.setdefault(k, {
-            "replica": k, "state": "up", "health": "ok",
+            "replica": k, "state": "up", "health": "ok", "role": None,
             "live": None, "slots": None, "queue_depth": None,
             "steps": 0, "breaker": "closed", "routed": 0,
             "requeued": 0, "rejects": 0, "deaths": 0, "restarts": 0,
             "finished": 0, "drafted": 0, "accepted": 0,
+            "dir_lookups": 0, "dir_hits": 0,
         })
 
     shed = {"latency": 0, "throughput": 0}
-    hops = 0
+    prefix = {"hits": 0, "misses": 0, "steals": 0, "stale": 0}
+    hops = handoffs = 0
     pressure = None
     for e in events:
         kind = e.get("event")
         rep = e.get("replica")
+        # the engine's metrics tags ride every serve event — a
+        # role-tagged record pins the replica's prefill/decode/mixed kind
+        if rep is not None and e.get("role") is not None:
+            row(rep)["role"] = e.get("role")
         if kind == "serve_step" and rep is not None:
             r = row(rep)
             r["live"] = e.get("live")
@@ -188,7 +199,25 @@ def summarize_fleet(events, window=4096):
         elif kind == "serve_queue_reject" and rep is not None:
             row(rep)["rejects"] += 1
         elif kind == "router_route" and rep is not None:
-            row(rep)["routed"] += 1
+            r = row(rep)
+            r["routed"] += 1
+            # directory verdict stamped on decode-phase placements:
+            # hit/steal routed the request TO this replica's cached span
+            d = e.get("directory")
+            if d is not None:
+                r["dir_lookups"] += 1
+                if d in ("hit", "steal"):
+                    r["dir_hits"] += 1
+                if d == "hit":
+                    prefix["hits"] += 1
+                elif d == "steal":
+                    prefix["steals"] += 1
+                elif d == "stale":
+                    prefix["stale"] += 1
+                elif d == "miss":
+                    prefix["misses"] += 1
+        elif kind == "kv_handoff_in":
+            handoffs += 1
         elif kind == "router_hop":
             hops += 1
             to = e.get("to_replica")
@@ -224,11 +253,15 @@ def summarize_fleet(events, window=4096):
             r["occupancy"] = None
         r["acceptance"] = (round(r["accepted"] / r["drafted"], 4)
                            if r["drafted"] else None)
+        r["dir_hit_rate"] = (round(r["dir_hits"] / r["dir_lookups"], 4)
+                             if r["dir_lookups"] else None)
     return {
         "records": len(events),
         "replicas": [per[k] for k in sorted(per)],
         "shed": shed,
         "requeues": hops,
+        "prefix": prefix,
+        "handoffs": handoffs,
         "pressure": pressure,
     }
 
@@ -240,26 +273,35 @@ def render_fleet(stats, clock=None):
         f"{time.strftime('%H:%M:%S', time.gmtime(clock))} UTC"
         f"  ({stats['records']} records)",
         "-" * 72,
-        f"{'rep':>3} {'state':<7} {'health':<9} {'occ':>5} "
+        f"{'rep':>3} {'state':<7} {'role':<8} {'health':<9} {'occ':>5} "
         f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
         f"{'requeued':>8} {'rejects':>7} {'deaths':>6} "
-        f"{'drafted':>7} {'acc':>5}",
+        f"{'drafted':>7} {'acc':>5} {'dir%':>5}",
     ]
     for r in stats["replicas"]:
         lines.append(
-            f"{r['replica']:>3} {r['state']:<7} {str(r['health']):<9} "
+            f"{r['replica']:>3} {r['state']:<7} "
+            f"{str(r.get('role') or '-'):<8} {str(r['health']):<9} "
             f"{_fmt(r['occupancy'], nd=2):>5} {_fmt(r['live']):>4} "
             f"{_fmt(r['queue_depth']):>5} {r['breaker']:<9} "
             f"{r['routed']:>6} {r['requeued']:>8} {r['rejects']:>7} "
             f"{r['deaths']:>6} {r['drafted']:>7} "
-            f"{_fmt(r['acceptance'], nd=2):>5}")
+            f"{_fmt(r['acceptance'], nd=2):>5} "
+            f"{_fmt(r.get('dir_hit_rate'), nd=2):>5}")
     shed = stats["shed"]
+    pre = stats.get("prefix") or {}
     lines.append("-" * 72)
     lines.append(
         f"fleet     requeues {stats['requeues']}"
         f"  shed latency {shed['latency']}"
         f" / throughput {shed['throughput']}"
         f"  pressure {_fmt(stats['pressure'], nd=2)}")
+    lines.append(
+        f"prefix    hits {pre.get('hits', 0)}"
+        f"  misses {pre.get('misses', 0)}"
+        f"  steals {pre.get('steals', 0)}"
+        f"  stale {pre.get('stale', 0)}"
+        f"  handoffs {stats.get('handoffs', 0)}")
     return "\n".join(lines)
 
 
@@ -330,8 +372,9 @@ def main(argv=None):
                     help="newest N records the frame is computed over")
     ap.add_argument("--fleet", action="store_true",
                     help="per-replica rows for a ServingRouter fleet "
-                         "(state, health, occupancy, queue, breaker, "
-                         "routed/requeue/reject/death counts)")
+                         "(state, health, role, occupancy, queue, "
+                         "breaker, routed/requeue/reject/death counts, "
+                         "directory hit rate + fleet prefix totals)")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()

@@ -51,6 +51,14 @@ can never be compared silently.
 token is the prefill sample, an accepted draft, or a bonus sample.
 Rejected drafts (``spec_proposed - spec_accepted``) are exempt: they
 cost compute, never sequence length.
+
+``--check`` also enforces the KV-handoff pairing rule (ISSUE 12):
+every ``kv_handoff_out`` must pair with a ``kv_handoff_in`` for the
+same request (blocks that left a replica must land on one), and a
+handed-off request must retire exactly once per router admission —
+two ``serve_finish`` records (prefill clone + real request), with
+``router_hop``-carrying requests exempt the same way span-balance
+exempts them.
 """
 
 from __future__ import annotations
@@ -267,6 +275,60 @@ def check_span_balance(events):
     return problems
 
 
+def check_handoff_balance(events):
+    """The KV-handoff pairing rule (ISSUE 12): every ``kv_handoff_out``
+    must pair with a ``kv_handoff_in`` for the same request — blocks
+    that left a replica must land on one — and vice versa (an import
+    with no export is a miswired log); the out/in counts must match
+    (one landing per departure).  A handed-off request must also still
+    retire exactly ONCE per router admission: the prefill clone and the
+    real request each admit+finish on their engines, so its stream
+    carries exactly two ``serve_finish`` records — more means a
+    duplicate retirement leaked through, fewer a lost phase.  Requests
+    with a ``router_hop`` are exempt from the finish count (a requeue
+    legitimately re-runs a phase — the same exemption the per-replica
+    span-balance rule grants), and flight-dump streams are exempt
+    entirely (mid-flight snapshot)."""
+    if any(e.get("event") == "flight_dump" for e in events):
+        return []
+    outs, ins, finishes = {}, {}, {}
+    hopped = set()
+    for e in events:
+        kind = e.get("event")
+        rid = e.get("request")
+        if kind == "kv_handoff_out":
+            outs[rid] = outs.get(rid, 0) + 1
+        elif kind == "kv_handoff_in":
+            ins[rid] = ins.get(rid, 0) + 1
+        elif kind == "serve_finish":
+            finishes[rid] = finishes.get(rid, 0) + 1
+        elif kind == "router_hop":
+            hopped.add(rid)
+    problems = []
+    for rid in sorted(str(r) for r in set(outs) - set(ins)):
+        problems.append(f"handoff: request {rid!r} exported KV "
+                        f"(kv_handoff_out) that never landed "
+                        f"(no kv_handoff_in)")
+    for rid in sorted(str(r) for r in set(ins) - set(outs)):
+        problems.append(f"handoff: request {rid!r} imported KV "
+                        f"(kv_handoff_in) that was never exported")
+    for rid in sorted(set(outs) & set(ins), key=str):
+        if outs[rid] != ins[rid]:
+            problems.append(
+                f"handoff: request {rid!r} has {outs[rid]} exports "
+                f"but {ins[rid]} imports")
+    for rid in sorted(set(outs) & set(ins), key=str):
+        n = finishes.get(rid, 0)
+        if rid in hopped or n == 0:
+            continue    # requeue re-runs a phase / engine log absent
+        if n != 2:
+            problems.append(
+                f"handoff: request {rid!r} was handed off but "
+                f"retired {n} time(s) — expected exactly 2 "
+                f"(prefill clone + real request)")
+    return problems
+
+
 def check_quant_consistency(events):
     """The mixed-quantization rule: every ``bench_row`` record in one
     stream must carry the SAME ``quant`` stamp (rows predating the
@@ -341,10 +403,12 @@ def main(argv=None):
                     help="validate every record against the event "
                          "contract AND the request span-balance rule "
                          "(every serve_admit has a serve_finish), the "
-                         "quant-mix rule, and the speculative-"
-                         "attribution rule (accepted + bonus + 1 == "
-                         "n_generated per retired request); exit 1 on "
-                         "violations")
+                         "quant-mix rule, the speculative-attribution "
+                         "rule (accepted + bonus + 1 == n_generated "
+                         "per retired request), and the KV-handoff "
+                         "pairing rule (every kv_handoff_out has a "
+                         "kv_handoff_in, one retirement per "
+                         "admission); exit 1 on violations")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()
@@ -369,13 +433,16 @@ def main(argv=None):
         problems.extend(qmix)
         spec = check_spec_attribution(events)
         problems.extend(spec)
+        handoff = check_handoff_balance(events)
+        problems.extend(handoff)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
                           "contract_violations": len(problems),
                           "span_balance_violations": len(balance),
                           "quant_mix_violations": len(qmix),
-                          "spec_attribution_violations": len(spec)}))
+                          "spec_attribution_violations": len(spec),
+                          "handoff_violations": len(handoff)}))
         return 1 if problems or bad else 0
 
     if args.export:
