@@ -291,6 +291,86 @@ python bin/hetu_trace.py "$LOG/fleet_kv_flight.jsonl" --check \
   exit 1
 }
 
+# 00f. embedding-serving gate (ISSUE 14): a zipf(1.05) CTR trace
+#      replayed through the cache-fronted EmbedServingEngine on CPU,
+#      with the PS killed for the middle third of the trace — every
+#      request must still score (stale hits + zero-vector misses,
+#      ZERO loss), the cache counters must show the outage engaged,
+#      and the merged serve stream must pass hetu_trace --check
+#      including the gather span-balance rule — the second workload's
+#      contract proven before any chip time.
+run embed_serve_gate 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/embed_trace.jsonl" \
+    JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.cache.cstable import CacheSparseTable
+from hetu_tpu.ps.client import PSConnectionError
+from hetu_tpu.ps.server import PSServer
+from hetu_tpu.serving import EmbedRequest, EmbedServingEngine
+
+
+class KillablePS:
+    def __init__(self, server):
+        self._server, self.down = server, False
+
+    def __getattr__(self, name):
+        fn = getattr(self._server, name)
+
+        def w(*a, **kw):
+            if self.down:
+                raise PSConnectionError("PS down (chaos)")
+            return fn(*a, **kw)
+        return w
+
+
+server = PSServer()
+server.param_init("snd_order_embedding", (512, 8), "normal", 0.0, 1.0,
+                  seed=3)
+comm = KillablePS(server)
+table = CacheSparseTable(limit=128, vocab_size=512, width=8,
+                         key="snd_order_embedding", comm=comm,
+                         policy="LRU")
+rng = np.random.RandomState(0)
+params = {"W1": rng.randn(13, 16) * .3, "W2": rng.randn(16, 16) * .3,
+          "W3": rng.randn(16, 16) * .3,
+          "W4": rng.randn(26 * 8 + 16, 1) * .3}
+eng = EmbedServingEngine(params, {"snd_order_embedding": table},
+                         model="wdl", wave=4, queue_limit=64)
+treq = np.random.RandomState(42)
+reqs = [EmbedRequest(item_ids=(treq.zipf(1.05, (2, 26)) - 1) % 512,
+                     dense_features=treq.randn(2, 13).astype(np.float32))
+        for _ in range(30)]
+res = {}
+res.update(eng.run(reqs[:10]))        # warm
+comm.down = True                      # mid-trace PS kill
+res.update(eng.run(reqs[10:20]))      # dark: stale/zero, zero loss
+comm.down = False                     # recovery
+res.update(eng.run(reqs[20:]))
+s = table.perf_summary()
+assert len(res) == 30, f"retired {len(res)}/30"
+assert all(r.finish_reason == "scored" for r in res.values())
+assert s["ps_failures"] > 0, "the kill never fired"
+assert s["stale_served_rows"] + s["zero_served_rows"] > 0, s
+assert s["hit_rate"] > 0.2, s
+snap = eng.metrics.snapshot()
+assert snap["requests_finished"] == 30, snap
+print("embed serve gate OK: scored", snap["requests_finished"],
+      "hit_rate", round(s["hit_rate"], 3),
+      "ps_failures", s["ps_failures"])
+PYEOF
+if ! grep -q 'embed serve gate OK' "$LOG/embed_serve_gate.log"; then
+  echo "embed serving gate FAILED — see $LOG/embed_serve_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/embed_trace.jsonl" --check \
+    > "$LOG/embed_serve_contract.log" || {
+  echo "embed serve span/gather contract check FAILED — see" \
+       "$LOG/embed_serve_contract.log" >&2
+  exit 1
+}
+
 # 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
 #     must pass BEFORE any chip time is spent).  Speculative-decoding
 #     trace-replay gate: the draft-propose / batched-verify path must

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import envvars, telemetry
@@ -60,12 +61,21 @@ class CacheSparseTable:
                                     prefer_native=prefer_native)
         self._pool = ThreadPoolExecutor(max_workers=1)
         # cache state is not thread-safe; one lock serializes the sync
-        # methods against pool-submitted async calls
+        # methods against pool-submitted async calls.  LOCKING CONTRACT
+        # (audited for concurrent serving waves): every public entry
+        # point — embedding_lookup/update/push_pull/flush/perf_summary
+        # and the async variants (which run the sync methods on the
+        # single pool thread) — takes self._lock; _replay and
+        # _push_or_buffer mutate the outage backlog and MUST only be
+        # called with the lock held (they are internal to the locked
+        # region, never a public surface).  RLock, not Lock: the fused
+        # push_pull holds it across _update + _lookup.
         self._lock = threading.RLock()
         # perf counters (reference cstable.py:126-187)
         self.num_lookups = 0
         self.num_rows_looked = 0
         self.num_pulled_rows = 0
+        self.num_pulled_bytes = 0
         self.num_pushed_rows = 0
         self.num_synced_rows = 0
         # outage degradation state (module docstring)
@@ -75,6 +85,8 @@ class CacheSparseTable:
         self._outage = 0            # consecutive failed PS RPCs
         self._backlog = (np.zeros(0, np.int64),
                          np.zeros((0, self.width), np.float32))
+        self._backlog_t0 = None     # when the oldest buffered push
+        # landed (drives the cache.staleness_s gauge)
         self.num_ps_failures = 0
         self.num_stale_served = 0
         self.num_zero_served = 0
@@ -98,7 +110,9 @@ class CacheSparseTable:
                 f"{err}") from err
 
     def _replay(self):
-        """Drain the push backlog on (re-)contact; no-op while empty."""
+        """Drain the push backlog on (re-)contact; no-op while empty.
+        Caller MUST hold self._lock (see the locking contract in
+        __init__)."""
         bids, bgrads = self._backlog
         if bids.size == 0 or self.comm is None:
             return
@@ -109,6 +123,8 @@ class CacheSparseTable:
             return
         self._backlog = (np.zeros(0, np.int64),
                          np.zeros((0, self.width), np.float32))
+        self._backlog_t0 = None
+        telemetry.set_gauge("cache.staleness_s", 0.0)
         self.num_replayed_rows += len(bids)
         self.num_pushed_rows += len(bids)
         telemetry.inc("cache.writeback_rows", len(bids))
@@ -116,7 +132,9 @@ class CacheSparseTable:
 
     def _push_or_buffer(self, ids, grads):
         """push_embedding with outage buffering: deltas that cannot
-        reach the PS merge into the bounded backlog for replay."""
+        reach the PS merge into the bounded backlog for replay.
+        Caller MUST hold self._lock (see the locking contract in
+        __init__)."""
         if len(ids) == 0:
             return
         self._replay()
@@ -135,7 +153,10 @@ class CacheSparseTable:
                 f"PS outage push backlog for table {self.key!r} "
                 f"exceeded HETU_CACHE_BACKLOG_ROWS="
                 f"{self.max_backlog_rows} ({len(bids)} rows)")
+        if self._backlog_t0 is None:
+            self._backlog_t0 = time.monotonic()
         self._backlog = (bids, bgrads)
+        telemetry.set_gauge("cache.staleness_s", self.staleness_s())
 
     # ------------------------------------------------------------------ #
 
@@ -208,6 +229,8 @@ class CacheSparseTable:
                 self._evictions_seen = ev_total
                 self._push_or_buffer(ev_ids, ev_grads)
                 self.num_pulled_rows += len(miss_ids)
+                self.num_pulled_bytes += int(pulled.nbytes)
+                telemetry.inc("cache.pull_bytes", int(pulled.nbytes))
                 rows[~hit] = pulled
 
         return rows[inv].reshape(*shape, self.width)
@@ -290,22 +313,37 @@ class CacheSparseTable:
         return (np.asarray(self.comm.sparse_pull(self.key, ids),
                            np.float32), None)
 
+    def staleness_s(self):
+        """Age of the OLDEST buffered push (seconds): 0 with an empty
+        backlog — the observable behind the cache.staleness_s gauge."""
+        t0 = self._backlog_t0
+        return 0.0 if t0 is None else max(time.monotonic() - t0, 0.0)
+
     def perf_summary(self):
-        c = self.cache.counters()
-        total = c["hits"] + c["misses"]
-        return {
-            "lookups": self.num_lookups,
-            "rows_looked": self.num_rows_looked,
-            "hit_rate": c["hits"] / total if total else 0.0,
-            "pulled_rows": self.num_pulled_rows,
-            "pushed_rows": self.num_pushed_rows,
-            "synced_rows": self.num_synced_rows,
-            "evictions": c["evictions"],
-            "cache_size": self.cache.size(),
-            # outage degradation counters
-            "ps_failures": self.num_ps_failures,
-            "stale_served_rows": self.num_stale_served,
-            "zero_served_rows": self.num_zero_served,
-            "replayed_rows": self.num_replayed_rows,
-            "backlog_rows": len(self._backlog[0]),
-        }
+        """Counter snapshot; locked — serving waves read it from other
+        threads while lookups mutate the counters.  Also refreshes the
+        cache.staleness_s gauge so dashboards see backlog age advance
+        between pushes."""
+        with self._lock:
+            c = self.cache.counters()
+            total = c["hits"] + c["misses"]
+            staleness = self.staleness_s()
+            telemetry.set_gauge("cache.staleness_s", staleness)
+            return {
+                "lookups": self.num_lookups,
+                "rows_looked": self.num_rows_looked,
+                "hit_rate": c["hits"] / total if total else 0.0,
+                "pulled_rows": self.num_pulled_rows,
+                "pull_bytes": self.num_pulled_bytes,
+                "pushed_rows": self.num_pushed_rows,
+                "synced_rows": self.num_synced_rows,
+                "evictions": c["evictions"],
+                "cache_size": self.cache.size(),
+                # outage degradation counters
+                "ps_failures": self.num_ps_failures,
+                "stale_served_rows": self.num_stale_served,
+                "zero_served_rows": self.num_zero_served,
+                "replayed_rows": self.num_replayed_rows,
+                "backlog_rows": len(self._backlog[0]),
+                "staleness_s": round(staleness, 6),
+            }

@@ -257,6 +257,14 @@ _reg("HETU_KV_CHUNK", "int", 0,
      "this many tokens interleaved with decode waves, so a long prompt "
      "does not stall running generations (0 = whole prompt in one "
      "pass).", "serving")
+_reg("HETU_EMBED_WAVE", "int", 8,
+     "Embedding serving: max requests the engine claims per scoring "
+     "wave (one embedding gather + one jitted tower forward per wave; "
+     "EmbedServingEngine(wave=) overrides).", "serving")
+_reg("HETU_EMBED_QUEUE", "int", 64,
+     "Embedding serving: bounded admission-queue depth — submit "
+     "raises QueueFull past it (EmbedServingEngine(queue_limit=) "
+     "overrides).", "serving")
 
 # --------------------------------------------------------------------- #
 # serving fleet router (serving/router.py)
@@ -380,6 +388,10 @@ _reg("HETU_BENCH_DECODE", "bool", False,
      "Run the KV-cached decode benchmark.", "bench")
 _reg("HETU_BENCH_SERVE", "bool", False,
      "Run the continuous-batching serving benchmark.", "bench")
+_reg("HETU_BENCH_EMBED_SERVE", "bool", False,
+     "Run the embedding-cache recommendation-serving benchmark "
+     "(zipf cache-limit ladder, int8-pull A/B, PS-kill chaos).",
+     "bench")
 _reg("HETU_BENCH_CTR_ROWS", "bool", False,
      "Run the max-embedding-rows-per-chip ladder.", "bench")
 _reg("HETU_BENCH_CTR_FP32", "bool", False,

@@ -644,6 +644,16 @@ class PSClient:
                 self._van_drop()    # pure read: safe fallback
             except RuntimeError:
                 pass                # rejected (e.g. no versions)
+        q = _q_mode()
+        if q:
+            # int8 pull pair on the HET sync verb: the serving cache's
+            # miss path pulls through here, so HETU_PS_QUANT shrinks
+            # cold-start / post-outage refill bytes the same ~3.7x the
+            # dense pulls get
+            s_ids, s_rows, s_vers = self.t.call(
+                "sync_embedding", key, ids, stored_versions, bound,
+                quant=q)
+            return s_ids, _q_decode(s_rows), s_vers
         return self.t.call("sync_embedding", key, ids, stored_versions,
                            bound)
 

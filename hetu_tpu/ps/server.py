@@ -688,16 +688,20 @@ class PSServer:
 
     # ---------------- cache sync (HET protocol) ---------------- #
 
-    def sync_embedding(self, key, ids, stored_versions, bound):
+    def sync_embedding(self, key, ids, stored_versions, bound,
+                       quant=None):
         """kSyncEmbedding (hetu_client.cc): return rows whose server version
-        exceeds the client's stored version by more than ``bound``."""
+        exceeds the client's stored version by more than ``bound``.
+        ``quant="int8"`` ships the row payload as a QuantArray (the
+        HETU_PS_QUANT pull pair — serving cache misses ride this)."""
         p = self.params[key]
         ids = np.asarray(ids, np.int64).reshape(-1)
         stored_versions = np.asarray(stored_versions, np.int64).reshape(-1)
         with p.lock:
             server_v = p.versions[ids]
             stale = (server_v - stored_versions) > bound
-            return ids[stale], p.value[ids[stale]], server_v[stale]
+            return (ids[stale], self._q_out(p.value[ids[stale]], quant),
+                    server_v[stale])
 
     def push_embedding(self, key, ids, rows, versions=None):
         """kPushEmbedding: apply client-accumulated embedding grads."""

@@ -11,6 +11,14 @@ compiled arithmetic — with the offline path.
 
     engine.py     ServingEngine: admission queue with backpressure, the
                   per-step admit -> prefill -> fused-decode -> retire loop
+    embed_engine.py
+                  EmbedServingEngine: the recommendation workload —
+                  waves of (user_ids, item_ids, dense_features)
+                  requests gather embeddings through CacheSparseTable
+                  (int8 PS pull on miss under HETU_PS_QUANT) and score
+                  in one jitted WDL/DCN/NCF tower forward; degrades
+                  through a PS outage exactly like training
+                  (stale-serving + replay), zero request loss
     router.py     ServingRouter: the FLEET tier — health-aware weighted
                   routing over N supervised replicas, session affinity
                   (session_id -> home replica, warm prefix blocks),
@@ -79,22 +87,27 @@ Quickstart (greedy results are token-identical to ``generate_fast``):
 """
 
 from ..telemetry.slo import SLO, SLOMonitor
-from .request import Request, Result
+from .request import EmbedRequest, EmbedResult, Request, RequestCore, Result
 from .kv_manager import (
     KVCacheManager, PagedKVManager, resolve_handoff_quant,
     resolve_kv_block, resolve_kv_quant, round_up_pow2,
 )
-from .metrics import COMPONENTS, ServingMetrics
+from .metrics import (
+    COMPONENTS, EMBED_COMPONENTS, EmbedServingMetrics, ServingMetrics,
+)
 from .engine import ServingEngine, QueueFull
+from .embed_engine import EmbedServingEngine
 from .prefix_directory import PrefixDirectory, prefix_hash
 from .replica import Replica
 from .router import RouterShed, ServingRouter
 
 __all__ = [
-    "ServingEngine", "ServingRouter", "Replica", "QueueFull",
-    "RouterShed", "Request", "Result",
+    "ServingEngine", "EmbedServingEngine", "ServingRouter", "Replica",
+    "QueueFull", "RouterShed", "Request", "RequestCore", "Result",
+    "EmbedRequest", "EmbedResult",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",
-    "COMPONENTS", "SLO", "SLOMonitor", "PrefixDirectory",
+    "EmbedServingMetrics", "COMPONENTS", "EMBED_COMPONENTS",
+    "SLO", "SLOMonitor", "PrefixDirectory",
     "prefix_hash", "resolve_handoff_quant",
     "resolve_kv_block", "resolve_kv_quant", "round_up_pow2",
 ]

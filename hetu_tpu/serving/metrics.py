@@ -84,7 +84,14 @@ class _Lifecycle:
         self.handoff_ms = 0.0     # prefill->decode handoff detour
 
 
-class ServingMetrics:
+class MetricsCore:
+    """Model-agnostic serving-telemetry base: the event pipeline,
+    clock plumbing, and the submit/reject lifecycle every engine kind
+    shares.  :class:`ServingMetrics` (GPT decode) and
+    :class:`EmbedServingMetrics` (recommendation scoring) both build
+    on this, so the fleet router / hetu_top / span-balance tooling
+    read one event vocabulary regardless of workload."""
+
     def __init__(self, log_path=None, tags=None):
         self.log_path = (log_path if log_path is not None
                          else envvars.get_path("HETU_SERVE_LOG"))
@@ -102,26 +109,7 @@ class ServingMetrics:
         self.submitted = 0
         self.rejected = 0
         self.finished = 0
-        self.tokens_generated = 0
-        self.ttfts = []            # seconds, submit -> first token
-        self.latencies = []        # seconds, submit -> finish
-        self.tpots = []            # per-request decode s/token means
-        self.step_live = []        # live slots per fused step
-        self.step_queue = []       # queue depth per fused step
-        self.step_dt = []          # seconds per fused decode step
-        self.step_tokens = []      # tokens EMITTED per fused step (==
-        # live without speculation; 1..(k+1)*live with it — the TPOT
-        # percentiles are computed from these real per-step counts)
-        self.step_prefill = []     # prefill seconds folded into a step
-        self.prefill_dt = []       # seconds per prefill dispatch
-        self.prefill_reqs = 0      # requests prefilled
-        self.prefill_batched = 0   # batched (fast-path) dispatches
-        self.components = {c: [] for c in COMPONENTS}
-        # per-request breakdowns explain_tail() slices (ring: the tail
-        # report is about RECENT behavior, same cap as the event ring)
-        self.breakdowns = collections.deque(maxlen=cap)
-        self._lc = {}              # request_id -> _Lifecycle
-        self._slots = None
+        self._lc = {}              # request_id -> lifecycle record
         self._t0 = None
         self._t_last = None
 
@@ -158,6 +146,58 @@ class ServingMetrics:
         stream uses (so req_span tracks align with span tracks)."""
         return cls._PERF_TO_EPOCH + perf_t
 
+    def _make_lc(self, t_submit):
+        """Workload-specific lifecycle record for one request."""
+        raise NotImplementedError
+
+    def record_submit(self, request_id, queue_depth):
+        self.submitted += 1
+        self._lc[request_id] = self._make_lc(time.perf_counter())
+        self.event("serve_submit", request=request_id,
+                   queue_depth=queue_depth)
+
+    def record_reject(self, request_id, queue_depth):
+        self.rejected += 1
+        self.event("serve_queue_reject", request=request_id,
+                   queue_depth=queue_depth)
+
+    def lc_hop(self, request_id, hop_ms):
+        """Credit wall time the fleet router lost placing this request
+        on a replica that died/wedged before it could retire (called by
+        the router right after the re-submission; accumulates across
+        hops)."""
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.hop_ms += float(hop_ms)
+
+
+class ServingMetrics(MetricsCore):
+    def __init__(self, log_path=None, tags=None):
+        super().__init__(log_path=log_path, tags=tags)
+        self.tokens_generated = 0
+        self.ttfts = []            # seconds, submit -> first token
+        self.latencies = []        # seconds, submit -> finish
+        self.tpots = []            # per-request decode s/token means
+        self.step_live = []        # live slots per fused step
+        self.step_queue = []       # queue depth per fused step
+        self.step_dt = []          # seconds per fused decode step
+        self.step_tokens = []      # tokens EMITTED per fused step (==
+        # live without speculation; 1..(k+1)*live with it — the TPOT
+        # percentiles are computed from these real per-step counts)
+        self.step_prefill = []     # prefill seconds folded into a step
+        self.prefill_dt = []       # seconds per prefill dispatch
+        self.prefill_reqs = 0      # requests prefilled
+        self.prefill_batched = 0   # batched (fast-path) dispatches
+        self.components = {c: [] for c in COMPONENTS}
+        # per-request breakdowns explain_tail() slices (ring: the tail
+        # report is about RECENT behavior, same cap as the event ring)
+        cap = max(1, envvars.get_int("HETU_TELEMETRY_BUFFER"))
+        self.breakdowns = collections.deque(maxlen=cap)
+        self._slots = None
+
+    def _make_lc(self, t_submit):
+        return _Lifecycle(t_submit)
+
     # ------------------------------------------------------------- #
     # lifecycle marks (the engine calls these at phase boundaries)
     # ------------------------------------------------------------- #
@@ -185,15 +225,6 @@ class ServingMetrics:
             lc.prefill_ms += dt_s * 1e3
             lc.n_prefills += 1
 
-    def lc_hop(self, request_id, hop_ms):
-        """Credit wall time the fleet router lost placing this request
-        on a replica that died/wedged before it could retire (called by
-        the router right after the re-submission; accumulates across
-        hops)."""
-        lc = self._lc.get(request_id)
-        if lc is not None:
-            lc.hop_ms += float(hop_ms)
-
     def lc_handoff(self, request_id, handoff_ms):
         """Credit the prefill->decode disaggregation detour: wall time
         between the router flipping this request into its prefill
@@ -204,17 +235,6 @@ class ServingMetrics:
             lc.handoff_ms += float(handoff_ms)
 
     # ------------------------------------------------------------- #
-
-    def record_submit(self, request_id, queue_depth):
-        self.submitted += 1
-        self._lc[request_id] = _Lifecycle(time.perf_counter())
-        self.event("serve_submit", request=request_id,
-                   queue_depth=queue_depth)
-
-    def record_reject(self, request_id, queue_depth):
-        self.rejected += 1
-        self.event("serve_queue_reject", request=request_id,
-                   queue_depth=queue_depth)
 
     def record_admit(self, request_id, slot, queue_wait_s, ttft_s):
         self._mark()
@@ -478,6 +498,239 @@ class ServingMetrics:
             f"({ttft_parts[dominant]:.1f}ms, {share:.0%} of the "
             f"pre-token wall)")
         return report
+
+
+EMBED_COMPONENTS = ("queue_ms", "router_hop_ms", "gather_ms",
+                    "forward_ms")
+
+
+class _EmbedLifecycle:
+    """Perf-counter timeline of one scoring request: submit -> wave
+    claim -> gather (embedding fetch) -> forward (tower) -> retire."""
+
+    __slots__ = ("t_submit", "t_claim", "gather_ms", "t_first",
+                 "hop_ms")
+
+    def __init__(self, t_submit):
+        self.t_submit = t_submit
+        self.t_claim = None       # wave claimed the request
+        self.gather_ms = 0.0      # embedding gather attributed to it
+        self.t_first = None       # scores landed
+        self.hop_ms = 0.0         # router requeue hops before us
+
+
+class EmbedServingMetrics(MetricsCore):
+    """Embedding-engine telemetry: the GPT lifecycle with the KV
+    phases replaced by ``gather_ms`` (CacheSparseTable fetch) and
+    ``forward_ms`` (the jitted tower).  Emits the SAME event kinds the
+    GPT engine does — serve_submit/serve_admit/serve_step/serve_finish
+    plus per-phase req_span and req_retire — so hetu_trace --check's
+    span-balance rule, hetu_top, and the SLO monitor work unmodified;
+    the one new kind is the per-wave ``serve_gather`` record.  Every
+    event carries ``workload="embed"`` (hetu_top's workload column)."""
+
+    def __init__(self, log_path=None, tags=None):
+        super().__init__(log_path=log_path, tags=tags)
+        self.tags.setdefault("workload", "embed")
+        self.pairs_scored = 0
+        self.ttfts = []            # seconds, submit -> scores landed
+        self.latencies = []        # == ttfts shape-wise; kept separate
+        # so snapshot() reads like the GPT one
+        self.step_live = []        # requests per wave
+        self.step_queue = []       # queue depth per wave
+        self.step_dt = []          # seconds per wave (gather+forward)
+        self.step_rows = []        # pairs scored per wave
+        self.gather_dt = []        # seconds per wave gather
+        self.hit_rates = []        # cache hit-rate per wave gather
+        self.components = {c: [] for c in EMBED_COMPONENTS}
+        cap = max(1, envvars.get_int("HETU_TELEMETRY_BUFFER"))
+        self.breakdowns = collections.deque(maxlen=cap)
+        self._slots = None
+
+    def _make_lc(self, t_submit):
+        return _EmbedLifecycle(t_submit)
+
+    # ------------------------------------------------------------- #
+
+    def lc_claimed(self, request_id):
+        """The wave claimed this request off the queue (queue phase
+        ends here; gather starts)."""
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.t_claim = time.perf_counter()
+
+    def record_gather(self, n, rows, gather_s, hit_rate, requests=()):
+        """One wave's embedding gather: ``n`` requests, ``rows`` total
+        pairs fetched through the cache in ``gather_s`` seconds at
+        ``hit_rate``.  Attributes the wall to every participant."""
+        self._mark()
+        self.gather_dt.append(gather_s)
+        self.hit_rates.append(float(hit_rate))
+        for rid in requests:
+            lc = self._lc.get(rid)
+            if lc is not None:
+                lc.gather_ms += gather_s * 1e3
+        self.event("serve_gather", n=n, rows=rows,
+                   gather_ms=round(gather_s * 1e3, 3),
+                   hit_rate=round(float(hit_rate), 4))
+
+    def record_admit(self, request_id, slot, queue_wait_s, ttft_s):
+        """Scores landed for this request (embed waves emit the whole
+        result at once, so admit == first-result)."""
+        self._mark()
+        self.ttfts.append(ttft_s)
+        lc = self._lc.get(request_id)
+        if lc is not None:
+            lc.t_first = time.perf_counter()
+        self.event("serve_admit", request=request_id, slot=slot,
+                   queue_wait_s=round(queue_wait_s, 6),
+                   ttft_s=round(ttft_s, 6))
+
+    def record_step(self, live, slots, queue_depth, dt_s, rows,
+                    gather_s=0.0, step=None, requests=None):
+        """One scoring wave: ``dt_s`` is the wave wall (gather +
+        forward), ``rows`` the pairs it scored.  Shapes the serve_step
+        event like a GPT decode wave (decode_ms = the forward wall) so
+        hetu_top and the trace exporter render waves unmodified."""
+        self._mark()
+        self._slots = slots
+        self.step_live.append(live)
+        self.step_queue.append(queue_depth)
+        self.step_dt.append(dt_s)
+        self.step_rows.append(int(rows))
+        self.pairs_scored += int(rows)
+        telemetry.observe("serve.pairs_per_wave", int(rows))
+        fields = {}
+        if step is not None:
+            fields["step"] = step
+        if requests is not None:
+            fields["requests"] = list(requests)
+        self.event("serve_step", live=live, queue_depth=queue_depth,
+                   slots=slots, rows=int(rows),
+                   gather_ms=round(gather_s * 1e3, 3),
+                   decode_ms=round(max(dt_s - gather_s, 0.0) * 1e3, 3),
+                   **fields)
+
+    def record_finish(self, request_id, reason, n_pairs, latency_s):
+        self._mark()
+        self.finished += 1
+        self.latencies.append(latency_s)
+        self.event("serve_finish", request=request_id, reason=reason,
+                   n_generated=n_pairs, latency_s=round(latency_s, 6))
+        return self._retire(request_id)
+
+    def _retire(self, request_id):
+        lc = self._lc.pop(request_id, None)
+        if lc is None or lc.t_claim is None or lc.t_first is None:
+            return None
+        queue_ms = max(lc.t_claim - lc.t_submit, 0.0) * 1e3
+        wave_wall_ms = max(lc.t_first - lc.t_claim, 0.0) * 1e3
+        gather_ms = min(lc.gather_ms, wave_wall_ms)
+        forward_ms = max(wave_wall_ms - gather_ms, 0.0)
+        ttft_ms = max(lc.t_first - lc.t_submit, 0.0) * 1e3
+        comp = {"queue_ms": queue_ms, "router_hop_ms": lc.hop_ms,
+                "gather_ms": gather_ms, "forward_ms": forward_ms}
+        for k, v in comp.items():
+            self.components[k].append(v)
+        breakdown = {"request": request_id, "ttft_ms": ttft_ms,
+                     **{k: round(v, 3) for k, v in comp.items()}}
+        self.breakdowns.append(breakdown)
+        phases = [("queue", lc.t_submit, queue_ms, {}),
+                  ("gather", lc.t_claim, gather_ms, {}),
+                  ("forward", lc.t_claim + gather_ms / 1e3,
+                   forward_ms, {})]
+        if lc.hop_ms > 0:
+            # the hop happened BEFORE this engine's submit: backdate
+            # its span so the request's track reads hop -> queue -> ...
+            phases.insert(0, ("router_hop",
+                              lc.t_submit - lc.hop_ms / 1e3,
+                              lc.hop_ms, {}))
+        for phase, t_start, ms, extra in phases:
+            self.event("req_span", request=request_id, phase=phase,
+                       ms=round(ms, 3), t=self._epoch(t_start), **extra)
+        self.event("req_retire", request=request_id,
+                   ttft_ms=round(ttft_ms, 3),
+                   **breakdown_fields(comp))
+        return breakdown
+
+    # ------------------------------------------------------------- #
+
+    def snapshot(self):
+        wall = ((self._t_last - self._t0)
+                if self._t0 is not None and self._t_last > self._t0
+                else None)
+        occ = ([l / self._slots for l in self.step_live]
+               if self._slots else [])
+        comps = {}
+        for name, xs in self.components.items():
+            if xs:
+                comps[name] = {
+                    "p50_ms": round(_pct(xs, 50), 3),
+                    "p95_ms": round(_pct(xs, 95), 3),
+                    "p99_ms": round(_pct(xs, 99), 3),
+                    "mean_ms": round(float(np.mean(xs)), 3),
+                }
+        return {
+            "requests_submitted": self.submitted,
+            "requests_rejected": self.rejected,
+            "requests_finished": self.finished,
+            "pairs_scored": self.pairs_scored,
+            "wall_s": round(wall, 6) if wall else None,
+            "qps": (round(self.finished / wall, 2) if wall else None),
+            "pairs_per_sec": (round(self.pairs_scored / wall, 2)
+                              if wall else None),
+            "latency_p50_s": _pct(self.latencies, 50),
+            "latency_p95_s": _pct(self.latencies, 95),
+            "latency_p99_s": _pct(self.latencies, 99),
+            "latency_mean_s": (float(np.mean(self.latencies))
+                               if self.latencies else None),
+            "gather_ms_p50": (round(_pct(self.gather_dt, 50) * 1e3, 3)
+                              if self.gather_dt else None),
+            "wave_ms_p50": (round(_pct(self.step_dt, 50) * 1e3, 3)
+                            if self.step_dt else None),
+            "cache_hit_rate_mean": (float(np.mean(self.hit_rates))
+                                    if self.hit_rates else None),
+            "steps": len(self.step_live),
+            "rows_per_wave_mean": (float(np.mean(self.step_rows))
+                                   if self.step_rows else None),
+            "mean_batch_occupancy": (float(np.mean(occ)) if occ else None),
+            "mean_queue_depth": (float(np.mean(self.step_queue))
+                                 if self.step_queue else None),
+            "components": comps,
+        }
+
+    def explain_tail(self, q=99):
+        """Name the component dominating the latency tail (the embed
+        twin of ServingMetrics.explain_tail — same report shape, over
+        queue/hop/gather/forward instead of the KV phases)."""
+        rows = [b for b in self.breakdowns if b.get("ttft_ms") is not None]
+        if not rows:
+            return None
+        ttfts = [b["ttft_ms"] for b in rows]
+        cut = _pct(ttfts, q)
+        tail = [b for b in rows if b["ttft_ms"] >= cut]
+        means = {c: float(np.mean([b[c] for b in tail]))
+                 for c in EMBED_COMPONENTS}
+        dominant = max(means, key=means.get)
+        total = sum(means.values()) or 1.0
+        share = means[dominant] / total
+        return {
+            "q": q,
+            "ttft_p_ms": round(cut, 3),
+            "ttft_p50_ms": round(_pct(ttfts, 50), 3),
+            "n_requests": len(rows),
+            "n_tail": len(tail),
+            "dominant_component": dominant,
+            "dominant_ms": round(means[dominant], 3),
+            "dominant_share": round(share, 4),
+            "components_mean_ms": {c: round(v, 3)
+                                   for c, v in means.items()},
+            "tail_requests": [b["request"] for b in tail[:8]],
+            "summary": (
+                f"p{q} latency {cut:.1f}ms ({len(tail)}/{len(rows)} "
+                f"requests): dominated by {dominant.replace('_ms', '')} "
+                f"({means[dominant]:.1f}ms, {share:.0%} of the wall)"),
+        }
 
 
 def breakdown_fields(comp):

@@ -332,7 +332,10 @@ class ServingRouter:
         """One routing consult; returns (hint, outcome) — see
         ``PrefixDirectory.lookup``.  The chaos seam lives here: a drawn
         kill (role "directory") drops the directory mid-lookup."""
-        if self.directory is None:
+        if self.directory is None or \
+                getattr(req, "prompt", None) is None:
+            # payloads without a token prompt (embedding requests)
+            # have no prefix to look up
             return None, None
         plan = faults.plan_from_env()
         if plan is not None:
@@ -349,7 +352,8 @@ class ServingRouter:
         prefix-sharing layout, and the prompt spans at least one full
         block (``match_prefix`` caps sharing below the last prompt
         position, so a sub-block prompt hands off nothing)."""
-        if not self._roles_active:
+        if not self._roles_active or \
+                getattr(req, "prompt", None) is None:
             return False
         for r in self.replicas:
             if r.engine is not None:
@@ -671,8 +675,13 @@ class ServingRouter:
         (backpressure propagated up), ValueError when it can never fit,
         RuntimeError when the whole fleet is terminally dead."""
         req = request
-        total = len(req.prompt) + req.max_new_tokens
-        if total > self.s_max:
+        # capacity pre-check through the model-agnostic hook: GPT
+        # requests bound prompt+budget against the fleet's S_max;
+        # workloads with no sequence bound (embedding waves) return
+        # None on either side and skip it
+        total = req.capacity_tokens()
+        if total is not None and self.s_max is not None \
+                and total > self.s_max:
             raise ValueError(
                 f"prompt + max_new_tokens = {total} exceeds the "
                 f"fleet's S_max {self.s_max}")

@@ -75,6 +75,8 @@ REQUIRED_FIELDS = {
     "serve_prefill": ("n", "bucket", "prefill_ms"),
     "serve_step": ("live", "queue_depth", "decode_ms"),
     "serve_finish": ("request", "reason", "n_generated"),
+    # embedding serving engine (serve stream; per-wave cache gather)
+    "serve_gather": ("n", "rows", "gather_ms"),
     # static checks (validate stream)
     "graph_verified": ("subgraph", "phase"),
     "graph_verify_error": ("kind", "error"),

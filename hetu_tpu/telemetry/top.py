@@ -63,8 +63,14 @@ def summarize(events, window=512):
     steps = []
     slo = {"state": None, "burn_rate": None, "violations": 0}
     flight_dumps = 0
+    workload = None
     for e in events:
         kind = e.get("event")
+        # the workload tag embed engines stamp on every serve event;
+        # untagged streams (GPT engines predate the tag) default "gpt"
+        if kind and kind.startswith("serve_") and \
+                e.get("workload") is not None:
+            workload = e.get("workload")
         if kind == "gauge":
             gauges[e.get("name")] = e.get("value")
         elif kind == "serve_step":
@@ -133,6 +139,7 @@ def summarize(events, window=512):
             gauges.get("serve.health"), "ok")
     return {
         "records": len(events),
+        "workload": workload or "gpt",
         "occupancy": occupancy,
         "live": last.get("live"),
         "slots": last.get("slots"),
@@ -165,6 +172,7 @@ def summarize_fleet(events, window=4096):
     def row(k):
         return per.setdefault(k, {
             "replica": k, "state": "up", "health": "ok", "role": None,
+            "workload": None,
             "live": None, "slots": None, "queue_depth": None,
             "steps": 0, "breaker": "closed", "routed": 0,
             "requeued": 0, "rejects": 0, "deaths": 0, "restarts": 0,
@@ -183,6 +191,10 @@ def summarize_fleet(events, window=4096):
         # role-tagged record pins the replica's prefill/decode/mixed kind
         if rep is not None and e.get("role") is not None:
             row(rep)["role"] = e.get("role")
+        # the workload tag (embed engines stamp workload="embed" on
+        # every serve event; untagged GPT streams render as "gpt")
+        if rep is not None and e.get("workload") is not None:
+            row(rep)["workload"] = e.get("workload")
         if kind == "serve_step" and rep is not None:
             r = row(rep)
             r["live"] = e.get("live")
@@ -273,7 +285,8 @@ def render_fleet(stats, clock=None):
         f"{time.strftime('%H:%M:%S', time.gmtime(clock))} UTC"
         f"  ({stats['records']} records)",
         "-" * 72,
-        f"{'rep':>3} {'state':<7} {'role':<8} {'health':<9} {'occ':>5} "
+        f"{'rep':>3} {'state':<7} {'role':<8} {'wkld':<6} "
+        f"{'health':<9} {'occ':>5} "
         f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
         f"{'requeued':>8} {'rejects':>7} {'deaths':>6} "
         f"{'drafted':>7} {'acc':>5} {'dir%':>5}",
@@ -281,7 +294,9 @@ def render_fleet(stats, clock=None):
     for r in stats["replicas"]:
         lines.append(
             f"{r['replica']:>3} {r['state']:<7} "
-            f"{str(r.get('role') or '-'):<8} {str(r['health']):<9} "
+            f"{str(r.get('role') or '-'):<8} "
+            f"{str(r.get('workload') or 'gpt'):<6} "
+            f"{str(r['health']):<9} "
             f"{_fmt(r['occupancy'], nd=2):>5} {_fmt(r['live']):>4} "
             f"{_fmt(r['queue_depth']):>5} {r['breaker']:<9} "
             f"{r['routed']:>6} {r['requeued']:>8} {r['rejects']:>7} "
@@ -326,7 +341,8 @@ def render(stats, clock=None):
         f"hetu_top — {time.strftime('%H:%M:%S', time.gmtime(clock))} UTC"
         f"  ({s['records']} records)",
         "-" * 64,
-        f"engine    occupancy {_fmt(s['occupancy'])}"
+        f"engine    workload {s.get('workload') or 'gpt'}"
+        f"  occupancy {_fmt(s['occupancy'])}"
         f"  live {_fmt(s['live'])}/{_fmt(s['slots'])}"
         f"  queue {_fmt(s['queue_depth'])}"
         f"  steps {_fmt(s['steps'])}"

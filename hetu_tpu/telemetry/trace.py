@@ -275,6 +275,35 @@ def check_span_balance(events):
     return problems
 
 
+def check_gather_balance(events):
+    """The gather-phase rule (embedding serving): every ``req_retire``
+    carrying a ``gather_ms`` component must pair with a ``req_span``
+    record of phase "gather" for the same request — a retirement that
+    billed gather time without tracing the phase is a torn lifecycle
+    (and the reverse, a gather span with no retirement, a leaked
+    request).  GPT retirements (no ``gather_ms`` field) are skipped;
+    flight-dump streams are exempt (mid-flight snapshot)."""
+    if any(e.get("event") == "flight_dump" for e in events):
+        return []
+    retired, spanned = set(), set()
+    for e in events:
+        kind = e.get("event")
+        if kind == "req_retire" and e.get("gather_ms") is not None:
+            retired.add(e.get("request"))
+        elif kind == "req_span" and e.get("phase") == "gather":
+            spanned.add(e.get("request"))
+    problems = []
+    for rid in sorted(str(r) for r in retired - spanned):
+        problems.append(
+            f"gather-balance: request {rid!r} retired with a "
+            f"gather_ms component but no req_span phase=gather")
+    for rid in sorted(str(r) for r in spanned - retired):
+        problems.append(
+            f"gather-balance: request {rid!r} traced a gather phase "
+            f"but never retired with a gather_ms component")
+    return problems
+
+
 def check_handoff_balance(events):
     """The KV-handoff pairing rule (ISSUE 12): every ``kv_handoff_out``
     must pair with a ``kv_handoff_in`` for the same request — blocks
@@ -408,7 +437,10 @@ def main(argv=None):
                          "per retired request), and the KV-handoff "
                          "pairing rule (every kv_handoff_out has a "
                          "kv_handoff_in, one retirement per "
-                         "admission); exit 1 on violations")
+                         "admission), and the gather-balance rule "
+                         "(every embed retirement billing gather_ms "
+                         "traced a gather phase); exit 1 on "
+                         "violations")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()
@@ -435,6 +467,8 @@ def main(argv=None):
         problems.extend(spec)
         handoff = check_handoff_balance(events)
         problems.extend(handoff)
+        gather = check_gather_balance(events)
+        problems.extend(gather)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
@@ -442,7 +476,8 @@ def main(argv=None):
                           "span_balance_violations": len(balance),
                           "quant_mix_violations": len(qmix),
                           "spec_attribution_violations": len(spec),
-                          "handoff_violations": len(handoff)}))
+                          "handoff_violations": len(handoff),
+                          "gather_violations": len(gather)}))
         return 1 if problems or bad else 0
 
     if args.export:

@@ -1,0 +1,162 @@
+"""CacheSparseTable thread-safety audit (ISSUE 14 satellite): the
+locking contract in cstable.__init__ under fire.
+
+Serving waves read the cache from engine threads while training-style
+updates land from others, and a PS outage in the middle exercises the
+backlog machinery (_push_or_buffer/_replay — lock-held-only internals)
+on every path.  The regression here: two threads hammering
+lookup+update across a simulated outage window finish with no escaped
+exception, a consistent counter snapshot, a drained backlog, and the
+staleness/pull-bytes observables populated in ``perf_summary()``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hetu_tpu import telemetry
+from hetu_tpu.cache.cstable import CacheSparseTable
+from hetu_tpu.ps.client import PSConnectionError
+from hetu_tpu.ps.server import PSServer
+
+pytestmark = pytest.mark.smoke
+
+W = 4
+VOCAB = 64
+ITERS = 40        # per thread per phase (healthy / outage / recovered)
+
+
+class _FlakyPS:
+    """Every PS verb raises while ``down`` (same rig as the serving
+    outage tests — the cache only sees ConnectionError)."""
+
+    def __init__(self, server):
+        self._server = server
+        self.down = False
+
+    def __getattr__(self, name):
+        fn = getattr(self._server, name)
+
+        def wrapper(*a, **kw):
+            if self.down:
+                raise PSConnectionError("PS down (test)")
+            return fn(*a, **kw)
+        return wrapper
+
+
+def _mk_table(monkeypatch, **kw):
+    # budgets high enough that the hammer degrades instead of surfacing
+    monkeypatch.setenv("HETU_CACHE_MAX_STALE", "1000000")
+    monkeypatch.setenv("HETU_CACHE_BACKLOG_ROWS", "1000000")
+    server = PSServer()
+    server.param_init("emb", (VOCAB, W), "normal", 0.0, 1.0, seed=3)
+    flaky = _FlakyPS(server)
+    t = CacheSparseTable(limit=32, vocab_size=VOCAB, width=W,
+                         key="emb", comm=flaky, policy="LRU", **kw)
+    return t, flaky, server
+
+
+def test_two_thread_hammer_across_outage(monkeypatch):
+    """Lookup thread + update thread, three phases (healthy -> PS down
+    -> recovered), main thread polling perf_summary throughout: no
+    exception escapes, the backlog drains on recovery, and the outage
+    observables are populated."""
+    telemetry.reset()
+    monkeypatch.setenv("HETU_TELEMETRY", "1")
+    t, flaky, server = _mk_table(monkeypatch, push_bound=0)
+    t.embedding_lookup(np.arange(VOCAB))      # warm everything hot
+    errors = []
+    barrier = threading.Barrier(3, timeout=60)
+
+    def run_phases(op):
+        rng = np.random.RandomState(hash(op.__name__) % 2**31)
+        for _phase in range(3):
+            barrier.wait()
+            for _ in range(ITERS):
+                try:
+                    op(rng)
+                except Exception as e:   # noqa: BLE001 — the assert
+                    errors.append(e)
+            barrier.wait()
+
+    def lookup_op(rng):
+        rows = t.embedding_lookup(rng.randint(0, VOCAB, 8))
+        assert rows.shape == (8, W)
+
+    def update_op(rng):
+        ids = rng.randint(0, VOCAB, 4)
+        t.embedding_update(ids, rng.randn(4, W).astype(np.float32) * .01)
+
+    threads = [threading.Thread(target=run_phases, args=(op,))
+               for op in (lookup_op, update_op)]
+    for th in threads:
+        th.start()
+
+    barrier.wait()           # phase 0: healthy
+    barrier.wait()
+    flaky.down = True
+    barrier.wait()           # phase 1: outage — summary reads race the
+    mid = [t.perf_summary() for _ in range(10)]   # hammer on the lock
+    barrier.wait()
+    during = t.perf_summary()
+    flaky.down = False
+    barrier.wait()           # phase 2: recovered
+    barrier.wait()
+    for th in threads:
+        th.join(timeout=60)
+        assert not th.is_alive()
+
+    assert errors == []      # nothing escaped the degradation budget
+    assert all(isinstance(s, dict) for s in mid)
+    # the outage was real and the backlog machinery engaged:
+    # push_bound=0 updates buffered, lookups served stale
+    assert during["ps_failures"] > 0
+    assert during["stale_served_rows"] > 0
+    assert during["backlog_rows"] > 0
+    assert during["staleness_s"] > 0.0
+    # recovery drained the backlog (replay on next PS contact)
+    t.flush()
+    final = t.perf_summary()
+    assert final["backlog_rows"] == 0
+    assert final["staleness_s"] == 0.0
+    assert final["replayed_rows"] > 0
+    assert final["pull_bytes"] > 0
+    assert final["pushed_rows"] > 0
+    # and the cache still agrees with the PS after a final flush: the
+    # hammer's deltas all landed exactly once
+    ids = np.arange(VOCAB)
+    cached = t.embedding_lookup(ids)
+    want = server.sparse_pull("emb", ids)
+    np.testing.assert_allclose(cached, want, rtol=1e-4, atol=1e-5)
+
+
+def test_async_variants_during_outage(monkeypatch):
+    """The pool-thread async API (the serving prefetch path) degrades
+    identically: futures resolve during the outage, replay happens on
+    recovery, counters stay consistent."""
+    telemetry.reset()
+    monkeypatch.setenv("HETU_TELEMETRY", "1")
+    t, flaky, _ = _mk_table(monkeypatch, push_bound=0)
+    rng = np.random.RandomState(0)
+    t.embedding_lookup(np.arange(32))
+    flaky.down = True
+    futs = []
+    for _ in range(20):
+        ids = rng.randint(0, 32, 8)
+        futs.append(t.embedding_lookup_async(ids))
+        futs.append(t.embedding_update_async(
+            ids[:4], rng.randn(4, W).astype(np.float32) * .01))
+    for f in futs:
+        r = f.result(timeout=30)
+        if r is not None:
+            assert r.shape == (8, W)
+    s = t.perf_summary()
+    assert s["ps_failures"] > 0 and s["backlog_rows"] > 0
+    assert s["staleness_s"] > 0.0
+    flaky.down = False
+    t.flush()
+    assert t.perf_summary()["backlog_rows"] == 0
+    # the registry observables mirrored the instance counters
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("cache.pull_bytes", 0) > 0

@@ -148,6 +148,16 @@ def test_serve_gpt_example_chains_decode():
     assert frac == 1.0
 
 
+def test_serve_ctr_example_survives_ps_kill():
+    """Embedding serving demo: a zipf CTR trace scores through the
+    cache-fronted engine with the PS killed for the middle third —
+    every request still scores (stale/zero degradation, zero loss)."""
+    mod = _load("ctr/serve_ctr.py", "ex_serve_ctr")
+    frac = _run_main(mod, ["--requests", "24", "--wave", "4",
+                           "--kill-ps"])
+    assert frac == 1.0
+
+
 def test_gpt_greedy_generation():
     """Inference path: after training next=(x+1)%V, greedy decoding must
     reproduce the arithmetic chain from a prompt (eval subgraph shares
