@@ -371,6 +371,98 @@ python bin/hetu_trace.py "$LOG/embed_trace.jsonl" --check \
   exit 1
 }
 
+# 00g. rolling-swap gate (ISSUE 15): an N=2 CPU fleet runs TWO v1 -> v2
+#      rollouts mid-trace in one process.  The first is chaos-killed
+#      mid-drain (HETU_CHAOS role=swap) and must fail CLEANLY — zero
+#      request loss, fleet back on v1 (the corpse respawns on the
+#      committed version), a flight dump holding the swap timeline.
+#      The chaos kill is one-shot, so the second rollout must LAND:
+#      fleet on v2, every Result version-stamped, and a trace stream
+#      that passes the span-balance AND version-coherence rules.
+run swap_gate 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/swap_trace.jsonl" \
+    HETU_FAILURE_LOG="$LOG/swap_failure.jsonl" \
+    HETU_FLIGHT_LOG="$LOG/swap_flight.jsonl" \
+    HETU_CHAOS="seed=5,kill=2,role=swap" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import time
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.serving import (Request, ServingEngine, ServingRouter,
+                              WeightSyncCoordinator)
+
+def mk_params(seed):
+    rng, hd = np.random.RandomState(seed), 16
+    p = {"sw_wte_table": rng.randn(61, hd) * 0.05,
+         "sw_wpe": rng.randn(32, hd) * 0.05,
+         "sw_ln_f_scale": np.ones(hd), "sw_ln_f_bias": np.zeros(hd)}
+    for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+                   ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+                   ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+        p[f"sw_h0_{w}_weight"] = rng.randn(*shp) * 0.05
+        p[f"sw_h0_{w}_bias"] = np.zeros(shp[1])
+    for ln in ("ln1", "ln2"):
+        p[f"sw_h0_{ln}_scale"] = np.ones(hd)
+        p[f"sw_h0_{ln}_bias"] = np.zeros(hd)
+    return p
+
+p1, p2 = mk_params(0), mk_params(1)
+cfg = GPTConfig(vocab_size=61, hidden_size=16, num_hidden_layers=1,
+                num_attention_heads=2, max_position_embeddings=32,
+                batch_size=1, seq_len=32, dropout_rate=0.0)
+router = ServingRouter(
+    lambda i: ServingEngine(p1, cfg, slots=2, fast_path=False),
+    replicas=2, restart_backoff=0.01)
+coord = WeightSyncCoordinator(router, p1, version=1)
+
+def trace(n, seed):
+    rng = np.random.RandomState(seed)
+    return [Request(prompt=[int(t) for t in rng.randint(0, 61, 3)],
+                    max_new_tokens=4) for _ in range(n)]
+
+# rollout 1: the seeded kill fires at replica 0's drain seam
+assert coord.begin(p2, 2)
+res1 = router.run(trace(8, 11))
+coord.drain()
+assert len(res1) == 8, f"retired {len(res1)}/8 under the chaos kill"
+assert coord.state == "rolled_back", coord.last
+deadline = time.time() + 10.0
+while len(coord.fleet_versions()) < 2 and time.time() < deadline:
+    router.step(); time.sleep(0.005)
+assert coord.fleet_versions() == {0: 1, 1: 1}, coord.fleet_versions()
+
+# rollout 2: the one-shot kill is spent — this one must land
+assert coord.begin(p2, 2)
+res2 = router.run(trace(8, 12))
+coord.drain()
+assert len(res2) == 8, f"retired {len(res2)}/8 in the clean rollout"
+assert coord.state == "done", coord.last
+assert coord.fleet_versions() == {0: 2, 1: 2}, coord.fleet_versions()
+assert all(r.weight_version in (1, 2) for r in res2.values())
+snap = router.snapshot()
+assert snap["lost"] == 0 and snap["duplicates"] == 0, snap
+print("rolling swap gate OK: failed+rolled_back then done,"
+      " fleet v2, finished", snap["finished"])
+PYEOF
+if ! grep -q 'rolling swap gate OK' "$LOG/swap_gate.log"; then
+  echo "rolling-swap gate FAILED — see $LOG/swap_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/swap_trace.jsonl" \
+    "$LOG/swap_failure.jsonl" --check \
+    > "$LOG/swap_trace_contract.log" || {
+  echo "swap span-balance/version-coherence check FAILED — see" \
+       "$LOG/swap_trace_contract.log" >&2
+  exit 1
+}
+python bin/hetu_trace.py "$LOG/swap_flight.jsonl" --check \
+    > "$LOG/swap_flight_contract.log" || {
+  echo "swap flight-dump contract check FAILED — see" \
+       "$LOG/swap_flight_contract.log" >&2
+  exit 1
+}
+
 # 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
 #     must pass BEFORE any chip time is spent).  Speculative-decoding
 #     trace-replay gate: the draft-propose / batched-verify path must

@@ -326,6 +326,23 @@ _reg("HETU_DIRECTORY_TTL", "float", 0.0,
      "catches every lie).", "router")
 
 # --------------------------------------------------------------------- #
+# live weight sync (serving/weight_sync.py — rolling zero-downtime swaps)
+# --------------------------------------------------------------------- #
+_reg("HETU_SWAP_PROBE_TOKENS", "int", 4,
+     "Greedy probe-decode length (tokens) a freshly swapped replica "
+     "must retire on the NEW weight version before the rollout "
+     "readmits it — the half-open check of a rolling swap.", "swap")
+_reg("HETU_SWAP_DRAIN_STEPS", "int", 2000,
+     "Max router steps a quiesced replica may take to drain its "
+     "in-flight requests before the rollout is marked failed (and the "
+     "fleet auto-rolls back).", "swap")
+_reg("HETU_SWAP_ROLLBACK", "bool", True,
+     "Auto-roll already-swapped replicas back to the last COMMITTED "
+     "version when a rollout fails mid-swap.  0 leaves them on the new "
+     "version (the rollout is still marked failed); dead replicas "
+     "respawn on the committed version either way.", "swap")
+
+# --------------------------------------------------------------------- #
 # quantization (hetu_tpu/quant.py — one layer, three seams)
 # --------------------------------------------------------------------- #
 _reg("HETU_PS_QUANT", "str", None,

@@ -37,7 +37,16 @@ Spec grammar (comma-separated ``k=v``)::
                       (prefix match: role=server matches server:0).
                       Seams hosting several roles in ONE process (the
                       router's replica fleet) pass their role to
-                      ``draw(role=...)`` explicitly, overriding the env
+                      ``draw(role=...)`` explicitly, overriding the env.
+                      ``role=swap`` scopes a plan to the live-weight-
+                      sync seams (serving/weight_sync.py): the
+                      coordinator draws at ``swap.version_push``
+                      (kinds drop/reset = a corrupt/stale version read,
+                      rejecting the rollout), then per replica at
+                      ``swap.drain`` (kill mid-drain) and
+                      ``swap.apply`` (kill after the buffers moved,
+                      before the probe) — ``kill=<n>`` picks the seam
+                      by draw position
 
 Determinism: decision ``i`` is a pure function of ``(seed, i)`` (a
 blake2 hash, not an RNG object), so a spec replays the identical fault

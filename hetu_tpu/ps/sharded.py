@@ -438,6 +438,49 @@ class ShardedPSClient:
                 "param_assign", km(key), part))
         self._fan(one)
 
+    # ---------------- versioned weight pull ---------------- #
+    # Live weight sync (serving/weight_sync.py): the trainer stamps a
+    # monotonically increasing fleet version next to the weights it
+    # pushes; a serving-side coordinator pulls the pytree under a
+    # torn-read guard (version re-checked after the last key) so a
+    # push racing the pull can never hand the fleet a mixed snapshot.
+
+    WEIGHTS_VERSION_KEY = "__weights_version__"
+
+    def set_weights_version(self, version):
+        """Stamp the resident weights with ``version`` (call AFTER the
+        weight push completes — pullers treat the stamp as the commit
+        point)."""
+        self.param_set(self.WEIGHTS_VERSION_KEY,
+                       np.asarray([float(version)], np.float32))
+
+    def weights_version(self):
+        """The committed weight version, or None when never stamped."""
+        try:
+            v = np.asarray(self.pull(self.WEIGHTS_VERSION_KEY)).ravel()
+        except Exception:  # noqa: BLE001 — unstamped PS
+            return None
+        return int(v[0]) if v.size else None
+
+    def pull_versioned(self, keys, retries=1):
+        """Pull ``keys`` as one version-consistent snapshot: returns
+        ``(params, version)``.  The version stamp is read before and
+        after the keys; a mismatch (a push landed mid-pull) retries the
+        whole snapshot, then raises — a torn pytree must never reach a
+        serving engine."""
+        last = (None, None)
+        for _ in range(int(retries) + 1):
+            v0 = self.weights_version()
+            params = {k: self.pull(k) for k in keys}
+            v1 = self.weights_version()
+            if v0 == v1:
+                return params, v1
+            last = (v0, v1)
+            self._event("ps_version_skew", before=v0, after=v1)
+        raise RuntimeError(
+            f"versioned pull torn across a push "
+            f"(v{last[0]} -> v{last[1]}) after {retries + 1} attempts")
+
     def clear(self, key):
         self._row_sharded.pop(key, None)
 

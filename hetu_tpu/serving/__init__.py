@@ -45,6 +45,16 @@ compiled arithmetic — with the offline path.
                   affinity hash, so any replica's warm cache attracts
                   matching traffic (hit/steal), with TTL staleness and
                   graceful degradation to plain affinity when killed
+    weight_sync.py
+                  WeightSyncCoordinator: zero-downtime rolling weight
+                  swaps — quiesce one replica (breaker-style routing
+                  exclusion), drain its in-flight work, swap the param
+                  dict under the engine (no recompile; the spec draft
+                  inherits it), probe-decode on the new version, then
+                  readmit; version-stamped end to end (every serve
+                  event/Result carries weight_version), chaos-gated
+                  (HETU_CHAOS role=swap), auto-rollback to the last
+                  committed version on any mid-swap failure
     request.py    Request / Result dataclasses
     metrics.py    ServingMetrics: TTFT/TPOT percentiles, tok/s,
                   occupancy; JSONL events (per-step prefill_ms/
@@ -100,9 +110,11 @@ from .embed_engine import EmbedServingEngine
 from .prefix_directory import PrefixDirectory, prefix_hash
 from .replica import Replica
 from .router import RouterShed, ServingRouter
+from .weight_sync import WeightSyncCoordinator
 
 __all__ = [
     "ServingEngine", "EmbedServingEngine", "ServingRouter", "Replica",
+    "WeightSyncCoordinator",
     "QueueFull", "RouterShed", "Request", "RequestCore", "Result",
     "EmbedRequest", "EmbedResult",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",

@@ -187,6 +187,48 @@ class EmbedServingEngine:
         self.steps = 0
         self.peak_live = 0
         self._fwd_cache = {}        # row bucket -> jitted forward
+        # live weight sync: version of the resident tower params
+        # (None = unversioned); waves are atomic, so every result of a
+        # wave carries the one version it scored under
+        self.weight_version = None
+        self.last_swap_at = None
+
+    # ------------------------------------------------------------- #
+    # live weight sync (serving/weight_sync.py)
+    # ------------------------------------------------------------- #
+
+    def set_weight_version(self, version):
+        """Stamp the current params; rides ``metrics.tags`` so every
+        serve event carries ``weight_version``."""
+        self.weight_version = int(version)
+        self.metrics.tags["weight_version"] = self.weight_version
+
+    def swap_params(self, params, *, version=None):
+        """Replace the tower params between waves (the rolling-swap
+        primitive; the jitted forwards take params as arguments, so no
+        recompile).  Key-set and shapes must match the resident dict —
+        a corrupt push fails here, before anything moves.  Call only on
+        a drained engine (``pending == 0``)."""
+        new = {}
+        for k, v in params.items():
+            p = jnp.asarray(v, jnp.float32)
+            old = self.params.get(k)
+            if old is not None and tuple(p.shape) != tuple(old.shape):
+                raise ValueError(
+                    f"swap_params: {k} has shape {tuple(p.shape)}, "
+                    f"resident is {tuple(old.shape)}")
+            new[k] = p
+        if set(new) != set(self.params):
+            missing = sorted(set(self.params) - set(new))
+            extra = sorted(set(new) - set(self.params))
+            raise ValueError(
+                f"swap_params key mismatch: missing {missing[:4]}, "
+                f"unexpected {extra[:4]}")
+        self.params = new
+        self.last_swap_at = time.perf_counter()
+        if version is not None:
+            self.set_weight_version(version)
+        self.metrics.event("weight_swap", version=self.weight_version)
 
     # ------------------------------------------------------------- #
 
@@ -325,7 +367,8 @@ class EmbedServingEngine:
                 request_id=req.request_id, scores=s,
                 n_pairs=req.n_pairs, finish_reason="scored",
                 ttft_s=ttft, latency_s=ttft, slot=slot,
-                cache_hit_rate=hit_rate)
+                cache_hit_rate=hit_rate,
+                weight_version=self.weight_version)
             self.metrics.record_finish(req.request_id, "scored",
                                        req.n_pairs, ttft)
             self.slo.observe(request_id=req.request_id,

@@ -208,6 +208,15 @@ class ServingEngine:
         self._keys = np.zeros((B, 2), np.uint32)
         self._reqs = [None] * B
         self._gen = [None] * B               # generated ids per slot
+        # live weight sync (serving/weight_sync.py): the version the
+        # current param dict is stamped with (None = unversioned) and
+        # the per-slot ADMISSION version a retirement reports — the
+        # coordinator only swaps a drained engine, so the two agree
+        # unless something upstream broke (exactly what the trace
+        # version-coherence rule exists to catch)
+        self.weight_version = None
+        self.last_swap_at = None
+        self._slot_version = [None] * B
         self._prefill_off = np.zeros(B, np.int32)  # paged: next prompt
         self._prompt_arr = [None] * B              # position to prefill
         self.steps = 0
@@ -251,6 +260,52 @@ class ServingEngine:
             self._spec_acc = np.zeros(B, np.int64)
             self._spec_prop = np.zeros(B, np.int64)
             self._spec_bonus = np.zeros(B, np.int64)
+
+    # ------------------------------------------------------------- #
+    # live weight sync (serving/weight_sync.py)
+    # ------------------------------------------------------------- #
+
+    def set_weight_version(self, version):
+        """Stamp the CURRENT params with ``version``: rides
+        ``metrics.tags`` so every subsequent serve event carries
+        ``weight_version`` (the A/B and trace-coherence key)."""
+        self.weight_version = int(version)
+        self.metrics.tags["weight_version"] = self.weight_version
+
+    def swap_params(self, params, *, version=None):
+        """Replace the weights under the engine between steps — the
+        rolling-swap primitive.  No recompile: every jitted step takes
+        the param dict as an argument, so the next wave simply sees the
+        new buffers (the spec-decode draft shares this dict and
+        inherits the swap for free).  The new pytree must match the old
+        one key-for-key and shape-for-shape (a corrupt push fails HERE,
+        before any buffer moves); dtypes follow the resident params so
+        the KV cache dtype contract survives the swap.  Call only on a
+        drained engine (the coordinator's job) — live slots would mix
+        versions mid-request."""
+        name = self._name
+        new = {}
+        for k, v in params.items():
+            if not k.startswith(name + "_"):
+                continue
+            old = self.params.get(k)
+            p = _prep_param(v, old.dtype if old is not None else None)
+            if old is not None and tuple(p.shape) != tuple(old.shape):
+                raise ValueError(
+                    f"swap_params: {k} has shape {tuple(p.shape)}, "
+                    f"resident is {tuple(old.shape)}")
+            new[k] = p
+        if set(new) != set(self.params):
+            missing = sorted(set(self.params) - set(new))
+            extra = sorted(set(new) - set(self.params))
+            raise ValueError(
+                f"swap_params key mismatch: missing {missing[:4]}, "
+                f"unexpected {extra[:4]}")
+        self.params = new
+        self.last_swap_at = time.perf_counter()
+        if version is not None:
+            self.set_weight_version(version)
+        self.metrics.event("weight_swap", version=self.weight_version)
 
     # ------------------------------------------------------------- #
 
@@ -375,6 +430,7 @@ class ServingEngine:
                     self._topk[slot] = req.top_k
                     self._keys[slot] = key
                     self._reqs[slot] = req
+                    self._slot_version[slot] = self.weight_version
                     self._gen[slot] = [tok0]
                     self.metrics.record_admit(
                         req.request_id, slot, now - req.submitted_at,
@@ -583,6 +639,7 @@ class ServingEngine:
                     req.request_id, (time.perf_counter() - t_a) * 1e3)
                 self._queue.popleft()
                 self._reqs[slot] = req
+                self._slot_version[slot] = self.weight_version
                 self._gen[slot] = None
                 self._prompt_arr[slot] = np.asarray(req.prompt, np.int32)
                 self._prefill_off[slot] = cached
@@ -977,7 +1034,8 @@ class ServingEngine:
             n_generated=n, ttft_s=req.first_token_at - req.submitted_at,
             latency_s=now - req.submitted_at, slot=slot,
             spec_accepted=spec["accepted"] if spec else 0,
-            spec_proposed=spec["proposed"] if spec else 0)
+            spec_proposed=spec["proposed"] if spec else 0,
+            weight_version=self._slot_version[slot])
         self.metrics.record_finish(req.request_id, reason, n,
                                    res.latency_s, spec=spec)
         decode_s = now - req.first_token_at
@@ -991,6 +1049,7 @@ class ServingEngine:
             self.retire_hook(req, slot)
         self._reqs[slot] = None
         self._gen[slot] = None
+        self._slot_version[slot] = None
         self.kv.release(slot)
         return res
 

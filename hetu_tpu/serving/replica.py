@@ -267,4 +267,7 @@ class Replica:
             "live": self.live,
             "occupancy": round(self.occupancy, 4),
             "exit_code": self.exit_code,
+            "weight_version": (getattr(self.engine, "weight_version",
+                                       None)
+                               if self.engine is not None else None),
         }

@@ -128,6 +128,10 @@ class Result:
     # accepted + bonus samples + the prefill token == n_generated
     spec_accepted: int = 0
     spec_proposed: int = 0
+    # the weight version the request was ADMITTED under (None on an
+    # unversioned engine) — a swap never lands mid-request, so every
+    # generated token is this version's
+    weight_version: Optional[int] = None
 
     @property
     def generated(self) -> List[int]:
@@ -206,3 +210,5 @@ class EmbedResult:
     latency_s: float
     slot: int
     cache_hit_rate: float = 0.0
+    # the weight version the scoring wave ran under (None unversioned)
+    weight_version: Optional[int] = None

@@ -255,6 +255,12 @@ class ServingRouter:
         self._breaker = [
             {"state": "closed", "failures": 0, "open_until": 0.0,
              "probe": None, "opens": 0} for _ in range(n)]
+        # live weight sync: replicas quiesced for a rolling swap are
+        # excluded from placement exactly like an open breaker; the
+        # WeightSyncCoordinator (router.weight_sync) owns the set and
+        # gets a tick per step to advance its rollout
+        self._swap_hold = set()
+        self.weight_sync = None
         self._reject_streak = [0] * n
         self._session_last = {}                # session_id -> replica
         # counters (snapshot surface)
@@ -527,7 +533,8 @@ class ServingRouter:
         warmth wherever it lands; the role rank should pick a
         decode-heavy home, not the session hash)."""
         cands = [r for r in self.replicas
-                 if r.state == UP and self._breaker_allows(r.index, now)]
+                 if r.state == UP and r.index not in self._swap_hold
+                 and self._breaker_allows(r.index, now)]
         cands.sort(key=lambda r: (-self._score(r), r.index))
         if self._roles_active:
             rank = _ROLE_RANK[routed.phase]
@@ -727,6 +734,11 @@ class ServingRouter:
                         "replica_wedged_kill", replica=r.index,
                         age_s=round(now - r.last_beat, 3))
                     r.die(rc=-9, error="stale heartbeat")
+        if self.weight_sync is not None:
+            # advance a rolling weight swap BEFORE the death drain: a
+            # chaos kill the coordinator fires here requeues the
+            # victim's requests within this same iteration (zero loss)
+            self.weight_sync.tick(now)
         for r in self.replicas:
             if r.state == DEAD and not r.drained:
                 self._on_death(r, now)
@@ -908,6 +920,7 @@ class ServingRouter:
             row["breaker_opens"] = b["opens"]
             row["routed"] = self._placed[r.index]
             row["rejects"] = self._rejects[r.index]
+            row["swap_hold"] = r.index in self._swap_hold
             rows.append(row)
         return {
             "replicas": rows,
@@ -936,6 +949,8 @@ class ServingRouter:
             "handoff_failed": self.handoff_failed,
             "handoffs_skipped": self.handoffs_skipped,
             "handoff_bytes": self.handoff_bytes,
+            "weight_sync": (self.weight_sync.snapshot()
+                            if self.weight_sync is not None else None),
             "latency_p50_s": _p(self._lat, 50),
             "latency_p95_s": _p(self._lat, 95),
             "latency_p99_s": _p(self._lat, 99),

@@ -109,6 +109,22 @@ REQUIRED_FIELDS = {
     "kv_handoff_in": ("request", "replica", "from_replica"),
     "kv_handoff_drop": ("request", "replica"),
     "directory_killed": ("reason",),
+    # live weight sync (serving/weight_sync.py; ISSUE 15): the rolling
+    # quiesce->drain->swap->probe->readmit cycle per replica (serve
+    # stream) plus rollout lifecycle; failures (stale push, mid-swap
+    # death) ride the failure stream
+    "weight_swap": ("version",),
+    "swap_quiesce": ("replica", "version"),
+    "swap_drained": ("replica", "version"),
+    "swap_probe": ("replica", "version", "ok"),
+    "swap_readmit": ("replica", "version"),
+    "swap_rejected_stale": ("version", "committed"),
+    "rollout_start": ("version", "replicas"),
+    "rollout_advance": ("version", "done", "replicas"),
+    "rollout_done": ("version", "swapped"),
+    "rollout_failed": ("version", "reason"),
+    "rollout_rollback": ("version", "replicas"),
+    "ps_version_skew": ("before", "after"),
     # flight recorder dump header (telemetry/flight.py)
     "flight_dump": ("reason",),
     # telemetry core + bench

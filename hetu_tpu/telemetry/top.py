@@ -37,6 +37,13 @@ KV handoffs) underneath.  The role column reads the ``role`` tag the
 replica's engine stamps on its serve events; the directory columns
 read the ``directory=hit/steal/miss/stale`` verdicts the router
 stamps on its ``router_route`` records (ISSUE 12).
+
+Live weight sync (ISSUE 15): the single-engine view shows the current
+``weight_version`` and the last-swap timestamp (from ``weight_swap``
+records); ``--fleet`` grows a per-replica ``ver`` column (the
+``weight_version`` tag riding each replica's serve events) and a
+rollout-progress footer (``rollout   rolling 1/2 → v7``) assembled
+from the coordinator's ``rollout_*`` records.
 """
 
 from __future__ import annotations
@@ -64,8 +71,19 @@ def summarize(events, window=512):
     slo = {"state": None, "burn_rate": None, "violations": 0}
     flight_dumps = 0
     workload = None
+    weight_version = None
+    last_swap_t = None
     for e in events:
         kind = e.get("event")
+        # live weight sync: the weight_version tag rides every serve
+        # event once the engine is version-stamped; a weight_swap
+        # record marks the last rolling-swap instant
+        if e.get("weight_version") is not None:
+            weight_version = e.get("weight_version")
+        if kind == "weight_swap":
+            last_swap_t = e.get("t")
+            if e.get("version") is not None:
+                weight_version = e.get("version")
         # the workload tag embed engines stamp on every serve event;
         # untagged streams (GPT engines predate the tag) default "gpt"
         if kind and kind.startswith("serve_") and \
@@ -158,6 +176,8 @@ def summarize(events, window=512):
         "spec": spec,
         "slo": slo,
         "flight_dumps": flight_dumps,
+        "weight_version": weight_version,
+        "last_swap_t": last_swap_t,
     }
 
 
@@ -172,7 +192,7 @@ def summarize_fleet(events, window=4096):
     def row(k):
         return per.setdefault(k, {
             "replica": k, "state": "up", "health": "ok", "role": None,
-            "workload": None,
+            "workload": None, "version": None,
             "live": None, "slots": None, "queue_depth": None,
             "steps": 0, "breaker": "closed", "routed": 0,
             "requeued": 0, "rejects": 0, "deaths": 0, "restarts": 0,
@@ -184,6 +204,7 @@ def summarize_fleet(events, window=4096):
     prefix = {"hits": 0, "misses": 0, "steals": 0, "stale": 0}
     hops = handoffs = 0
     pressure = None
+    rollout = None          # live-weight-sync progress footer
     for e in events:
         kind = e.get("event")
         rep = e.get("replica")
@@ -195,6 +216,10 @@ def summarize_fleet(events, window=4096):
         # every serve event; untagged GPT streams render as "gpt")
         if rep is not None and e.get("workload") is not None:
             row(rep)["workload"] = e.get("workload")
+        # the weight_version tag (live weight sync): the newest stamp
+        # per replica is its current version
+        if rep is not None and e.get("weight_version") is not None:
+            row(rep)["version"] = e.get("weight_version")
         if kind == "serve_step" and rep is not None:
             r = row(rep)
             r["live"] = e.get("live")
@@ -239,6 +264,20 @@ def summarize_fleet(events, window=4096):
                 r["requeued"] += 1
         elif kind == "router_breaker" and rep is not None:
             row(rep)["breaker"] = e.get("state")
+        elif kind == "rollout_start":
+            rollout = {"version": e.get("version"), "done": 0,
+                       "replicas": e.get("replicas"),
+                       "state": ("rolling"
+                                 if e.get("phase") != "rollback"
+                                 else "rolling back")}
+        elif kind == "rollout_advance" and rollout is not None:
+            rollout["done"] = e.get("done", rollout["done"])
+        elif kind == "rollout_done" and rollout is not None:
+            rollout["state"] = ("done"
+                                if e.get("phase") != "rollback"
+                                else "rolled back")
+        elif kind == "rollout_failed" and rollout is not None:
+            rollout["state"] = "failed"
         elif kind == "router_shed":
             cls = e.get("slo_class")
             if cls in shed:
@@ -275,6 +314,7 @@ def summarize_fleet(events, window=4096):
         "prefix": prefix,
         "handoffs": handoffs,
         "pressure": pressure,
+        "rollout": rollout,
     }
 
 
@@ -285,17 +325,19 @@ def render_fleet(stats, clock=None):
         f"{time.strftime('%H:%M:%S', time.gmtime(clock))} UTC"
         f"  ({stats['records']} records)",
         "-" * 72,
-        f"{'rep':>3} {'state':<7} {'role':<8} {'wkld':<6} "
+        f"{'rep':>3} {'state':<7} {'role':<8} {'wkld':<6} {'ver':>4} "
         f"{'health':<9} {'occ':>5} "
         f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
         f"{'requeued':>8} {'rejects':>7} {'deaths':>6} "
         f"{'drafted':>7} {'acc':>5} {'dir%':>5}",
     ]
     for r in stats["replicas"]:
+        ver = r.get("version")
         lines.append(
             f"{r['replica']:>3} {r['state']:<7} "
             f"{str(r.get('role') or '-'):<8} "
             f"{str(r.get('workload') or 'gpt'):<6} "
+            f"{('v' + str(ver)) if ver is not None else '-':>4} "
             f"{str(r['health']):<9} "
             f"{_fmt(r['occupancy'], nd=2):>5} {_fmt(r['live']):>4} "
             f"{_fmt(r['queue_depth']):>5} {r['breaker']:<9} "
@@ -317,6 +359,13 @@ def render_fleet(stats, clock=None):
         f"  steals {pre.get('steals', 0)}"
         f"  stale {pre.get('stale', 0)}"
         f"  handoffs {stats.get('handoffs', 0)}")
+    ro = stats.get("rollout")
+    if ro is not None:
+        # "rollout   rolling 1/2 → v7" while in flight; terminal
+        # states render as done/failed/rolled back
+        lines.append(
+            f"rollout   {ro['state']} {ro.get('done', 0)}"
+            f"/{_fmt(ro.get('replicas'))} → v{_fmt(ro.get('version'))}")
     return "\n".join(lines)
 
 
@@ -347,6 +396,11 @@ def render(stats, clock=None):
         f"  queue {_fmt(s['queue_depth'])}"
         f"  steps {_fmt(s['steps'])}"
         f"  tok/s {_fmt(s['tokens_per_sec'])}",
+        f"weights   version "
+        f"{('v' + str(s['weight_version'])) if s.get('weight_version') is not None else '-'}"
+        f"  last_swap "
+        + (time.strftime('%H:%M:%S', time.gmtime(s['last_swap_t']))
+           if s.get('last_swap_t') else '-'),
         f"kv pool   blocks_free {_fmt(s['blocks_free'])}"
         f"  blocks_shared {_fmt(s['blocks_shared'])}"
         f"  prefixes {_fmt(s['prefix_entries'])}",

@@ -59,6 +59,12 @@ handed-off request must retire exactly once per router admission —
 two ``serve_finish`` records (prefill clone + real request), with
 ``router_hop``-carrying requests exempt the same way span-balance
 exempts them.
+
+``--check`` also enforces the version-coherence rule (ISSUE 15): all
+of one request's ``weight_version``-stamped records must agree on a
+single version — a rolling weight swap only lands on a drained
+replica, so a request that spans two versions without a ``router_hop``
+requeue (or a handoff pair) means a swap landed under a live request.
 """
 
 from __future__ import annotations
@@ -412,6 +418,45 @@ def check_spec_attribution(events):
     return problems
 
 
+def check_version_coherence(events):
+    """The live-weight-sync rule (ISSUE 15): no retirement may mix
+    tokens from two weight versions.  Every per-request record
+    (``serve_submit``/``serve_admit``/``serve_finish``, ``req_span``,
+    ``req_retire``) carries the ``weight_version`` tag of the engine
+    that emitted it, and a rolling swap only lands on a DRAINED
+    replica — so all of one request's records must agree on a single
+    version.  The one legal exception is a router requeue
+    (``router_hop`` names the request): a request admitted pre-swap
+    that loses its replica legitimately re-admits — token-identically
+    — on a peer that may already run the new version.  A prefill ->
+    decode handoff pair is exempt the same way (each phase admits on
+    its own replica; a rollout may pass between them).  Streams from a
+    flight-recorder dump are mid-flight snapshots and are exempt, as
+    are unversioned fleets (no ``weight_version`` tags anywhere)."""
+    if any(e.get("event") == "flight_dump" for e in events):
+        return []
+    versions, exempt = {}, set()
+    for e in events:
+        kind = e.get("event")
+        rid = e.get("request")
+        if kind in ("router_hop", "kv_handoff_out", "kv_handoff_in"):
+            exempt.add(rid)
+            continue
+        v = e.get("weight_version")
+        if rid is None or v is None:
+            continue
+        versions.setdefault(rid, set()).add(v)
+    problems = []
+    for rid in sorted(versions, key=str):
+        vs = versions[rid]
+        if len(vs) > 1 and rid not in exempt:
+            problems.append(
+                f"version-coherence: request {rid!r} carries records "
+                f"from weight versions {sorted(vs)} with no router "
+                f"requeue — a swap landed under a live request")
+    return problems
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="hetu_trace",
@@ -437,9 +482,12 @@ def main(argv=None):
                          "per retired request), and the KV-handoff "
                          "pairing rule (every kv_handoff_out has a "
                          "kv_handoff_in, one retirement per "
-                         "admission), and the gather-balance rule "
+                         "admission), the gather-balance rule "
                          "(every embed retirement billing gather_ms "
-                         "traced a gather phase); exit 1 on "
+                         "traced a gather phase), and the "
+                         "version-coherence rule (no retirement mixes "
+                         "weight versions; a request only changes "
+                         "version across a router requeue); exit 1 on "
                          "violations")
     args = ap.parse_args(argv)
 
@@ -469,6 +517,8 @@ def main(argv=None):
         problems.extend(handoff)
         gather = check_gather_balance(events)
         problems.extend(gather)
+        version = check_version_coherence(events)
+        problems.extend(version)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
@@ -477,7 +527,8 @@ def main(argv=None):
                           "quant_mix_violations": len(qmix),
                           "spec_attribution_violations": len(spec),
                           "handoff_violations": len(handoff),
-                          "gather_violations": len(gather)}))
+                          "gather_violations": len(gather),
+                          "version_violations": len(version)}))
         return 1 if problems or bad else 0
 
     if args.export:

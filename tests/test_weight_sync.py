@@ -1,0 +1,430 @@
+"""Live weight sync (ISSUE 15 tentpole): zero-downtime rolling weight
+swaps across the serving fleet.
+
+The acceptance spine: a v1 -> v2 rollout over a 2-replica fleet while a
+request trace is in flight loses ZERO requests, every Result is
+token-identical to offline ``generate_fast`` under the EXACT param
+version it was admitted on (``Result.weight_version``), and the fleet
+lands on v2.  Chaos (``HETU_CHAOS role=swap``) kills a replica
+mid-drain or mid-swap: the rollout fails, already-swapped survivors
+roll back to the committed version, the corpse respawns ON the
+committed version, requests still retire exactly once, and the flight
+recorder holds the swap timeline.  Around it: stale/corrupt version
+push rejection, the PS torn-read-guarded ``pull_versioned`` handoff,
+the engine-level ``swap_params`` contract (shape/key-set validation,
+no recompile), the ``hetu_trace --check`` version-coherence rule, and
+the ``hetu_top --fleet`` version column + rollout footer.
+
+All CPU-harness, all smoke-tier (tiny random-weight GPTs — the
+contract under test is swap orchestration, not model quality).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import hetu_tpu as ht  # noqa: F401  (platform forcing + compat shims)
+from hetu_tpu import telemetry
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.models.gpt_decode import generate_fast
+from hetu_tpu.ps import faults
+from hetu_tpu.ps.server import PSServer
+from hetu_tpu.ps.sharded import ShardedPSClient
+from hetu_tpu.serving import (
+    Request, ServingEngine, ServingRouter, WeightSyncCoordinator,
+)
+from hetu_tpu.telemetry import top
+from hetu_tpu.telemetry.trace import (
+    check_span_balance, check_version_coherence, read_events,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+def _rand_gpt(name="ws", L=1, H=2, Dh=8, V=61, S=32, seed=0):
+    """Deterministic random params in generate_fast's naming contract."""
+    rng = np.random.RandomState(seed)
+    hd = H * Dh
+    p = {f"{name}_wte_table": rng.randn(V, hd) * 0.05,
+         f"{name}_wpe": rng.randn(S, hd) * 0.05,
+         f"{name}_ln_f_scale": np.ones(hd),
+         f"{name}_ln_f_bias": np.zeros(hd)}
+    for i in range(L):
+        us = f"{name}_h{i}"
+        for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+                       ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+                       ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+            p[f"{us}_{w}_weight"] = rng.randn(*shp) * 0.05
+            p[f"{us}_{w}_bias"] = np.zeros(shp[1])
+        for ln in ("ln1", "ln2"):
+            p[f"{us}_{ln}_scale"] = np.ones(hd)
+            p[f"{us}_{ln}_bias"] = np.zeros(hd)
+    cfg = GPTConfig(vocab_size=V, hidden_size=hd, num_hidden_layers=L,
+                    num_attention_heads=H, max_position_embeddings=S,
+                    batch_size=1, seq_len=S, dropout_rate=0.0)
+    return p, cfg
+
+
+@pytest.fixture(scope="module")
+def model():
+    # v1 and v2 share shapes/keys but not values: a swap visibly
+    # changes greedy outputs, so token-identity pins the version
+    p1, cfg = _rand_gpt(seed=0)
+    p2, _ = _rand_gpt(seed=1)
+    return p1, p2, cfg
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("HETU_TELEMETRY", "1")
+    monkeypatch.delenv("HETU_CHAOS", raising=False)
+    faults.reset_plans()
+    telemetry.reset()
+    yield
+    faults.reset_plans()
+    telemetry.reset()
+
+
+def _fleet(model, **kw):
+    p1, _, cfg = model
+    kw.setdefault("slots", 2)
+    kw.setdefault("queue_limit", 16)
+    kw.setdefault("fast_path", False)
+    router = ServingRouter(lambda i: ServingEngine(p1, cfg, **kw),
+                           replicas=2, restart_backoff=0.01)
+    return router, WeightSyncCoordinator(router, p1, version=1)
+
+
+def _trace(n=10, seed=7, vocab=61):
+    rng = np.random.RandomState(seed)
+    return [Request(prompt=[int(t) for t in
+                            rng.randint(0, vocab, int(rng.randint(1, 5)))],
+                    max_new_tokens=int(rng.randint(3, 9)))
+            for _ in range(n)]
+
+
+def _offline(params, cfg, req):
+    return generate_fast(params, cfg, [req.prompt],
+                         num_tokens=req.max_new_tokens)[0].tolist()
+
+
+def _wait_respawn(router, coord, n=2, budget=5.0):
+    deadline = time.time() + budget
+    while len(coord.fleet_versions()) < n and time.time() < deadline:
+        router.step()
+        time.sleep(0.005)
+    return coord.fleet_versions()
+
+
+# --------------------------------------------------------------------- #
+# engine-level swap contract
+# --------------------------------------------------------------------- #
+
+class TestEngineSwap:
+    def test_swap_changes_outputs_and_stamps_version(self, model):
+        """swap_params rebinds the param dict between steps (no
+        recompile): the SAME request decodes v1 tokens before the swap
+        and v2 tokens after, and each Result carries the version it was
+        admitted on."""
+        p1, p2, cfg = model
+        eng = ServingEngine(p1, cfg, slots=2, fast_path=False)
+        eng.set_weight_version(1)
+        req = Request(prompt=[5, 6, 7], max_new_tokens=5)
+        r1 = next(iter(eng.run([req]).values()))
+        assert r1.weight_version == 1
+        assert r1.tokens.tolist() == _offline(p1, cfg, req)
+        eng.swap_params(p2, version=2)
+        assert eng.weight_version == 2
+        assert eng.last_swap_at is not None
+        req2 = Request(prompt=[5, 6, 7], max_new_tokens=5)
+        r2 = next(iter(eng.run([req2]).values()))
+        assert r2.weight_version == 2
+        assert r2.tokens.tolist() == _offline(p2, cfg, req2)
+        assert r2.tokens.tolist() != r1.tokens.tolist()
+
+    def test_swap_rejects_shape_and_key_mismatch(self, model):
+        """A corrupt pytree (wrong shape, missing/extra keys) must fail
+        the validation BEFORE any resident buffer moves."""
+        p1, p2, cfg = model
+        eng = ServingEngine(p1, cfg, slots=2, fast_path=False)
+        bad_shape = dict(p2)
+        bad_shape["ws_wpe"] = np.zeros((3, 3))
+        with pytest.raises(ValueError, match="shape"):
+            eng.swap_params(bad_shape, version=2)
+        missing = {k: v for k, v in p2.items() if k != "ws_wpe"}
+        with pytest.raises(ValueError):
+            eng.swap_params(missing, version=2)
+        # the failed swaps left v1 resident and the version unchanged
+        req = Request(prompt=[1, 2], max_new_tokens=4)
+        r = next(iter(eng.run([req]).values()))
+        assert r.tokens.tolist() == _offline(p1, cfg, req)
+
+
+# --------------------------------------------------------------------- #
+# the rolling swap (happy path)
+# --------------------------------------------------------------------- #
+
+class TestRollingSwap:
+    def test_zero_loss_token_identity_and_trace_rules(
+            self, model, tmp_path, monkeypatch):
+        """A v1 -> v2 rollout mid-trace: every request retires exactly
+        once, token-identical to offline under ITS OWN admission
+        version; the fleet lands on v2; the serve stream passes both
+        the span-balance and version-coherence checks."""
+        slog = str(tmp_path / "serve.jsonl")
+        monkeypatch.setenv("HETU_SERVE_LOG", slog)
+        p1, p2, cfg = model
+        router, coord = _fleet(model)
+        reqs = _trace(12, seed=7)
+        assert coord.begin(p2, 2)
+        res = router.run(reqs)
+        coord.drain()
+        assert coord.state == "done"
+        assert coord.committed_version == 2
+        assert coord.fleet_versions() == {0: 2, 1: 2}
+        assert len(res) == len(reqs)
+        snap = router.snapshot()
+        assert snap["lost"] == 0 and snap["duplicates"] == 0
+        by_ver = {1: p1, 2: p2}
+        seen = set()
+        for r in reqs:
+            out = res[r.request_id]
+            assert out.weight_version in by_ver, out
+            seen.add(out.weight_version)
+            assert out.tokens.tolist() == \
+                _offline(by_ver[out.weight_version], cfg, r), r.request_id
+        assert 1 in seen   # the trace was live across the swap
+        events, bad = read_events([slog])
+        assert bad == 0
+        assert check_span_balance(events) == []
+        assert check_version_coherence(events) == []
+        kinds = [e["event"] for e in events]
+        for k in ("rollout_start", "swap_quiesce", "swap_drained",
+                  "weight_swap", "swap_probe", "swap_readmit",
+                  "rollout_done"):
+            assert k in kinds, k
+        for e in events:
+            assert telemetry.validate_record(e) == [], e
+        # router snapshot surfaces the sync state
+        ws = snap["weight_sync"]
+        assert ws["committed_version"] == 2
+        assert ws["last"]["state"] == "done"
+
+    def test_stale_version_rejected(self, model, tmp_path, monkeypatch):
+        """Pushing a version <= committed is refused up front: no
+        quiesce, no swap, a contract-valid swap_rejected_stale event."""
+        flg = str(tmp_path / "failure.jsonl")
+        monkeypatch.setenv("HETU_FAILURE_LOG", flg)
+        p1, p2, cfg = model
+        router, coord = _fleet(model)
+        assert not coord.begin(p2, 1)        # same version: stale
+        assert coord.state == "rejected_stale"
+        assert coord.fleet_versions() == {0: 1, 1: 1}
+        assert router._swap_hold == set()
+        events, bad = read_events([flg])
+        assert bad == 0
+        assert any(e["event"] == "swap_rejected_stale" for e in events)
+        for e in events:
+            assert telemetry.validate_record(e) == [], e
+        # and a fresh, HIGHER version still goes through afterwards
+        assert coord.begin(p2, 2)
+        router.run(_trace(4, seed=3))
+        coord.drain()
+        assert coord.state == "done"
+
+    def test_corrupt_version_push_rejected(self, model, tmp_path,
+                                           monkeypatch):
+        """The chaos seam at swap.version_push (drop = a corrupt/torn
+        version read) rejects the rollout before any replica moves."""
+        flg = str(tmp_path / "failure.jsonl")
+        monkeypatch.setenv("HETU_FAILURE_LOG", flg)
+        monkeypatch.setenv("HETU_CHAOS", "seed=1,drop=1.0,role=swap")
+        faults.reset_plans()
+        _, p2, _ = model
+        router, coord = _fleet(model)
+        assert not coord.begin(p2, 2)
+        assert coord.state == "rejected_stale"
+        assert coord.fleet_versions() == {0: 1, 1: 1}
+        events, _ = read_events([flg])
+        assert any(e["event"] == "swap_rejected_stale" for e in events)
+
+
+# --------------------------------------------------------------------- #
+# chaos: mid-swap kills + rollback
+# --------------------------------------------------------------------- #
+#
+# role=swap draw order (ps/faults.py): draw 1 = swap.version_push, then
+# per replica in rollout order: swap.drain, swap.apply.  So kill=2 hits
+# replica 0 mid-drain, kill=3 replica 0 mid-swap (buffers moved, probe
+# pending), kill=4 replica 1 mid-drain AFTER replica 0 swapped — the
+# real-rollback case.
+
+class TestChaosSwap:
+    @pytest.mark.parametrize("spec,label", [
+        ("seed=5,kill=2,role=swap", "mid-drain"),
+        ("seed=5,kill=3,role=swap", "mid-swap"),
+    ])
+    def test_kill_fails_rollout_cleanly(self, model, tmp_path,
+                                        monkeypatch, spec, label):
+        """A seeded kill of the quiesced replica mid-drain/mid-swap:
+        zero request loss (the router requeues the corpse's work the
+        same step), the rollout fails, the fleet converges back to the
+        COMMITTED v1 (the corpse respawns on it), and the flight
+        recorder holds the chaos kill + the swap timeline."""
+        flog = str(tmp_path / "flight.jsonl")
+        slog = str(tmp_path / "serve.jsonl")
+        flg = str(tmp_path / "failure.jsonl")
+        monkeypatch.setenv("HETU_FLIGHT_LOG", flog)
+        monkeypatch.setenv("HETU_SERVE_LOG", slog)
+        monkeypatch.setenv("HETU_FAILURE_LOG", flg)
+        monkeypatch.setenv("HETU_CHAOS", spec)
+        faults.reset_plans()
+        p1, p2, cfg = model
+        router, coord = _fleet(model)
+        reqs = _trace(10, seed=11)
+        assert coord.begin(p2, 2)
+        res = router.run(reqs)
+        coord.drain()
+        assert len(res) == len(reqs), label
+        assert coord.state == "rolled_back", (label, coord.last)
+        assert coord.committed_version == 1
+        assert _wait_respawn(router, coord) == {0: 1, 1: 1}, label
+        snap = router.snapshot()
+        assert snap["lost"] == 0 and snap["duplicates"] == 0
+        # every retired result decoded on v1 (v2 never served traffic)
+        for r in reqs:
+            out = res[r.request_id]
+            assert out.weight_version == 1, (label, out)
+            assert out.tokens.tolist() == _offline(p1, cfg, r)
+        fevents, fbad = read_events([flog])
+        assert fbad == 0
+        reasons = [e["reason"] for e in fevents
+                   if e["event"] == "flight_dump"]
+        assert "swap_chaos_kill" in reasons
+        assert "swap_rollout_failed" in reasons
+        events, bad = read_events([slog, flg])
+        assert bad == 0
+        assert any(e["event"] == "rollout_failed" for e in events)
+        assert check_version_coherence(events) == []
+        assert check_span_balance(events) == []
+        # chaos kills are one-shot: the SAME process retries and lands
+        assert coord.begin(p2, 2)
+        res2 = router.run(_trace(6, seed=111))
+        coord.drain()
+        assert len(res2) == 6 and coord.state == "done"
+        assert coord.fleet_versions() == {0: 2, 1: 2}
+
+    def test_kill_after_first_swap_rolls_survivor_back(
+            self, model, tmp_path, monkeypatch):
+        """kill=4 fires at replica 1's drain AFTER replica 0 already
+        swapped to v2: the failure path must roll the v2 survivor back
+        to v1 (a mixed-version fleet never serves steady-state)."""
+        flg = str(tmp_path / "failure.jsonl")
+        slog = str(tmp_path / "serve.jsonl")
+        monkeypatch.setenv("HETU_SERVE_LOG", slog)
+        monkeypatch.setenv("HETU_FAILURE_LOG", flg)
+        monkeypatch.setenv("HETU_CHAOS", "seed=5,kill=4,role=swap")
+        faults.reset_plans()
+        _, p2, _ = model
+        router, coord = _fleet(model)
+        reqs = _trace(10, seed=13)
+        assert coord.begin(p2, 2)
+        res = router.run(reqs)
+        coord.drain()
+        assert len(res) == len(reqs)
+        assert coord.state == "rolled_back", coord.last
+        assert _wait_respawn(router, coord) == {0: 1, 1: 1}
+        events, bad = read_events([slog, flg])
+        assert bad == 0
+        kinds = [e["event"] for e in events]
+        assert "rollout_rollback" in kinds   # the non-vacuous path
+        assert "rollout_failed" in kinds
+        assert check_version_coherence(events) == []
+
+
+# --------------------------------------------------------------------- #
+# PS handoff + observability
+# --------------------------------------------------------------------- #
+
+class TestPSVersionedPull:
+    def test_begin_from_ps_rolls_the_stamped_version(self, model):
+        """Weights pushed to a sharded PS + set_weights_version feed a
+        rollout via the torn-read-guarded pull_versioned snapshot."""
+        p1, p2, cfg = model
+        ps = ShardedPSClient(servers=[PSServer(), PSServer()])
+        for k, v in p2.items():
+            ps.param_set(k, np.asarray(v, np.float32))
+        ps.set_weights_version(2)
+        assert ps.weights_version() == 2
+        router, coord = _fleet(model)
+        assert coord.begin_from_ps(ps, sorted(p2))
+        res = router.run(_trace(6, seed=17))
+        coord.drain()
+        assert len(res) == 6 and coord.state == "done"
+        assert coord.fleet_versions() == {0: 2, 1: 2}
+        # the pulled pytree really is v2: post-swap decode matches it
+        req = Request(prompt=[9, 10], max_new_tokens=5)
+        out = next(iter(router.run([req]).values()))
+        assert out.weight_version == 2
+        assert out.tokens.tolist() == _offline(p2, cfg, req)
+
+    def test_unstamped_ps_refused(self, model):
+        """A PS that was never version-stamped cannot feed a rollout —
+        there is no commit point to roll back to."""
+        _, p2, _ = model
+        ps = ShardedPSClient(servers=[PSServer()])
+        for k, v in p2.items():
+            ps.param_set(k, np.asarray(v, np.float32))
+        router, coord = _fleet(model)
+        with pytest.raises(ValueError, match="version"):
+            coord.begin_from_ps(ps, sorted(p2))
+
+
+class TestTopAndTrace:
+    def test_fleet_top_version_column_and_rollout_footer(
+            self, model, tmp_path, monkeypatch, capsys):
+        """hetu_top --fleet shows each replica's weight version and the
+        rollout progress footer; the single-engine view shows the
+        version + last-swap time."""
+        slog = str(tmp_path / "serve.jsonl")
+        monkeypatch.setenv("HETU_SERVE_LOG", slog)
+        _, p2, _ = model
+        router, coord = _fleet(model)
+        assert coord.begin(p2, 2)
+        router.run(_trace(8, seed=19))
+        coord.drain()
+        events, _ = read_events([slog])
+        stats = top.summarize_fleet(events)
+        rows = {r["replica"]: r for r in stats["replicas"]}
+        assert rows[0]["version"] == 2 and rows[1]["version"] == 2
+        ro = stats["rollout"]
+        assert ro["version"] == 2 and ro["state"] == "done"
+        assert ro["done"] == ro["replicas"] == 2
+        frame = top.render_fleet(stats)
+        assert "ver" in frame and "v2" in frame
+        assert "rollout" in frame
+        rc = top.main([slog, "--fleet", "--once"])
+        assert rc == 0
+        assert "v2" in capsys.readouterr().out
+        # single-engine view: version + last_swap ride the summary
+        one = top.summarize(
+            [e for e in events if e.get("replica") == 0])
+        assert one["weight_version"] == 2
+        assert one["last_swap_t"] is not None
+        assert "version v2" in top.render(one)
+
+    def test_trace_check_flags_mixed_version_request(self):
+        """The version-coherence rule: one rid carrying records from
+        two weight versions with no router requeue is a violation; a
+        requeued (router_hop) rid is exempt."""
+        base = {"t": 0.0, "kind": "serve"}
+        bad = [dict(base, event="serve_admit", request="r1",
+                    weight_version=1),
+               dict(base, event="serve_finish", request="r1",
+                    weight_version=2)]
+        probs = check_version_coherence(bad)
+        assert len(probs) == 1 and "r1" in probs[0]
+        hopped = bad + [dict(base, event="router_hop", request="r1",
+                             to_replica=1)]
+        assert check_version_coherence(hopped) == []
