@@ -463,6 +463,149 @@ python bin/hetu_trace.py "$LOG/swap_flight.jsonl" --check \
   exit 1
 }
 
+# 00h. elastic-fleet gate (ISSUE 16): one CPU process runs the three
+#      autoscale chaos phases back to back.  Phase A: a burn-driven
+#      scale-up whose bring-up is chaos-killed (role=autoscale takes
+#      out the BUSIEST PEER mid-warm) — zero request loss, and every
+#      finished request token-identical to an offline decode of the
+#      same specs.  Phase B: a diurnal trough walks the fleet down,
+#      then a flash crowd lands on the shrunken fleet — it must grow
+#      back, still zero loss.  Phase C: a drain whose SUBJECT is
+#      chaos-killed mid-drain (fresh one-shot plan) — the requeue reads
+#      the router's records, never the corpse.  The combined stream
+#      must pass the hetu_trace scale-balance rule (every scale_up
+#      paired with replica_ready, every scale_down with
+#      replica_retired, drained rids retiring exactly once on a peer).
+run autoscale_gate 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/autoscale_trace.jsonl" \
+    HETU_FAILURE_LOG="$LOG/autoscale_failure.jsonl" \
+    HETU_FLIGHT_LOG="$LOG/autoscale_flight.jsonl" \
+    HETU_CHAOS="seed=11,kill=1,role=autoscale" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import os
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.ps import faults
+from hetu_tpu.serving import (SLO, FleetAutoscaler, Request,
+                              ServingEngine, ServingRouter,
+                              TrafficGenerator, replay)
+
+def mk_params(seed=0):
+    rng, hd = np.random.RandomState(seed), 16
+    p = {"el_wte_table": rng.randn(61, hd) * 0.05,
+         "el_wpe": rng.randn(32, hd) * 0.05,
+         "el_ln_f_scale": np.ones(hd), "el_ln_f_bias": np.zeros(hd)}
+    for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+                   ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+                   ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+        p[f"el_h0_{w}_weight"] = rng.randn(*shp) * 0.05
+        p[f"el_h0_{w}_bias"] = np.zeros(shp[1])
+    for ln in ("ln1", "ln2"):
+        p[f"el_h0_{ln}_scale"] = np.ones(hd)
+        p[f"el_h0_{ln}_bias"] = np.zeros(hd)
+    return p
+
+p = mk_params()
+cfg = GPTConfig(vocab_size=61, hidden_size=16, num_hidden_layers=1,
+                num_attention_heads=2, max_position_embeddings=32,
+                batch_size=1, seq_len=32, dropout_rate=0.0)
+
+def mk_router(replicas, slo_ms=None):
+    def factory(i):
+        slo = [SLO("ttft", "latency", slo_ms)] if slo_ms else None
+        return ServingEngine(p, cfg, slots=4, queue_limit=8,
+                             max_seq_len=32, paged=True, kv_block=4,
+                             prefix_share=True, slo=slo)
+    return ServingRouter(factory, replicas=replicas, directory=True,
+                         shed_on_slo=False, restart_backoff=0.01)
+
+# ---- phase A: chaos-killed scale-up, burn-driven --------------------
+r = mk_router(2, slo_ms=0.001)   # any traffic burns the tight budget
+auto = FleetAutoscaler(r, fleet_min=1, fleet_max=3, up_ticks=2,
+                       down_ticks=10**6, cooldown=3)
+specs = TrafficGenerator(seed=7, vocab=61, s_max=32, horizon_s=2.0,
+                         base_rps=2.0, peak_rps=40.0, cycle_s=2.0,
+                         n_sessions=4, prefix_len=8).trace(dt=0.05)
+res, rep = replay(r, specs, step_s=0.01, tail_s=1.0)
+snap = r.snapshot()
+assert auto.scale_ups >= 1, auto.snapshot()
+assert snap["lost"] == 0, snap
+assert len(res) + len(rep["shed"]) + len(rep["rejected"]) == len(specs)
+assert any(row["restarts"] >= 1 for row in snap["replicas"]), \
+    "the scale-up chaos kill never fired"
+eng = ServingEngine(p, cfg, slots=4, queue_limit=len(specs) + 1,
+                    max_seq_len=32)
+off = eng.run([sp.to_request() for sp in specs if sp.request_id in res])
+for rid, x in res.items():
+    assert list(x.tokens) == list(off[rid].tokens), rid
+a_ups, a_fin = auto.scale_ups, snap["finished"]
+
+# ---- phase B: flash crowd lands on the scaled-down fleet ------------
+os.environ.pop("HETU_CHAOS", None)
+faults.reset_plans()
+r = mk_router(1)
+auto = FleetAutoscaler(r, fleet_min=1, fleet_max=2, up_pressure=0.2,
+                       up_ticks=2, down_pressure=0.1, down_ticks=25,
+                       cooldown=10)
+specs = TrafficGenerator(seed=21, vocab=61, s_max=32, horizon_s=4.0,
+                         base_rps=1.0, peak_rps=80.0, cycle_s=2.0,
+                         n_sessions=8, prefix_len=8,
+                         flash=((1.9, 0.4, 25.0),)).trace(dt=0.05)
+res, rep = replay(r, specs, step_s=0.01, tail_s=3.0)
+snap = r.snapshot()
+assert snap["lost"] == 0, snap
+assert auto.scale_ups >= 2 and auto.scale_downs >= 1, auto.snapshot()
+acts = [e["action"] for e in auto.timeline]
+assert "scale_up" in acts[acts.index("scale_down"):], \
+    f"no regrowth after the scale-down: {acts}"
+assert len(res) + len(rep["shed"]) + len(rep["rejected"]) == len(specs)
+b_ups, b_downs = auto.scale_ups, auto.scale_downs
+
+# ---- phase C: drain whose subject is chaos-killed mid-drain ---------
+os.environ["HETU_CHAOS"] = "seed=12,kill=1,role=autoscale"
+faults.reset_plans()
+r = mk_router(2)
+reqs = [Request(prompt=[2 + i, 5, 9], max_new_tokens=6,
+                request_id=f"c{i}") for i in range(8)]
+for q in reqs:
+    r.submit(q)
+out = {}
+for _ in range(3):
+    for x in r.step():
+        out[x.request_id] = x
+r.retire_replica(1, reason="scale_down")
+assert "chaos autoscale kill" in (r.replicas[1].exit_error or ""), \
+    "the drain chaos kill never fired"
+for _ in range(4000):
+    if not r.pending:
+        break
+    for x in r.step():
+        out[x.request_id] = x
+assert r.snapshot()["lost"] == 0
+assert set(out) == {q.request_id for q in reqs}
+print("autoscale gate OK: chaos scale-up (ups", a_ups, "finished",
+      a_fin, ") flash regrowth (ups", b_ups, "downs", b_downs,
+      ") chaos drain retired 8/8, zero loss everywhere")
+PYEOF
+if ! grep -q 'autoscale gate OK' "$LOG/autoscale_gate.log"; then
+  echo "elastic-fleet gate FAILED — see $LOG/autoscale_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/autoscale_trace.jsonl" \
+    "$LOG/autoscale_failure.jsonl" --check \
+    > "$LOG/autoscale_contract.log" || {
+  echo "autoscale scale-balance/span check FAILED — see" \
+       "$LOG/autoscale_contract.log" >&2
+  exit 1
+}
+python bin/hetu_trace.py "$LOG/autoscale_flight.jsonl" --check \
+    > "$LOG/autoscale_flight_contract.log" || {
+  echo "autoscale flight-dump contract check FAILED — see" \
+       "$LOG/autoscale_flight_contract.log" >&2
+  exit 1
+}
+
 # 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
 #     must pass BEFORE any chip time is spent).  Speculative-decoding
 #     trace-replay gate: the draft-propose / batched-verify path must

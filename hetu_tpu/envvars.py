@@ -343,6 +343,49 @@ _reg("HETU_SWAP_ROLLBACK", "bool", True,
      "respawn on the committed version either way.", "swap")
 
 # --------------------------------------------------------------------- #
+# elastic fleet (serving/autoscaler.py — SLO-burn-driven autoscaling)
+# --------------------------------------------------------------------- #
+_reg("HETU_FLEET_MIN", "int", 1,
+     "Fewest replicas the autoscaler may run: scale-down never drops "
+     "the fleet below this floor (and never retires the last UP "
+     "replica regardless).", "fleet")
+_reg("HETU_FLEET_MAX", "int", 4,
+     "Most replicas the autoscaler may run: scale-up stops at this "
+     "ceiling (the equal-peak-capacity bound the autoscale_ab bench "
+     "sizes its static arm to).", "fleet")
+_reg("HETU_AUTOSCALE_UP_BURN", "float", 1.0,
+     "Worst-replica SLO burn rate at or above which a tick counts as "
+     "hot (burn >= 1 = an error budget spending faster than it "
+     "refills); HETU_AUTOSCALE_UP_TICKS consecutive hot ticks trigger "
+     "a scale-up.", "fleet")
+_reg("HETU_AUTOSCALE_UP_PRESSURE", "float", 0.75,
+     "Aggregate queue-fill fraction at or above which a tick counts "
+     "as hot even without an SLO burn signal — queue pressure leads "
+     "latency, so the fleet grows before the breach.", "fleet")
+_reg("HETU_AUTOSCALE_UP_TICKS", "int", 3,
+     "Consecutive hot ticks (one tick per router step) required to "
+     "scale up — the hysteresis that keeps a one-step spike from "
+     "spawning a replica.", "fleet")
+_reg("HETU_AUTOSCALE_DOWN_PRESSURE", "float", 0.15,
+     "Aggregate queue-fill fraction at or below which a tick counts "
+     "as idle (with burn < 1 and nothing router-held); "
+     "HETU_AUTOSCALE_DOWN_TICKS consecutive idle ticks trigger a "
+     "scale-down.", "fleet")
+_reg("HETU_AUTOSCALE_DOWN_TICKS", "int", 50,
+     "Consecutive idle ticks required to scale down — deliberately "
+     "much slower than scale-up (growing late sheds traffic; "
+     "shrinking late only burns replica-seconds).", "fleet")
+_reg("HETU_AUTOSCALE_COOLDOWN", "int", 20,
+     "Refractory ticks after ANY scale action during which the "
+     "autoscaler only observes — a bursty signal cannot flap the "
+     "fleet.", "fleet")
+_reg("HETU_AUTOSCALE_WARM_PREFIXES", "int", 4,
+     "Hottest directory-known prefixes moved per membership change: "
+     "imported into a joining replica before it takes traffic "
+     "(scale-up warming) and exported from a retiring replica to its "
+     "best peer (scale-down).  0 disables prefix movement.", "fleet")
+
+# --------------------------------------------------------------------- #
 # quantization (hetu_tpu/quant.py — one layer, three seams)
 # --------------------------------------------------------------------- #
 _reg("HETU_PS_QUANT", "str", None,

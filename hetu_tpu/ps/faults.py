@@ -46,7 +46,15 @@ Spec grammar (comma-separated ``k=v``)::
                       ``swap.drain`` (kill mid-drain) and
                       ``swap.apply`` (kill after the buffers moved,
                       before the probe) — ``kill=<n>`` picks the seam
-                      by draw position
+                      by draw position.
+                      ``role=autoscale`` scopes a plan to the elastic-
+                      fleet seams (serving/router.py): the router draws
+                      at ``autoscale.scale_up`` (kill the busiest PEER
+                      while a new replica is mid-bring-up) and
+                      ``autoscale.drain`` (kill the RETIRING replica
+                      itself mid-drain) — both must lose zero requests;
+                      ``kill=<n>`` picks the seam by draw position, as
+                      with role=swap
 
 Determinism: decision ``i`` is a pure function of ``(seed, i)`` (a
 blake2 hash, not an RNG object), so a spec replays the identical fault

@@ -55,6 +55,20 @@ compiled arithmetic — with the offline path.
                   event/Result carries weight_version), chaos-gated
                   (HETU_CHAOS role=swap), auto-rollback to the last
                   committed version on any mid-swap failure
+    autoscaler.py FleetAutoscaler: SLO-burn-driven elasticity — one
+                  control tick per router step watching worst-replica
+                  burn rate + queue pressure, scaling the fleet live
+                  between HETU_FLEET_MIN/MAX with hysteresis and
+                  cooldown via router.add_replica (committed-version
+                  admission, prefix warming, half-open bring-up probe)
+                  / router.retire_replica (quiesce, prefix export,
+                  zero-loss drain onto peers); never shrinks
+                  mid-rollout; chaos-gated (HETU_CHAOS role=autoscale);
+                  disabled == byte-identical to the static fleet
+    traffic.py    TrafficGenerator: seeded diurnal/zipf/flash traffic
+                  shapes rendered to replayable TrafficSpec traces
+                  (chat / long-context / CTR-shaped classes), plus
+                  replay() — virtual-clock playback into a router
     request.py    Request / Result dataclasses
     metrics.py    ServingMetrics: TTFT/TPOT percentiles, tok/s,
                   occupancy; JSONL events (per-step prefill_ms/
@@ -97,6 +111,7 @@ Quickstart (greedy results are token-identical to ``generate_fast``):
 """
 
 from ..telemetry.slo import SLO, SLOMonitor
+from .autoscaler import FleetAutoscaler
 from .request import EmbedRequest, EmbedResult, Request, RequestCore, Result
 from .kv_manager import (
     KVCacheManager, PagedKVManager, resolve_handoff_quant,
@@ -110,11 +125,13 @@ from .embed_engine import EmbedServingEngine
 from .prefix_directory import PrefixDirectory, prefix_hash
 from .replica import Replica
 from .router import RouterShed, ServingRouter
+from .traffic import TrafficGenerator, TrafficSpec, replay
 from .weight_sync import WeightSyncCoordinator
 
 __all__ = [
     "ServingEngine", "EmbedServingEngine", "ServingRouter", "Replica",
-    "WeightSyncCoordinator",
+    "WeightSyncCoordinator", "FleetAutoscaler",
+    "TrafficGenerator", "TrafficSpec", "replay",
     "QueueFull", "RouterShed", "Request", "RequestCore", "Result",
     "EmbedRequest", "EmbedResult",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",

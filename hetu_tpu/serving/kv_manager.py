@@ -738,6 +738,27 @@ class PagedKVManager:
         length = int(self.lengths[slot])
         n = self.blocks_needed(length)
         idx = np.asarray([int(b) for b in self.tables[slot, :n]], np.int32)
+        return self._export_span(idx, length, quant_mode)
+
+    def export_prefix(self, tokens, quant_mode=None):
+        """Serialize a REGISTERED prefix's blocks to the same wire
+        payload as :meth:`export_blocks` — no live slot required (the
+        prefix cache holds its own refcounts), which is how a fleet
+        moves warmth without a resident request: elastic scale-up
+        warming and retirement export (serving/router.py) both ride
+        this.  Returns None when the prefix is not registered here (or
+        sharing is off).  A pure read."""
+        if not self.prefix_share:
+            return None
+        e = self._prefix.get(tuple(int(t) for t in tokens))
+        if e is None:
+            return None
+        idx = np.asarray([int(b) for b in e.blocks], np.int32)
+        return self._export_span(idx, int(e.length), quant_mode)
+
+    def _export_span(self, idx, length, quant_mode):
+        """Gather pool blocks ``idx`` into the wire payload (shared by
+        the slot and prefix export paths)."""
         mode = resolve_handoff_quant(quant_mode)
 
         def gather(cache):

@@ -127,6 +127,14 @@ class PrefixDirectory:
             del self._entries[h]
         self.evictions += 1
 
+    def known(self, tokens):
+        """True when ANY replica currently claims this exact prefix.
+        The elastic-fleet warm/export paths use it to move only
+        prefixes the directory can actually route — a prefix no entry
+        names attracts no directed traffic, so its blocks are not
+        worth the wire bytes."""
+        return prefix_hash(tokens) in self._entries
+
     def drop_replica(self, replica):
         """Purge every entry naming ``replica`` (death/respawn)."""
         dead = []

@@ -46,6 +46,8 @@ WEDGED = "wedged"      # alive, not progressing, not heartbeating
 BACKOFF = "backoff"    # dead, respawn scheduled
 DEAD = "dead"          # dead; drain pending or budget spent (terminal
 #                        once next_at is +inf)
+RETIRED = "retired"    # orderly scale-down exit: terminal by intent,
+#                        never respawned (elastic fleet, ISSUE 16)
 
 
 class Replica:
@@ -90,6 +92,10 @@ class Replica:
         self.last_beat = None    # heartbeat stamp (perf_counter clock)
         self.steps = 0           # lifetime step count (all incarnations)
         self.drained = True      # router has recovered our requests
+        # elastic-fleet lifecycle phase (warming/serving/draining/
+        # retired) — the router's add/retire paths drive it; a
+        # statically constructed replica is simply serving
+        self.lifecycle = "serving"
         self._start()
         self.emit("replica_start", replica=self.index)
 
@@ -126,6 +132,20 @@ class Replica:
             fields["error"] = str(error)[:200]
         self.emit("replica_exit", replica=self.index, **fields)
 
+    def retire(self):
+        """Orderly scale-down exit: drop the incarnation and CLOSE the
+        supervisor slot for good (no respawn — retirement is intent,
+        not failure, so the restart budget is not consulted).  The
+        router owns the drain: by the time this fires, every request
+        the incarnation held has been requeued onto peers and its hot
+        prefixes exported, so the engine is dropped with nothing left
+        to lose."""
+        self.engine = None
+        self.state = RETIRED
+        self.lifecycle = "retired"
+        self.next_at = float("inf")
+        self.drained = True
+
     def schedule_restart(self, now=None):
         """Enter the backoff window, or go terminal when the budget is
         spent (``replica_failed`` + a flight dump: a replica the fleet
@@ -160,8 +180,10 @@ class Replica:
 
     @property
     def terminal(self):
-        """Budget spent: this replica is never coming back."""
-        return self.state == DEAD and self.next_at == float("inf")
+        """Never coming back: restart budget spent, or retired by an
+        orderly scale-down."""
+        return self.state in (DEAD, RETIRED) \
+            and self.next_at == float("inf")
 
     @property
     def alive(self):
@@ -259,6 +281,7 @@ class Replica:
         return {
             "replica": self.index,
             "state": self.state,
+            "lifecycle": self.lifecycle,
             "role": self.kind,
             "health": self.health(),
             "restarts": self.restarts,

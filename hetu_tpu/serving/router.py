@@ -89,6 +89,26 @@ behind this router, which owns everything a fleet adds to the problem:
   queue is at capacity, ``submit`` raises plain QueueFull: the
   replicas' backpressure propagates up through the router unchanged.
 
+- **Dynamic fleet membership (elastic fleet).**  ``add_replica()``
+  grows the fleet live: the new replica spawns under the same
+  supervised respawn budget, admits on the COMMITTED weight version
+  (the weight-sync coordinator adopts it), prefix-warms from its peers
+  through the directory-led ``export_prefix``/``import_blocks``
+  handoff — only prefixes the directory can actually route — and must
+  pass a half-open greedy probe decode (the breaker's readmission
+  model, via the same ``_swap_hold`` quiesce set) before taking
+  traffic.  ``retire_replica()`` shrinks it: the victim quiesces,
+  exports its hottest prefixes to the best peer (int8-capable codec),
+  then every request it held requeues onto peers via the death-drain
+  path — ZERO loss, no breaker penalty (retirement is intent, not
+  failure) — and its directory entries drop.  A
+  :class:`~hetu_tpu.serving.autoscaler.FleetAutoscaler` attached as
+  ``router.autoscaler`` gets one tick per ``step()`` and drives both
+  ends from SLO burn + queue pressure; chaos seams (``HETU_CHAOS``
+  ``role=autoscale``) kill the busiest peer mid-bring-up
+  (``autoscale.scale_up``) or the retiring replica mid-drain
+  (``autoscale.drain``).
+
 Single-threaded by design: ``step()`` advances supervision, placement,
 and every live replica exactly once, which makes chaos runs
 seed-deterministic (the integration tests replay a kill and assert
@@ -109,7 +129,10 @@ from ..ps import faults
 from ..telemetry import flight
 from .engine import QueueFull, _STORM_REJECTS
 from .prefix_directory import PrefixDirectory
-from .replica import BACKOFF, DEAD, UP, WEDGED, Replica  # noqa: F401
+from .replica import (  # noqa: F401
+    BACKOFF, DEAD, RETIRED, UP, WEDGED, Replica,
+)
+from .request import Request
 
 # health-state weights for the routing score (breach still gets a
 # trickle: it may be the only replica, and starving it entirely would
@@ -242,6 +265,11 @@ class ServingRouter:
         self._roles_active = ("prefill" in self.roles
                               and "decode" in self.roles)
         self.handoff_quant = handoff_quant
+        # dynamic membership (add_replica) builds later replicas from
+        # the same factory/budget the constructor fleet got
+        self._factory = factory
+        self._restart_limit = restart_limit
+        self._restart_backoff = restart_backoff
         self.replicas = [
             Replica(i, factory, restart_limit=restart_limit,
                     restart_backoff=restart_backoff,
@@ -261,6 +289,10 @@ class ServingRouter:
         # gets a tick per step to advance its rollout
         self._swap_hold = set()
         self.weight_sync = None
+        # elastic fleet: a FleetAutoscaler attaches itself here and
+        # gets one tick per step; None = today's static behavior
+        self.autoscaler = None
+        self._scale_seq = 0       # unique bring-up probe request ids
         self._reject_streak = [0] * n
         self._session_last = {}                # session_id -> replica
         # counters (snapshot surface)
@@ -739,6 +771,12 @@ class ServingRouter:
             # chaos kill the coordinator fires here requeues the
             # victim's requests within this same iteration (zero loss)
             self.weight_sync.tick(now)
+        if self.autoscaler is not None:
+            # the elasticity control loop rides the same single-threaded
+            # step as the rollout: a scale-up's chaos kill or a retire's
+            # requeue lands BEFORE this iteration's death drain + flush,
+            # so displaced requests re-place with zero extra latency
+            self.autoscaler.tick(now)
         for r in self.replicas:
             if r.state == DEAD and not r.drained:
                 self._on_death(r, now)
@@ -779,6 +817,307 @@ class ServingRouter:
             for res in self.step():
                 out[res.request_id] = res
         return out
+
+    # ------------------------------------------------------------- #
+    # elastic fleet membership (live add / retire)
+    # ------------------------------------------------------------- #
+
+    def add_replica(self, kind="mixed", *, warm_prefixes=None,
+                    probe=True):
+        """Grow the fleet live: spawn a fresh supervised replica under
+        the same factory/respawn budget the constructor fleet got, at
+        the next index (indexes are never reused — a retired slot's
+        index stays burned, so the event stream pairs uniquely).
+
+        Bring-up is gated before the replica takes any traffic:
+
+        1. **committed-version admission** — the weight-sync coordinator
+           (when wired) adopts it: factory wrapped so every incarnation
+           respawns on the committed version, live engine stamped NOW,
+           and an in-flight rollout extends its order to cover it;
+        2. **prefix warming** — peers' hottest directory-known prefixes
+           land via the export/import handoff codec while the replica
+           is quiesced (``_swap_hold``), so its first requests hit warm
+           blocks instead of cold prefill;
+        3. **half-open probe** — one greedy decode must retire on the
+           quiesced engine (the breaker's readmission model); a failed
+           probe kills the incarnation and hands it to the supervisor
+           instead of admitting a replica that cannot serve.
+
+        The ``autoscale.scale_up`` chaos seam (role ``autoscale``)
+        draws here: a drawn kill takes out the BUSIEST PEER mid-
+        bring-up — the hard case, because the joining replica must
+        absorb the victim's requeued load the moment it is ready.
+        Returns the new replica's index."""
+        if kind not in _ROLES:
+            raise ValueError(f"unknown replica kind {kind!r}")
+        idx = len(self.replicas)
+        self.roles.append(kind)
+        self._assigned[idx] = {}
+        self._breaker.append({"state": "closed", "failures": 0,
+                              "open_until": 0.0, "probe": None,
+                              "opens": 0})
+        self._reject_streak.append(0)
+        self._placed.append(0)
+        self._rejects.append(0)
+        rep = Replica(idx, self._factory,
+                      restart_limit=self._restart_limit,
+                      restart_backoff=self._restart_backoff,
+                      emit_fn=self._fail_event, kind=kind,
+                      on_start=self._wire_replica)
+        self.replicas.append(rep)
+        self._roles_active = ("prefill" in self.roles
+                              and "decode" in self.roles)
+        if self.weight_sync is not None:
+            self.weight_sync.adopt(rep)
+        rep.lifecycle = "warming"
+        self._swap_hold.add(idx)
+        self._fail_event("replica_warming", replica=idx, role=kind)
+        self._chaos_scale_kill(exclude=idx)
+        warmed = self._warm_replica(rep, warm_prefixes)
+        ok = self._probe_replica(rep) if probe else True
+        self._swap_hold.discard(idx)
+        if ok:
+            rep.lifecycle = "serving"
+            self._fail_event("replica_ready", replica=idx,
+                             warmed_prefixes=warmed)
+        else:
+            # bring-up probe failed: never admit — treat it as a death
+            # and let the supervisor own the respawn (which re-wires
+            # and re-stamps the committed weights via the adopted
+            # factory), leaving the scale_up unpaired in the stream:
+            # exactly the incident the trace checker flags
+            rep.die(rc=1, error="bring-up probe failed")
+        return idx
+
+    def retire_replica(self, idx, reason="manual"):
+        """Shrink the fleet live, with zero request loss: quiesce the
+        victim (``_swap_hold`` — no new placements), export its hottest
+        directory-known prefixes to the best UP peer (its warmth must
+        not die with it), requeue every request it still held through
+        the death-drain records — WITHOUT a breaker penalty or a
+        respawn: retirement is intent, not failure — then drop its
+        directory entries and close the supervisor slot for good.
+
+        The ``autoscale.drain`` chaos seam draws here: a drawn kill
+        takes out the DRAINING replica itself mid-drain.  Zero loss
+        must hold anyway — the requeue below reads the router's own
+        assignment records, never the corpse (prefix export is skipped:
+        the pool died with the engine; honest degradation).
+
+        Returns the number of requeued requests."""
+        rep = self.replicas[idx]
+        if rep.state == RETIRED:
+            return 0
+        peers = [r for r in self.replicas
+                 if r.index != idx and r.state == UP]
+        if not peers:
+            raise ValueError(
+                f"cannot retire replica {idx}: no UP peer to absorb "
+                f"its traffic")
+        rep.lifecycle = "draining"
+        self._swap_hold.add(idx)
+        self._fail_event("replica_draining", replica=idx, reason=reason)
+        killed = self._chaos_drain_kill(rep)
+        exported = 0 if killed else self._export_hot_prefixes(rep)
+        assigned = self._assigned[idx]
+        rids = [rid for rid in assigned if not self._routed[rid].done]
+        self._assigned[idx] = {}
+        for rid in rids:
+            routed = self._routed[rid]
+            routed.hops += 1
+            routed.prev_replica = idx
+            routed.replica = None
+            routed.next_at = 0.0
+            self.requeued += 1
+            telemetry.inc("router.requeues")
+            self._pending.append(routed)
+        if self.directory is not None:
+            self.directory.drop_replica(idx)
+        rep.retire()
+        self._swap_hold.discard(idx)
+        self._fail_event("replica_retired", replica=idx,
+                         requeued=len(rids), exported_prefixes=exported,
+                         reason=reason, rids=list(rids))
+        return len(rids)
+
+    def _chaos_scale_kill(self, *, exclude):
+        """``autoscale.scale_up`` seam: kill the busiest UP peer while
+        the new replica (``exclude``) is mid-bring-up."""
+        plan = faults.plan_from_env()
+        if plan is None:
+            return False
+        f = plan.draw(method="autoscale.scale_up", kinds=("kill",),
+                      role="autoscale", inline=True)
+        if f is None or f.kind != "kill":
+            return False
+        peers = [r for r in self.replicas
+                 if r.state == UP and r.index != exclude]
+        if not peers:
+            return False
+        victim = max(peers,
+                     key=lambda r: (r.queue_depth + r.live, -r.index))
+        flight.RECORDER.dump("autoscale_chaos_kill",
+                             replica=victim.index,
+                             seam="autoscale.scale_up")
+        victim.die(rc=-9, error="chaos autoscale kill (scale_up)")
+        return True
+
+    def _chaos_drain_kill(self, rep):
+        """``autoscale.drain`` seam: kill the draining replica itself
+        mid-drain (a retire that loses its subject half-way)."""
+        plan = faults.plan_from_env()
+        if plan is None or rep.state != UP:
+            return False
+        f = plan.draw(method="autoscale.drain", kinds=("kill",),
+                      role="autoscale", inline=True)
+        if f is None or f.kind != "kill":
+            return False
+        flight.RECORDER.dump("autoscale_chaos_kill", replica=rep.index,
+                             seam="autoscale.drain")
+        rep.die(rc=-9, error="chaos autoscale kill (drain)")
+        return True
+
+    def _ship_prefix(self, src, dst, toks, rid):
+        """Move one registered prefix ``src`` replica -> ``dst``
+        replica through the export/import handoff codec (int8 wire
+        when ``HETU_HANDOFF_QUANT`` says so); True when the blocks
+        landed.  Emits the paired ``kv_handoff_out``/``kv_handoff_in``
+        records under a synthetic warm/retire rid — no request finish
+        ever pairs with them, which the trace checker's handoff rule
+        already tolerates (0-finish rids are exempt)."""
+        try:
+            payload = src.engine.kv.export_prefix(
+                toks, self.handoff_quant)
+        except ValueError:
+            payload = None
+        if payload is None:
+            return False
+        kv = dst.engine.kv
+        try:
+            slot = kv.import_blocks(payload, rid, prompt=list(toks))
+        except ValueError:
+            slot = None
+        if slot is None:
+            return False
+        # the slot was only a write vehicle: the re-registered prefix
+        # keeps the blocks alive (refcounted)
+        kv.release(slot)
+        self.handoffs += 1
+        nbytes = int(payload["nbytes"])
+        self.handoff_bytes += nbytes
+        blocks = -(-int(payload["length"]) // int(payload["block"]))
+        self._event("kv_handoff_out", request=rid, replica=src.index,
+                    to_replica=dst.index, bytes=nbytes, blocks=blocks,
+                    quant=payload["quant"] or "off")
+        self._event("kv_handoff_in", request=rid, replica=dst.index,
+                    from_replica=src.index, bytes=nbytes)
+        return True
+
+    def _warm_prefix_ok(self, rep):
+        """Can this replica's engine take part in a prefix move?"""
+        eng = rep.engine
+        kv = getattr(eng, "kv", None) if eng is not None else None
+        return kv is not None and getattr(kv, "prefix_share", False)
+
+    def _warm_replica(self, rep, budget=None):
+        """Prefix-warm a joining replica BEFORE it takes traffic:
+        import its peers' hottest DIRECTORY-KNOWN prefixes (a prefix no
+        directory entry names attracts no routed traffic — not worth
+        the wire bytes).  Returns how many prefixes landed."""
+        if budget is None:
+            budget = envvars.get_int("HETU_AUTOSCALE_WARM_PREFIXES")
+        if budget <= 0 or not self._warm_prefix_ok(rep):
+            return 0
+        block = rep.engine.kv.block
+        cands = []
+        for peer in self.replicas:
+            if peer.index == rep.index or peer.state != UP \
+                    or not self._warm_prefix_ok(peer) \
+                    or peer.engine.kv.block != block:
+                continue
+            for toks, e in peer.engine.kv._prefix.items():
+                if self.directory is not None \
+                        and not self.directory.known(toks):
+                    continue
+                cands.append((-e.used, peer.index, toks))
+        cands.sort()
+        warmed = 0
+        seen = set()
+        for _hot, pidx, toks in cands:
+            if warmed >= budget:
+                break
+            if toks in seen:
+                continue
+            seen.add(toks)
+            peer = self.replicas[pidx]
+            if peer.state != UP:
+                continue
+            rid = f"warm-r{rep.index}-{warmed}"
+            if self._ship_prefix(peer, rep, toks, rid):
+                warmed += 1
+        return warmed
+
+    def _export_hot_prefixes(self, rep, budget=None):
+        """A retiring replica's warmth must not die with it: export its
+        hottest directory-known prefixes to the best-scoring UP peer
+        through the same codec warming uses.  Runs BEFORE the directory
+        drop, so the peer registers as a holder while the entries that
+        made these prefixes routable still exist."""
+        if budget is None:
+            budget = envvars.get_int("HETU_AUTOSCALE_WARM_PREFIXES")
+        if budget <= 0 or not self._warm_prefix_ok(rep):
+            return 0
+        kv = rep.engine.kv
+        peers = [r for r in self.replicas
+                 if r.index != rep.index and r.state == UP
+                 and self._warm_prefix_ok(r)
+                 and r.engine.kv.block == kv.block]
+        if not peers:
+            return 0
+        hot = sorted(kv._prefix.items(), key=lambda kvp: -kvp[1].used)
+        exported = 0
+        for toks, _e in hot:
+            if exported >= budget:
+                break
+            if self.directory is not None \
+                    and not self.directory.known(toks):
+                continue
+            peer = max(peers,
+                       key=lambda r: (self._score(r), -r.index))
+            if toks in peer.engine.kv._prefix:
+                continue   # the best peer already holds it
+            rid = f"retire-r{rep.index}-{exported}"
+            if self._ship_prefix(rep, peer, toks, rid):
+                exported += 1
+        return exported
+
+    def _probe_replica(self, rep):
+        """Half-open bring-up probe: one greedy decode must retire on
+        the quiesced engine — on the committed weight version, when a
+        coordinator is wired — before the replica takes fleet traffic.
+        Embedding engines (no decode loop) admit on the version stamp
+        alone."""
+        eng = rep.engine
+        if eng is None:
+            return False
+        if hasattr(eng, "tables"):
+            return True
+        self._scale_seq += 1
+        rid = f"scale-probe-r{rep.index}-{self._scale_seq}"
+        req = Request(prompt=[1, 2, 3], max_new_tokens=1,
+                      temperature=0.0, request_id=rid, seed=0)
+        try:
+            res = eng.run([req]).get(rid)
+        except Exception:  # noqa: BLE001 — a probe crash IS a failure
+            res = None
+        if res is None or res.n_generated < 1:
+            return False
+        if self.weight_sync is not None \
+                and res.weight_version != self.weight_sync.committed_version:
+            return False
+        rep.last_beat = time.perf_counter()
+        return True
 
     # ------------------------------------------------------------- #
     # failure handling
@@ -951,6 +1290,8 @@ class ServingRouter:
             "handoff_bytes": self.handoff_bytes,
             "weight_sync": (self.weight_sync.snapshot()
                             if self.weight_sync is not None else None),
+            "autoscaler": (self.autoscaler.snapshot()
+                           if self.autoscaler is not None else None),
             "latency_p50_s": _p(self._lat, 50),
             "latency_p95_s": _p(self._lat, 95),
             "latency_p99_s": _p(self._lat, 99),

@@ -52,7 +52,7 @@ from .. import envvars
 from ..ps import faults
 from ..telemetry import flight
 from .request import Request
-from .replica import UP
+from .replica import RETIRED, UP
 
 __all__ = ["WeightSyncCoordinator"]
 
@@ -111,6 +111,24 @@ class WeightSyncCoordinator:
             if rep.engine is not None:
                 rep.engine.set_weight_version(self.committed_version)
 
+    def adopt(self, rep):
+        """Version-pin a replica that JOINED the fleet live (elastic
+        scale-up): wrap its factory so every incarnation respawns on
+        the committed version, stamp the live engine onto the committed
+        params/version NOW — admission on the committed version is the
+        bring-up contract — and, when a rollout is in flight, extend
+        the rollout order to cover it so the fleet still converges on
+        the new version after the commit."""
+        rep.factory = self._committed_factory(rep.factory)
+        if rep.engine is not None:
+            rep.engine.swap_params(self.committed_params,
+                                   version=self.committed_version)
+        ro = self.active
+        if ro is not None and rep.index not in ro["order"]:
+            ro["order"].append(rep.index)
+            self._mark("rollout_adopt", replica=rep.index,
+                       version=ro["version"])
+
     # ------------------------------------------------------------- #
     # entry points
     # ------------------------------------------------------------- #
@@ -142,7 +160,8 @@ class WeightSyncCoordinator:
                          "state": "rejected_stale", "swapped": []}
             return False
         order = (list(_order) if _order is not None
-                 else [r.index for r in self.router.replicas])
+                 else [r.index for r in self.router.replicas
+                       if r.state != RETIRED])
         self.active = {
             "version": version, "params": dict(params), "phase": _phase,
             "order": order, "i": 0, "state": "quiesce",

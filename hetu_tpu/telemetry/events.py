@@ -125,6 +125,17 @@ REQUIRED_FIELDS = {
     "rollout_failed": ("version", "reason"),
     "rollout_rollback": ("version", "replicas"),
     "ps_version_skew": ("before", "after"),
+    # elastic fleet (serving/autoscaler.py + router add/retire; ISSUE
+    # 16): scale actions and per-replica lifecycle transitions (failure
+    # stream).  hetu_trace --check pairs every scale_up with a
+    # replica_ready and every scale_down with a replica_retired whose
+    # drained rids each retire exactly once on a peer.
+    "scale_up": ("replica", "reason"),
+    "scale_down": ("replica", "reason"),
+    "replica_warming": ("replica",),
+    "replica_ready": ("replica",),
+    "replica_draining": ("replica",),
+    "replica_retired": ("replica", "requeued"),
     # flight recorder dump header (telemetry/flight.py)
     "flight_dump": ("reason",),
     # telemetry core + bench

@@ -44,6 +44,15 @@ records); ``--fleet`` grows a per-replica ``ver`` column (the
 ``weight_version`` tag riding each replica's serve events) and a
 rollout-progress footer (``rollout   rolling 1/2 → v7``) assembled
 from the coordinator's ``rollout_*`` records.
+
+Elastic fleet (ISSUE 16): ``--fleet`` grows a per-replica ``life``
+column (warming/serving/draining/retired, from the router's
+``replica_warming``/``replica_ready``/``replica_draining``/
+``replica_retired`` lifecycle records) and an autoscale footer —
+last scale action + reason, target vs. actual replicas, and the
+worst-burn / pressure signal that drove it (``scale_up``/
+``scale_down`` events plus the ``fleet.burn``/``fleet.replicas``
+gauges the autoscaler emits each tick).
 """
 
 from __future__ import annotations
@@ -192,7 +201,7 @@ def summarize_fleet(events, window=4096):
     def row(k):
         return per.setdefault(k, {
             "replica": k, "state": "up", "health": "ok", "role": None,
-            "workload": None, "version": None,
+            "life": None, "workload": None, "version": None,
             "live": None, "slots": None, "queue_depth": None,
             "steps": 0, "breaker": "closed", "routed": 0,
             "requeued": 0, "rejects": 0, "deaths": 0, "restarts": 0,
@@ -205,6 +214,8 @@ def summarize_fleet(events, window=4096):
     hops = handoffs = 0
     pressure = None
     rollout = None          # live-weight-sync progress footer
+    autoscale = None        # elastic-fleet footer (scale_* events)
+    fleet_burn = None       # latest fleet.burn gauge
     for e in events:
         kind = e.get("event")
         rep = e.get("replica")
@@ -294,6 +305,29 @@ def summarize_fleet(events, window=4096):
             r["state"] = "up"
         elif kind == "replica_failed" and rep is not None:
             row(rep)["state"] = "failed"
+        elif kind in ("scale_up", "scale_down"):
+            # elastic fleet: the newest scale action wins the footer
+            autoscale = {
+                "action": kind, "replica": rep,
+                "reason": e.get("reason"),
+                "target": e.get("target"), "actual": e.get("actual"),
+                "burn": e.get("burn"), "pressure": e.get("pressure"),
+            }
+        elif kind == "replica_warming" and rep is not None:
+            row(rep)["life"] = "warming"
+        elif kind == "replica_ready" and rep is not None:
+            row(rep)["life"] = "serving"
+        elif kind == "replica_draining" and rep is not None:
+            row(rep)["life"] = "draining"
+        elif kind == "replica_retired" and rep is not None:
+            r = row(rep)
+            r["life"] = "retired"
+            r["state"] = "retired"
+        elif kind == "gauge" and e.get("name") == "fleet.burn":
+            fleet_burn = e.get("value")
+        elif kind == "gauge" and e.get("name") == "fleet.replicas":
+            if autoscale is not None:
+                autoscale["actual"] = e.get("value")
         elif kind == "gauge" and e.get("name") == "router.pressure":
             pressure = e.get("value")
     for r in per.values():
@@ -315,6 +349,8 @@ def summarize_fleet(events, window=4096):
         "handoffs": handoffs,
         "pressure": pressure,
         "rollout": rollout,
+        "autoscale": autoscale,
+        "fleet_burn": fleet_burn,
     }
 
 
@@ -325,7 +361,8 @@ def render_fleet(stats, clock=None):
         f"{time.strftime('%H:%M:%S', time.gmtime(clock))} UTC"
         f"  ({stats['records']} records)",
         "-" * 72,
-        f"{'rep':>3} {'state':<7} {'role':<8} {'wkld':<6} {'ver':>4} "
+        f"{'rep':>3} {'state':<7} {'life':<8} {'role':<8} {'wkld':<6} "
+        f"{'ver':>4} "
         f"{'health':<9} {'occ':>5} "
         f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
         f"{'requeued':>8} {'rejects':>7} {'deaths':>6} "
@@ -335,6 +372,7 @@ def render_fleet(stats, clock=None):
         ver = r.get("version")
         lines.append(
             f"{r['replica']:>3} {r['state']:<7} "
+            f"{str(r.get('life') or '-'):<8} "
             f"{str(r.get('role') or '-'):<8} "
             f"{str(r.get('workload') or 'gpt'):<6} "
             f"{('v' + str(ver)) if ver is not None else '-':>4} "
@@ -366,6 +404,17 @@ def render_fleet(stats, clock=None):
         lines.append(
             f"rollout   {ro['state']} {ro.get('done', 0)}"
             f"/{_fmt(ro.get('replicas'))} → v{_fmt(ro.get('version'))}")
+    asc = stats.get("autoscale")
+    if asc is not None:
+        # elastic fleet: last scale action (target vs. actual replicas
+        # + the signal that drove it) and the worst burn gauge
+        lines.append(
+            f"autoscale {asc['action']} r{_fmt(asc.get('replica'))}"
+            f" ({_fmt(asc.get('reason'))})"
+            f"  target {_fmt(asc.get('target'))}"
+            f" actual {_fmt(asc.get('actual'))}"
+            f"  burn {_fmt(stats.get('fleet_burn'), nd=2)}"
+            f"  pressure {_fmt(asc.get('pressure'), nd=2)}")
     return "\n".join(lines)
 
 

@@ -65,6 +65,14 @@ of one request's ``weight_version``-stamped records must agree on a
 single version — a rolling weight swap only lands on a drained
 replica, so a request that spans two versions without a ``router_hop``
 requeue (or a handoff pair) means a swap landed under a live request.
+
+``--check`` also enforces the scale-balance rule (ISSUE 16): every
+``scale_up`` must pair with a ``replica_ready`` on the same replica
+(the bring-up probe admitted it) and every ``scale_down`` with a
+``replica_retired`` there, and each rid the retirement names as
+drained must retire exactly once AFTER the drain, on a peer — never
+on the draining replica itself, never twice, never zero times
+(deadline-expired rids excepted).
 """
 
 from __future__ import annotations
@@ -364,6 +372,88 @@ def check_handoff_balance(events):
     return problems
 
 
+def check_scale_balance(events):
+    """The elastic-fleet pairing rule (ISSUE 16): every ``scale_up``
+    must pair with a ``replica_ready`` on the same replica (the
+    bring-up probe passed and the replica was admitted) and every
+    ``scale_down`` with a ``replica_retired`` there (the drain
+    completed) — an unpaired scale event is a membership change that
+    never finished.  Replica indexes are never reused (a retired slot's
+    index stays burned), so one pairing per index is exact.  Each rid a
+    ``replica_retired`` names as drained must retire exactly once on a
+    PEER: never on the draining replica itself (a finish there after
+    the drain means the corpse kept serving), never twice fleet-wide,
+    and never zero times (a lost drain).  Rids that expired at their
+    deadline (``router_deadline``) are exempt — expiry is an accounted
+    outcome, not a loss — and streams without any ``serve_finish``
+    records skip the rid-level audit (the engine log was not merged
+    in).  The audit is ORDER-aware over the merged stream: a finish
+    BEFORE the drain (a handed-off rid's prefill clone, say) is
+    legitimate; what must hold is exactly one finish AFTER it, on a
+    peer.  Flight-dump streams are mid-flight snapshots: exempt
+    entirely."""
+    if any(e.get("event") == "flight_dump" for e in events):
+        return []
+    ups, downs, ready, retired = set(), set(), set(), set()
+    drained = {}          # rid -> retiring replica index
+    post = {}             # rid -> [replica finishing AFTER the drain]
+    deadline = set()
+    have_finish = False
+    for e in events:
+        kind = e.get("event")
+        rep = e.get("replica")
+        if kind == "scale_up":
+            ups.add(rep)
+        elif kind == "scale_down":
+            downs.add(rep)
+        elif kind == "replica_ready":
+            ready.add(rep)
+        elif kind == "replica_retired":
+            retired.add(rep)
+            for rid in e.get("rids") or ():
+                drained[rid] = rep
+                post.setdefault(rid, [])
+        elif kind == "serve_finish":
+            have_finish = True
+            rid = e.get("request")
+            if rid in drained:
+                post[rid].append(rep)
+        elif kind == "router_deadline":
+            deadline.add(e.get("request"))
+    problems = []
+    for rep in sorted(ups - ready, key=str):
+        problems.append(
+            f"scale: scale_up of replica {rep} never reached "
+            f"replica_ready — the bring-up probe failed or the scale "
+            f"action was abandoned")
+    for rep in sorted(downs - retired, key=str):
+        problems.append(
+            f"scale: scale_down of replica {rep} never reached "
+            f"replica_retired — the drain was abandoned")
+    if have_finish:
+        for rid in sorted(drained, key=str):
+            if rid in deadline:
+                continue
+            where = post[rid]
+            if not where:
+                problems.append(
+                    f"scale: request {rid!r} was drained off retiring "
+                    f"replica {drained[rid]} but never retired "
+                    f"anywhere — a lost drain")
+            elif drained[rid] in where:
+                problems.append(
+                    f"scale: request {rid!r} retired on replica "
+                    f"{drained[rid]} AFTER it was drained off it — "
+                    f"the draining replica kept serving")
+            elif len(where) > 1:
+                problems.append(
+                    f"scale: drained request {rid!r} retired "
+                    f"{len(where)} times after the drain (replicas "
+                    f"{sorted(where)}) — expected exactly once on a "
+                    f"peer")
+    return problems
+
+
 def check_quant_consistency(events):
     """The mixed-quantization rule: every ``bench_row`` record in one
     stream must carry the SAME ``quant`` stamp (rows predating the
@@ -487,7 +577,11 @@ def main(argv=None):
                          "traced a gather phase), and the "
                          "version-coherence rule (no retirement mixes "
                          "weight versions; a request only changes "
-                         "version across a router requeue); exit 1 on "
+                         "version across a router requeue), and the "
+                         "scale-balance rule (every scale_up pairs "
+                         "with a replica_ready, every scale_down with "
+                         "a replica_retired whose drained rids each "
+                         "retire exactly once on a peer); exit 1 on "
                          "violations")
     args = ap.parse_args(argv)
 
@@ -519,6 +613,8 @@ def main(argv=None):
         problems.extend(gather)
         version = check_version_coherence(events)
         problems.extend(version)
+        scale = check_scale_balance(events)
+        problems.extend(scale)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
@@ -528,7 +624,8 @@ def main(argv=None):
                           "spec_attribution_violations": len(spec),
                           "handoff_violations": len(handoff),
                           "gather_violations": len(gather),
-                          "version_violations": len(version)}))
+                          "version_violations": len(version),
+                          "scale_balance_violations": len(scale)}))
         return 1 if problems or bad else 0
 
     if args.export:

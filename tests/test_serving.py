@@ -387,6 +387,25 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     assert sum(sw["rolling"]["served_by_version"].values()) == \
         sw["rolling"]["finished"]
     assert sw["availability"] is not None and sw["availability"] >= 0.25
+    # elastic-fleet A/B (ISSUE 16): at equal peak capacity over the
+    # same diurnal trace, the autoscaled arm actually scales (>= 1 up
+    # and >= 1 down), loses nothing, spends fewer virtual
+    # replica-seconds at equal-or-better SLO attainment, and stays
+    # token-identical to the static arm (floors also asserted in-bench)
+    asc = art["autoscale_ab"]
+    assert asc["provenance"] == "live" and asc["platform"] == "cpu"
+    assert asc["static"]["lost"] == 0 and asc["autoscaled"]["lost"] == 0
+    assert asc["static"]["scale_ups"] == 0 \
+        and asc["static"]["scale_downs"] == 0
+    assert asc["autoscaled"]["scale_ups"] >= 1
+    assert asc["autoscaled"]["scale_downs"] >= 1
+    assert asc["autoscaled"]["replica_seconds"] < \
+        asc["static"]["replica_seconds"]
+    assert asc["replica_seconds_saved"] > 0
+    assert asc["autoscaled"]["slo_attainment"] >= \
+        asc["static"]["slo_attainment"] >= 0.98
+    assert asc["autoscaled"]["peak_replicas"] == 2
+    assert asc["token_identical_common"] > 0
     ov = fl["overload_shed"]
     assert ov["shed"] > 0
     assert ov["shed_by_class"]["latency"] == 0
