@@ -606,6 +606,126 @@ python bin/hetu_trace.py "$LOG/autoscale_flight.jsonl" --check \
   exit 1
 }
 
+# 00i. tiered-KV gate (ISSUE 17): one CPU process runs the prefix
+#      storm twice through a starved paged pool (2 slots x 8 blocks vs
+#      a 12-session zipf working set) behind the full spill ladder
+#      (host-RAM ring -> 2-shard PS cold store).  Phase A: the ladder
+#      cycles (spills, fetches, ring->PS demotions), zero loss, every
+#      finished request token-identical to an offline decode of the
+#      same specs.  Phase B: the same storm with HETU_CHAOS
+#      role=kvtier killing the PS rung mid-storm — the store must mark
+#      the cold rung dead and degrade to drop-on-evict with zero loss,
+#      identity intact, and WITHOUT taking the replica down with it.
+#      The combined stream must pass the hetu_trace tier-balance rule
+#      (every kv_spill closes with exactly one kv_fetch or
+#      kv_tier_drop), and the kill must land in the failure log.
+run kvtier_gate 600 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/kvtier_trace.jsonl" \
+    HETU_FAILURE_LOG="$LOG/kvtier_failure.jsonl" \
+    HETU_FLIGHT_LOG="$LOG/kvtier_flight.jsonl" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import os
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models import GPTConfig
+from hetu_tpu.ps import faults
+from hetu_tpu.ps.server import PSServer
+from hetu_tpu.ps.sharded import ShardedPSClient
+from hetu_tpu.serving import (ServingEngine, ServingRouter,
+                              TieredKVStore, TrafficGenerator, replay)
+
+def mk_params(seed=0):
+    rng, hd = np.random.RandomState(seed), 16
+    p = {"kt_wte_table": rng.randn(61, hd) * 0.05,
+         "kt_wpe": rng.randn(32, hd) * 0.05,
+         "kt_ln_f_scale": np.ones(hd), "kt_ln_f_bias": np.zeros(hd)}
+    for w, shp in [("attn_q", (hd, hd)), ("attn_k", (hd, hd)),
+                   ("attn_v", (hd, hd)), ("attn_proj", (hd, hd)),
+                   ("ffn_wi", (hd, 4 * hd)), ("ffn_wo", (4 * hd, hd))]:
+        p[f"kt_h0_{w}_weight"] = rng.randn(*shp) * 0.05
+        p[f"kt_h0_{w}_bias"] = np.zeros(shp[1])
+    for ln in ("ln1", "ln2"):
+        p[f"kt_h0_{ln}_scale"] = np.ones(hd)
+        p[f"kt_h0_{ln}_bias"] = np.zeros(hd)
+    return p
+
+p = mk_params()
+cfg = GPTConfig(vocab_size=61, hidden_size=16, num_hidden_layers=1,
+                num_attention_heads=2, max_position_embeddings=32,
+                batch_size=1, seq_len=32, dropout_rate=0.0)
+
+def mk_store():
+    return TieredKVStore(
+        host_bytes=4096, ps_tier=True,
+        ps=ShardedPSClient(servers=[PSServer(), PSServer()]))
+
+def mk_router(store):
+    def factory(i):
+        return ServingEngine(p, cfg, slots=2, queue_limit=64,
+                             max_seq_len=32, paged=True, kv_block=8,
+                             pool_blocks=8, prefix_share=True)
+    return ServingRouter(factory, replicas=1, kv_tiers=store)
+
+specs = TrafficGenerator(seed=31, vocab=61, s_max=32, horizon_s=2.0,
+                         base_rps=12.0, peak_rps=12.0, cycle_s=2.0,
+                         n_sessions=12, zipf_a=1.3,
+                         prefix_len=8).trace(dt=0.05)
+eng = ServingEngine(p, cfg, slots=2, queue_limit=len(specs) + 1,
+                    max_seq_len=32)
+off = eng.run([sp.to_request() for sp in specs])
+
+# ---- phase A: the full ladder under the storm, no chaos -------------
+store = mk_store()
+r = mk_router(store)
+res, rep = replay(r, specs, step_s=0.01)
+snap = r.snapshot()
+assert snap["lost"] == 0 and not rep["shed"] and not rep["rejected"]
+st = snap["kv_tiers"]
+assert sum(st["spills"].values()) > 0, st
+assert sum(st["fetches"].values()) > 0, st
+assert st["demotes"] > 0, st
+for rid, x in res.items():
+    assert list(x.tokens) == list(off[rid].tokens), rid
+store.close("kvtier_gate_phase_a_done")
+a_spills = sum(st["spills"].values())
+a_fetches = sum(st["fetches"].values())
+
+# ---- phase B: PS rung chaos-killed mid-storm ------------------------
+os.environ["HETU_CHAOS"] = "seed=5,kill=2,role=kvtier"
+faults.reset_plans()
+store = mk_store()
+r = mk_router(store)
+res, rep = replay(r, specs, step_s=0.01)
+snap = r.snapshot()
+os.environ.pop("HETU_CHAOS", None)
+faults.reset_plans()
+assert snap["lost"] == 0 and not rep["shed"] and not rep["rejected"]
+assert snap["kv_tiers"]["ps_dead"] is True, snap["kv_tiers"]
+assert all(x["restarts"] == 0 for x in snap["replicas"]), \
+    "the PS kill took a replica down with it"
+for rid, x in res.items():
+    assert list(x.tokens) == list(off[rid].tokens), rid
+store.close("kvtier_gate_phase_b_done")
+print("kvtier gate OK: ladder cycled (spills", a_spills, "fetches",
+      a_fetches, ") then PS chaos kill degraded to drop-on-evict,",
+      "zero loss + token identity in both phases")
+PYEOF
+if ! grep -q 'kvtier gate OK' "$LOG/kvtier_gate.log"; then
+  echo "tiered-KV gate FAILED — see $LOG/kvtier_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/kvtier_trace.jsonl" \
+    "$LOG/kvtier_failure.jsonl" --check \
+    > "$LOG/kvtier_contract.log" || {
+  echo "tiered-KV tier-balance check FAILED — see" \
+       "$LOG/kvtier_contract.log" >&2
+  exit 1
+}
+if ! grep -q 'kvtier_ps_killed' "$LOG/kvtier_failure.jsonl"; then
+  echo "tiered-KV gate: PS chaos kill missing from the failure log" >&2
+  exit 1
+fi
+
 # 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
 #     must pass BEFORE any chip time is spent).  Speculative-decoding
 #     trace-replay gate: the draft-propose / batched-verify path must

@@ -257,6 +257,17 @@ _reg("HETU_KV_CHUNK", "int", 0,
      "this many tokens interleaved with decode waves, so a long prompt "
      "does not stall running generations (0 = whole prompt in one "
      "pass).", "serving")
+_reg("HETU_KV_HOST_BYTES", "int", 0,
+     "Tiered KV: host-RAM ring capacity in bytes for refcount-zero "
+     "prefix blocks spilled out of the HBM pool (LRU; oldest entries "
+     "demote to the PS cold store when enabled, else tier-drop).  "
+     "0 = tier off — eviction drops blocks exactly as before.",
+     "serving")
+_reg("HETU_KV_PS_TIER", "bool", False,
+     "Tiered KV: enable the sharded-PS cold-store rung below the host "
+     "ring (prefix payloads keyed by prefix hash, versioned put/get).  "
+     "A dead/killed PS degrades the ladder to drop-on-evict with zero "
+     "request loss — never an error.", "serving")
 _reg("HETU_EMBED_WAVE", "int", 8,
      "Embedding serving: max requests the engine claims per scoring "
      "wave (one embedding gather + one jitted tower forward per wave; "

@@ -611,6 +611,22 @@ class PSClient:
     def clear(self, key):
         return self.t.call("param_clear", key)
 
+    # ---------------- serving KV cold store (ISSUE 17) ------------- #
+    # thin wrappers over the PSServer kv_* surface: the tiered-KV
+    # ladder (serving/kv_tiers.py) parks spilled prefix payloads here
+
+    def kv_put(self, key, payload, version=0):
+        return self.t.call("kv_put", key, payload, version)
+
+    def kv_get(self, key):
+        return self.t.call("kv_get", key)
+
+    def kv_del(self, key):
+        return self.t.call("kv_del", key)
+
+    def kv_keys(self):
+        return self.t.call("kv_keys")
+
     # ---------------- SSP / BSP / preduce ---------------- #
 
     def ssp_init(self, group=0, bound=0):

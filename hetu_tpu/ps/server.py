@@ -323,6 +323,10 @@ class PSServer:
 
     def __init__(self):
         self.params = {}
+        # serving KV cold store (ISSUE 17): spilled prefix payloads,
+        # key -> (payload, version) — a namespace of its own, never
+        # cast through the f32 param path
+        self.kv_cold = {}
         self.lock = threading.Lock()
         # SSP: per-key worker clocks (reference ssp_handler.h)
         self.ssp_clocks = {}
@@ -593,6 +597,39 @@ class PSServer:
                     f"{key!r} is served by the native van; clearing it "
                     f"would leave the C++ tier serving freed memory")
             self.params.pop(key, None)
+
+    # ---------------- serving KV cold store (ISSUE 17) ---------------- #
+    # The tiered-KV ladder's coldest rung (serving/kv_tiers.py): spilled
+    # prefix payloads — the export_blocks wire dict, int8 or exact —
+    # live in their OWN namespace dict, versioned per put, and never
+    # pass through the f32 param path (a cast would corrupt the int8
+    # planes).  Public methods = PSFunc surface: callable through every
+    # transport, chaos/telemetry included, like any other op.
+
+    def kv_put(self, key, payload, version=0):
+        """Park one cold payload under ``key`` (the tier store keys by
+        prefix hash).  Last write wins; the version stamp lets a fetch
+        refuse an entry someone overwrote behind its index."""
+        with self.lock:
+            self.kv_cold[key] = (payload, int(version))
+        return True
+
+    def kv_get(self, key):
+        """``(payload, version)`` or None — a miss is an answer, not an
+        error (the tier ladder degrades to cold prefill)."""
+        with self.lock:
+            return self.kv_cold.get(key)
+
+    def kv_del(self, key):
+        """Drop a cold payload (a fetch ends the residency); True when
+        something was actually removed."""
+        with self.lock:
+            return self.kv_cold.pop(key, None) is not None
+
+    def kv_keys(self):
+        """Resident cold-store keys (introspection/tests)."""
+        with self.lock:
+            return sorted(self.kv_cold)
 
     def param_save(self, key, path):
         p = self.params[key]

@@ -492,6 +492,38 @@ class ShardedPSClient:
     def wait(self, ticket):
         return self.clients[0].wait(ticket)
 
+    # ---------------- serving KV cold store (ISSUE 17) ------------- #
+    # spilled prefix payloads route whole to hash(key) % N — same as
+    # non-row-sharded params — with the usual async replica write and
+    # primary-failover read through _exec
+
+    def kv_put(self, key, payload, version=0):
+        h = self._home_idx(key)
+        out = self._exec(
+            h, lambda cli, km: cli.kv_put(km(key), payload, version))
+        self._replicate_op(
+            h, lambda cli, km: cli.kv_put(km(key), payload, version))
+        return out
+
+    def kv_get(self, key):
+        return self._exec(
+            self._home_idx(key), lambda cli, km: cli.kv_get(km(key)))
+
+    def kv_del(self, key):
+        h = self._home_idx(key)
+        out = self._exec(h, lambda cli, km: cli.kv_del(km(key)))
+        self._replicate_op(h, lambda cli, km: cli.kv_del(km(key)))
+        return out
+
+    def kv_keys(self):
+        seen = set()
+        for keys in self._fan(
+                lambda s: self._exec(s, lambda cli, km: cli.kv_keys())):
+            for k in keys or ():
+                if not k.startswith(REPLICA_PREFIX):
+                    seen.add(k)
+        return sorted(seen)
+
     # ---------------- failover lifecycle ---------------- #
 
     def drain_replication(self, timeout=30.0):

@@ -37,6 +37,15 @@ compiled arithmetic — with the offline path.
                   block-table paged pool (free-list block allocator,
                   refcounted copy-on-write prefix sharing, chunked
                   prefill support) — paged=/$HETU_KV_BLOCK selects it
+    kv_tiers.py   TieredKVStore: fleet-global prefix capacity — the
+                  eviction-to-tier ladder behind every paged pool
+                  (HBM pool -> host-RAM LRU ring sized by
+                  HETU_KV_HOST_BYTES -> sharded-PS cold store under
+                  HETU_KV_PS_TIER, keyed by prefix hash, versioned);
+                  refcount-zero evictions spill the int8 handoff wire
+                  payload down, admission misses fetch it back up
+                  token-identically via import_blocks; a dead/killed
+                  PS degrades to drop-on-evict with zero request loss
     prefix_directory.py
                   PrefixDirectory: the fleet-wide prefix-cache map
                   (prefix hash -> which replica holds the KV span),
@@ -122,6 +131,7 @@ from .metrics import (
 )
 from .engine import ServingEngine, QueueFull
 from .embed_engine import EmbedServingEngine
+from .kv_tiers import TieredKVStore
 from .prefix_directory import PrefixDirectory, prefix_hash
 from .replica import Replica
 from .router import RouterShed, ServingRouter
@@ -136,7 +146,7 @@ __all__ = [
     "EmbedRequest", "EmbedResult",
     "KVCacheManager", "PagedKVManager", "ServingMetrics",
     "EmbedServingMetrics", "COMPONENTS", "EMBED_COMPONENTS",
-    "SLO", "SLOMonitor", "PrefixDirectory",
+    "SLO", "SLOMonitor", "PrefixDirectory", "TieredKVStore",
     "prefix_hash", "resolve_handoff_quant",
     "resolve_kv_block", "resolve_kv_quant", "round_up_pow2",
 ]

@@ -626,6 +626,10 @@ class ServingEngine:
                     # requeue clock starts at the FIRST deferral
                     self.metrics.lc_blocked(req.request_id)
                     break
+                # tiered KV: a prompt no local prefix covers may be
+                # warm in the host ring / PS cold store — fetch and
+                # re-import it now so alloc below attaches the blocks
+                self._tier_admit(req)
                 t_a = time.perf_counter()
                 slot, cached = self.kv.alloc(
                     req.request_id, req.prompt,
@@ -653,6 +657,45 @@ class ServingEngine:
         if admitted:
             telemetry.inc("serve.admission_waves")
         return admitted
+
+    def _tier_admit(self, req):
+        """Tier miss-escalation at admission (serving/kv_tiers.py):
+        when the tier ladder holds a longer prefix of this prompt than
+        the local pool does, fetch it and re-admit through
+        ``import_blocks`` — token-identical to the original prefill —
+        so the ``kv.alloc`` that follows attaches the blocks
+        refcounted.  Local warmth always wins (a fetch never displaces
+        an equal-or-longer resident prefix), and every failure mode —
+        tier miss, chaos corruption, a pool too full to hold the
+        import — degrades to a cold prefill, never an error.  Returns
+        True when a span landed."""
+        store = getattr(self.kv, "tier_store", None)
+        if store is None or not getattr(self.kv, "prefix_share", False) \
+                or getattr(req, "prompt", None) is None:
+            return False
+        hit = store.lookup(req.prompt, self.kv.block)
+        if hit is None:
+            return False
+        toks, length, _tier = hit
+        _, cached = self.kv.match_prefix(req.prompt)
+        if cached >= length:
+            return False   # the pool already covers at least as much
+        payload = store.fetch(toks)
+        if payload is None:
+            return False
+        try:
+            slot = self.kv.import_blocks(
+                payload, f"{req.request_id}~tierfetch",
+                prompt=list(toks))
+        except ValueError:
+            slot = None
+        if slot is None:
+            store.note_import_failed()
+            return False
+        # the slot was only a write vehicle: the re-registered prefix
+        # keeps the blocks alive (refcounted) for this admission
+        self.kv.release(slot)
+        return True
 
     def _defer_for_prefix(self, req):
         """True when ``req`` should WAIT one step rather than duplicate

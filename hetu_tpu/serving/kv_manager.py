@@ -440,6 +440,15 @@ class PagedKVManager:
         # fleet-visible hints (None = standalone engine, no directory)
         self.on_prefix_register = None   # fn(tokens, entry)
         self.on_prefix_evict = None      # fn(tokens)
+        # tiered KV (serving/kv_tiers.py): eviction-to-tier instead of
+        # eviction-to-drop.  The spill hook gets the doomed prefix's
+        # wire payload BEFORE its blocks are freed; tier_store is the
+        # engine admission path's fetch handle.  Both None = today's
+        # drop-on-evict, byte-identical
+        self.on_prefix_spill = None      # fn(tokens, payload) -> bool
+        self.tier_store = None
+        self.spills = 0
+        self.prefix_hit_tokens = 0       # recompute tokens saved
         # replica-to-replica handoff accounting
         self.exports = 0
         self.imports = 0
@@ -563,6 +572,19 @@ class PagedKVManager:
             if not candidates:
                 break
             _, key = min(candidates)
+            if self.on_prefix_spill is not None:
+                # eviction-to-tier: serialize the doomed prefix while
+                # its blocks are still resident (export_prefix is a
+                # pure read) and offer it to the tier ladder; a
+                # declined spill proceeds as today's drop
+                try:
+                    payload = self.export_prefix(key, count=False)
+                except ValueError:
+                    payload = None
+                if payload is not None \
+                        and self.on_prefix_spill(key, payload):
+                    self.spills += 1
+                    telemetry.inc("serve.prefix_spills")
             e = self._prefix.pop(key)
             for b in e.blocks:
                 self.ref[b] -= 1
@@ -631,6 +653,7 @@ class PagedKVManager:
         self.total_allocs += 1
         if cached:
             self.prefix_hits += 1
+            self.prefix_hit_tokens += cached
             telemetry.inc("serve.prefix_hits")
         self._gauges()
         return slot, cached
@@ -740,7 +763,7 @@ class PagedKVManager:
         idx = np.asarray([int(b) for b in self.tables[slot, :n]], np.int32)
         return self._export_span(idx, length, quant_mode)
 
-    def export_prefix(self, tokens, quant_mode=None):
+    def export_prefix(self, tokens, quant_mode=None, *, count=True):
         """Serialize a REGISTERED prefix's blocks to the same wire
         payload as :meth:`export_blocks` — no live slot required (the
         prefix cache holds its own refcounts), which is how a fleet
@@ -754,11 +777,15 @@ class PagedKVManager:
         if e is None:
             return None
         idx = np.asarray([int(b) for b in e.blocks], np.int32)
-        return self._export_span(idx, int(e.length), quant_mode)
+        return self._export_span(idx, int(e.length), quant_mode,
+                                 count=count)
 
-    def _export_span(self, idx, length, quant_mode):
+    def _export_span(self, idx, length, quant_mode, *, count=True):
         """Gather pool blocks ``idx`` into the wire payload (shared by
-        the slot and prefix export paths)."""
+        the slot and prefix export paths).  ``count=False`` keeps the
+        gather out of the handoff ledger — the tier-spill path uses it
+        so spill bytes don't masquerade as replica-to-replica wire
+        traffic (the tier store keeps its own byte counters)."""
         mode = resolve_handoff_quant(quant_mode)
 
         def gather(cache):
@@ -771,9 +798,10 @@ class PagedKVManager:
         nbytes = cache_nbytes(k) + cache_nbytes(v)
         shape = (k[0] if isinstance(k, tuple) else k).shape
         raw = 2 * 4 * int(np.prod(shape))        # f32-equivalent bytes
-        self.exports += 1
-        self.export_bytes += nbytes
-        telemetry.inc("serve.kv_export_bytes", nbytes)
+        if count:
+            self.exports += 1
+            self.export_bytes += nbytes
+            telemetry.inc("serve.kv_export_bytes", nbytes)
         return {"layout": "paged", "block": self.block, "length": length,
                 "quant": kq, "k": k, "v": v,
                 "nbytes": nbytes, "raw_nbytes": raw}
@@ -856,8 +884,10 @@ class PagedKVManager:
             "blocks_shared": self.blocks_shared,
             "prefix_entries": len(self._prefix),
             "prefix_hits": self.prefix_hits,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
+            "spills": self.spills,
             "exports": self.exports,
             "imports": self.imports,
             "export_bytes": self.export_bytes,

@@ -49,16 +49,19 @@ def prefix_hash(tokens):
 
 
 class _DirEntry:
-    """One known prefix: its length/block span (for introspection) and
-    the replicas holding it with per-replica last-use stamps."""
+    """One known prefix: its length/block span (for introspection),
+    the replicas holding it with per-replica last-use stamps, and the
+    tier column — which rung of the tiered store (serving/kv_tiers.py)
+    holds a spilled copy ("host"/"ps"; None = HBM-resident or gone)."""
 
-    __slots__ = ("length", "blocks", "refs", "replicas")
+    __slots__ = ("length", "blocks", "refs", "replicas", "tier")
 
     def __init__(self, length, blocks):
         self.length = length
         self.blocks = blocks
         self.refs = 0                    # lifetime registrations
         self.replicas = {}               # replica index -> last-use t
+        self.tier = None                 # "host" / "ps" / None
 
 
 class PrefixDirectory:
@@ -80,6 +83,14 @@ class PrefixDirectory:
         self.steals = 0
         self.registrations = 0
         self.evictions = 0
+        # tiered KV (ISSUE 17): the router flips ``tiered`` when a
+        # TieredKVStore is wired — evictions then DEMOTE entries whose
+        # spilled copy is tier-resident instead of deleting them, so
+        # lookup keeps answering "warm somewhere"; with tiering off the
+        # delete semantics are exactly as before
+        self.tiered = False
+        self.demotions = 0
+        self.tier_hits = 0
 
     # ------------------------------------------------------------- #
     # replica feed
@@ -117,15 +128,46 @@ class PrefixDirectory:
 
     def evict(self, replica, tokens):
         """Drop ``replica``'s claim on ``tokens`` (LRU eviction on the
-        replica); the entry dies with its last holder."""
+        replica).  With tiering off the entry dies with its last holder
+        (delete semantics, exactly as before); with tiering on, an
+        entry whose spilled copy is tier-resident DEMOTES instead —
+        the tier column keeps it routable until the tier fetch/drop
+        clears it."""
         h = prefix_hash(tokens)
         e = self._entries.get(h)
         if e is None:
             return
         e.replicas.pop(replica, None)
         if not e.replicas:
-            del self._entries[h]
+            if self.tiered and e.tier is not None:
+                self.demotions += 1
+            else:
+                del self._entries[h]
         self.evictions += 1
+
+    def set_tier(self, tokens, tier):
+        """Stamp the tier column: a spilled copy of this prefix now
+        lives in ``tier``.  Creates the entry when eviction already
+        deleted it — spill and evict race by a callback ordering the
+        directory must not depend on."""
+        h = prefix_hash(tokens)
+        e = self._entries.get(h)
+        if e is None:
+            e = self._entries[h] = _DirEntry(len(tokens), 0)
+        e.tier = tier
+
+    def clear_tier(self, tokens):
+        """Drop the tier stamp (the copy was fetched back up or tier-
+        dropped); the entry dies when no replica claims it either —
+        delete semantics resume once nothing holds the prefix
+        anywhere."""
+        h = prefix_hash(tokens)
+        e = self._entries.get(h)
+        if e is None:
+            return
+        e.tier = None
+        if not e.replicas:
+            del self._entries[h]
 
     def known(self, tokens):
         """True when ANY replica currently claims this exact prefix.
@@ -136,11 +178,14 @@ class PrefixDirectory:
         return prefix_hash(tokens) in self._entries
 
     def drop_replica(self, replica):
-        """Purge every entry naming ``replica`` (death/respawn)."""
+        """Purge every entry naming ``replica`` (death/respawn) —
+        except tier-demoted ones: a spilled copy outlives the replica
+        that spilled it (that is the point of the tier ladder)."""
         dead = []
         for h, e in self._entries.items():
             e.replicas.pop(replica, None)
-            if not e.replicas:
+            if not e.replicas and not (self.tiered
+                                       and e.tier is not None):
                 dead.append(h)
         for h in dead:
             del self._entries[h]
@@ -160,8 +205,11 @@ class PrefixDirectory:
         used wins.  Returns ``(hint, outcome)``: ``hint`` is
         ``(replica, cached_len)`` or None; ``outcome`` is None when a
         fresh holder was found (the router stamps hit/steal once it
-        knows where placement landed), else "miss" (nothing known) or
-        "stale" (only TTL-expired claims) — both counted here."""
+        knows where placement landed), "tier" when NO replica holds the
+        cut but a spilled copy is tier-resident (``hint`` is then
+        ``(None, cached_len)`` — warm somewhere, fetched at engine
+        admission), else "miss" (nothing known) or "stale" (only
+        TTL-expired claims) — all but hit/steal counted here."""
         if self._block is None or len(prompt) < 2:
             self.misses += 1
             return None, "miss"
@@ -175,10 +223,16 @@ class PrefixDirectory:
                 continue
             fresh = {r: ts for r, ts in e.replicas.items()
                      if not self._expired(ts, now)}
-            if not fresh:
-                saw_stale = True
-                continue
-            return (max(fresh, key=fresh.get), n), None
+            if fresh:
+                return (max(fresh, key=fresh.get), n), None
+            if e.tier is not None:
+                # no pool holds this cut but the tier ladder does:
+                # route normally — the landing replica's admission
+                # fetch re-imports the span (tier column = "warm
+                # somewhere", not "warm at")
+                self.tier_hits += 1
+                return (None, n), "tier"
+            saw_stale = True
         if saw_stale:
             self.stale += 1
             return None, "stale"
@@ -207,4 +261,9 @@ class PrefixDirectory:
             "hit_rate": round(self.hit_rate, 4),
             "registrations": self.registrations,
             "evictions": self.evictions,
+            "tiered": self.tiered,
+            "tier_entries": sum(1 for e in self._entries.values()
+                                if e.tier is not None),
+            "tier_hits": self.tier_hits,
+            "demotions": self.demotions,
         }

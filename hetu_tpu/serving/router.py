@@ -128,6 +128,7 @@ from .. import envvars, telemetry
 from ..ps import faults
 from ..telemetry import flight
 from .engine import QueueFull, _STORM_REJECTS
+from .kv_tiers import TieredKVStore
 from .prefix_directory import PrefixDirectory
 from .replica import (  # noqa: F401
     BACKOFF, DEAD, RETIRED, UP, WEDGED, Replica,
@@ -214,7 +215,7 @@ class ServingRouter:
                  retry_backoff=None, shed_queue=None, shed_on_slo=None,
                  restart_limit=None, restart_backoff=None,
                  directory=None, directory_ttl=None, roles=None,
-                 handoff_quant=None, log_path=None):
+                 handoff_quant=None, kv_tiers=None, log_path=None):
         n = int(replicas if replicas is not None
                 else envvars.get_int("HETU_REPLICAS"))
         if n < 1:
@@ -250,6 +251,18 @@ class ServingRouter:
         self.directory = (PrefixDirectory(ttl=directory_ttl)
                           if use_dir else None)
         self.directory_killed = False
+        # tiered KV (ISSUE 17): one fleet-shared spill/fetch ladder
+        # behind every replica's pool — evicted prefix blocks tier to
+        # the host ring / PS cold store instead of dropping.  None =
+        # today's drop-on-evict, byte-identical (no hooks wired).
+        # Must exist before the replicas: _wire_replica attaches each
+        # incarnation's pool
+        self.kv_tiers = (kv_tiers if kv_tiers is not None
+                         else TieredKVStore.from_env())
+        if self.kv_tiers is not None:
+            self.kv_tiers.directory = self.directory
+            if self.directory is not None:
+                self.directory.tiered = True
         # prefill/decode roles, one per replica index; unlisted = mixed
         raw = roles if roles is not None \
             else envvars.get_str("HETU_ROUTER_ROLES")
@@ -335,6 +348,10 @@ class ServingRouter:
             return
         if self.directory is not None:
             self.directory.attach(rep.index, eng.kv)
+        if self.kv_tiers is not None:
+            # evictions on this incarnation's pool spill to the fleet
+            # ladder; its admission path fetches back through it
+            self.kv_tiers.attach(rep.index, eng.kv)
         eng.retire_hook = \
             lambda req, slot, _rep=rep: self._on_retire(_rep, req, slot)
 
@@ -363,6 +380,11 @@ class ServingRouter:
             return
         self.directory = None
         self.directory_killed = True
+        if self.kv_tiers is not None:
+            # the tier ladder survives a directory kill (engine-level
+            # fetches consult the store's own index) — it just stops
+            # stamping tier columns on a corpse
+            self.kv_tiers.directory = None
         self._fail_event("directory_killed", reason=reason)
         flight.RECORDER.dump("directory_killed")
 
@@ -599,7 +621,14 @@ class ServingRouter:
             # up), and a request carrying a handoff payload already
             # knows where its KV is going
             hint, outcome = self._directory_lookup(req, now)
-            if (hint is None and routed.hops == 0
+            if outcome == "tier":
+                # warm somewhere, but in the tier ladder, not a pool:
+                # no replica to prefer and no hit/steal to stamp — the
+                # landing replica's admission fetch re-imports the span
+                # (and a prefill-phase split would only recompute what
+                # the fetch lands for free, so don't flip phases)
+                hint = None
+            elif (hint is None and routed.hops == 0
                     and routed.retries == 0
                     and self._handoff_applies(req)):
                 routed.phase = "prefill"
@@ -919,7 +948,8 @@ class ServingRouter:
         self._swap_hold.add(idx)
         self._fail_event("replica_draining", replica=idx, reason=reason)
         killed = self._chaos_drain_kill(rep)
-        exported = 0 if killed else self._export_hot_prefixes(rep)
+        exported, spilled = ((0, 0) if killed
+                             else self._export_hot_prefixes(rep))
         assigned = self._assigned[idx]
         rids = [rid for rid in assigned if not self._routed[rid].done]
         self._assigned[idx] = {}
@@ -938,6 +968,7 @@ class ServingRouter:
         self._swap_hold.discard(idx)
         self._fail_event("replica_retired", replica=idx,
                          requeued=len(rids), exported_prefixes=exported,
+                         spilled_prefixes=spilled,
                          reason=reason, rids=list(rids))
         return len(rids)
 
@@ -1063,34 +1094,55 @@ class ServingRouter:
         hottest directory-known prefixes to the best-scoring UP peer
         through the same codec warming uses.  Runs BEFORE the directory
         drop, so the peer registers as a holder while the entries that
-        made these prefixes routable still exist."""
+        made these prefixes routable still exist.  A prefix no peer can
+        take — no peer at all, or the best peer's pool has no room —
+        SPILLS to the tier ladder instead of dying with the pool
+        (pre-tier behavior dropped it outright).  Returns
+        ``(exported, spilled)``."""
         if budget is None:
             budget = envvars.get_int("HETU_AUTOSCALE_WARM_PREFIXES")
         if budget <= 0 or not self._warm_prefix_ok(rep):
-            return 0
+            return 0, 0
         kv = rep.engine.kv
         peers = [r for r in self.replicas
                  if r.index != rep.index and r.state == UP
                  and self._warm_prefix_ok(r)
                  and r.engine.kv.block == kv.block]
-        if not peers:
-            return 0
         hot = sorted(kv._prefix.items(), key=lambda kvp: -kvp[1].used)
-        exported = 0
+        exported = spilled = 0
         for toks, _e in hot:
-            if exported >= budget:
+            if exported + spilled >= budget:
                 break
             if self.directory is not None \
                     and not self.directory.known(toks):
                 continue
-            peer = max(peers,
-                       key=lambda r: (self._score(r), -r.index))
-            if toks in peer.engine.kv._prefix:
-                continue   # the best peer already holds it
-            rid = f"retire-r{rep.index}-{exported}"
-            if self._ship_prefix(rep, peer, toks, rid):
-                exported += 1
-        return exported
+            if peers:
+                peer = max(peers,
+                           key=lambda r: (self._score(r), -r.index))
+                if toks in peer.engine.kv._prefix:
+                    continue   # the best peer already holds it
+                rid = f"retire-r{rep.index}-{exported}"
+                if self._ship_prefix(rep, peer, toks, rid):
+                    exported += 1
+                    continue
+            if self._spill_prefix(rep, toks):
+                spilled += 1
+        return exported, spilled
+
+    def _spill_prefix(self, rep, toks):
+        """Retire-path fallback: no peer could absorb this prefix —
+        tier it (host ring / PS cold store) instead of letting it die
+        with the retiring pool.  False when tiering is off or the
+        ladder declined (today's drop)."""
+        if self.kv_tiers is None:
+            return False
+        try:
+            payload = rep.engine.kv.export_prefix(toks, count=False)
+        except ValueError:
+            payload = None
+        if payload is None:
+            return False
+        return self.kv_tiers.spill(toks, payload, replica=rep.index)
 
     def _probe_replica(self, rep):
         """Half-open bring-up probe: one greedy decode must retire on
@@ -1288,6 +1340,8 @@ class ServingRouter:
             "handoff_failed": self.handoff_failed,
             "handoffs_skipped": self.handoffs_skipped,
             "handoff_bytes": self.handoff_bytes,
+            "kv_tiers": (self.kv_tiers.stats()
+                         if self.kv_tiers is not None else None),
             "weight_sync": (self.weight_sync.snapshot()
                             if self.weight_sync is not None else None),
             "autoscaler": (self.autoscaler.snapshot()

@@ -136,6 +136,17 @@ REQUIRED_FIELDS = {
     "replica_ready": ("replica",),
     "replica_draining": ("replica",),
     "replica_retired": ("replica", "requeued"),
+    # tiered KV (serving/kv_tiers.py; ISSUE 17): every kv_spill opens a
+    # tier residency for one prefix; exactly one terminal kv_fetch
+    # (re-admitted into a pool) or kv_tier_drop (ring overflow past a
+    # dead PS, corruption, shutdown) closes it.  hetu_trace --check
+    # tier-balance enforces the pairing.  kvtier_ps_killed (failure
+    # stream) marks the one-shot PS-rung death that degrades the
+    # ladder to drop-on-evict.
+    "kv_spill": ("prefix", "tier", "length"),
+    "kv_fetch": ("prefix", "tier", "length"),
+    "kv_tier_drop": ("prefix", "tier"),
+    "kvtier_ps_killed": ("reason",),
     # flight recorder dump header (telemetry/flight.py)
     "flight_dump": ("reason",),
     # telemetry core + bench

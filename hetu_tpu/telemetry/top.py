@@ -211,6 +211,10 @@ def summarize_fleet(events, window=4096):
 
     shed = {"latency": 0, "throughput": 0}
     prefix = {"hits": 0, "misses": 0, "steals": 0, "stale": 0}
+    # tiered KV (ISSUE 17): spill/fetch/drop ledger events plus the
+    # directory's "tier" routing verdict (warm in a tier, no pool)
+    tier = {"spills": 0, "fetches": 0, "drops": 0, "routed": 0,
+            "ps_killed": 0}
     hops = handoffs = 0
     pressure = None
     rollout = None          # live-weight-sync progress footer
@@ -264,8 +268,18 @@ def summarize_fleet(events, window=4096):
                     prefix["stale"] += 1
                 elif d == "miss":
                     prefix["misses"] += 1
+                elif d == "tier":
+                    tier["routed"] += 1
         elif kind == "kv_handoff_in":
             handoffs += 1
+        elif kind == "kv_spill":
+            tier["spills"] += 1
+        elif kind == "kv_fetch":
+            tier["fetches"] += 1
+        elif kind == "kv_tier_drop":
+            tier["drops"] += 1
+        elif kind == "kvtier_ps_killed":
+            tier["ps_killed"] += 1
         elif kind == "router_hop":
             hops += 1
             to = e.get("to_replica")
@@ -346,6 +360,7 @@ def summarize_fleet(events, window=4096):
         "shed": shed,
         "requeues": hops,
         "prefix": prefix,
+        "tier": tier,
         "handoffs": handoffs,
         "pressure": pressure,
         "rollout": rollout,
@@ -397,6 +412,15 @@ def render_fleet(stats, clock=None):
         f"  steals {pre.get('steals', 0)}"
         f"  stale {pre.get('stale', 0)}"
         f"  handoffs {stats.get('handoffs', 0)}")
+    tr = stats.get("tier") or {}
+    if any(tr.values()):
+        # tiered KV panel — only when the ladder saw traffic
+        lines.append(
+            f"kv-tier   spills {tr.get('spills', 0)}"
+            f"  fetches {tr.get('fetches', 0)}"
+            f"  drops {tr.get('drops', 0)}"
+            f"  routed {tr.get('routed', 0)}"
+            + ("  PS DEAD" if tr.get("ps_killed") else ""))
     ro = stats.get("rollout")
     if ro is not None:
         # "rollout   rolling 1/2 → v7" while in flight; terminal

@@ -454,6 +454,51 @@ def check_scale_balance(events):
     return problems
 
 
+def check_tier_balance(events):
+    """The tiered-KV pairing rule (ISSUE 17): a ``kv_spill`` opens a
+    tier residency for its prefix; exactly ONE terminal event closes
+    it — a ``kv_fetch`` (the payload was re-admitted into a pool) or a
+    ``kv_tier_drop`` (ring overflow past a dead/absent PS, corruption,
+    shutdown).  The audit is ORDER-aware per prefix hash over the
+    merged stream: a second spill while the first residency is still
+    open is a double-spill (a refresh must NOT re-emit); a fetch or
+    drop with no open residency closes nothing (a fabricated fetch);
+    and a residency still open at end-of-stream is a leak — completed
+    runs call ``TieredKVStore.close()``, which drops every resident.
+    Note a host->PS demotion inside the ladder is NOT an event (the
+    residency merely moved rungs).  Flight-dump streams are mid-flight
+    snapshots: exempt entirely."""
+    if any(e.get("event") == "flight_dump" for e in events):
+        return []
+    open_res = {}          # prefix hash -> count of open residencies
+    problems = []
+    for e in events:
+        kind = e.get("event")
+        if kind not in ("kv_spill", "kv_fetch", "kv_tier_drop"):
+            continue
+        h = e.get("prefix")
+        n = open_res.get(h, 0)
+        if kind == "kv_spill":
+            if n > 0:
+                problems.append(
+                    f"tier-balance: prefix {h!r} spilled while already "
+                    f"tier-resident — a refresh re-emitted kv_spill")
+            open_res[h] = n + 1
+        else:
+            if n <= 0:
+                problems.append(
+                    f"tier-balance: prefix {h!r} saw {kind} with no "
+                    f"open tier residency — nothing was spilled")
+            else:
+                open_res[h] = n - 1
+    for h in sorted(k for k, n in open_res.items() if n > 0):
+        problems.append(
+            f"tier-balance: prefix {h!r} still tier-resident at end "
+            f"of stream — no terminal kv_fetch/kv_tier_drop (close() "
+            f"not called?)")
+    return problems
+
+
 def check_quant_consistency(events):
     """The mixed-quantization rule: every ``bench_row`` record in one
     stream must carry the SAME ``quant`` stamp (rows predating the
@@ -581,8 +626,10 @@ def main(argv=None):
                          "scale-balance rule (every scale_up pairs "
                          "with a replica_ready, every scale_down with "
                          "a replica_retired whose drained rids each "
-                         "retire exactly once on a peer); exit 1 on "
-                         "violations")
+                         "retire exactly once on a peer), and the "
+                         "tier-balance rule (every kv_spill closes "
+                         "with exactly one kv_fetch or kv_tier_drop "
+                         "for its prefix); exit 1 on violations")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()
@@ -615,6 +662,8 @@ def main(argv=None):
         problems.extend(version)
         scale = check_scale_balance(events)
         problems.extend(scale)
+        tier = check_tier_balance(events)
+        problems.extend(tier)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
@@ -625,7 +674,8 @@ def main(argv=None):
                           "handoff_violations": len(handoff),
                           "gather_violations": len(gather),
                           "version_violations": len(version),
-                          "scale_balance_violations": len(scale)}))
+                          "scale_balance_violations": len(scale),
+                          "tier_balance_violations": len(tier)}))
         return 1 if problems or bad else 0
 
     if args.export:

@@ -436,9 +436,34 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     for srow in sa["acceptance_sweep"]:
         assert srow["identical"] is True
         assert srow["acceptance_rate"] <= sa["spec"]["acceptance_rate"]
+    # tiered-KV prefix storm (ISSUE 17): at equal pool size, the
+    # ladder saves strictly more recompute tokens than drop-on-evict
+    # with zero loss and greedy identity everywhere, the full ladder
+    # cycles (spills, fetches, ring -> PS demotions), and the
+    # PS-chaos arm degrades (ps_dead) without taking a replica down
+    # (floors also asserted in-bench)
+    storm = art["prefix_storm_ab"]
+    assert storm["provenance"] == "live" and storm["platform"] == "cpu"
+    assert storm["greedy_identical"] is True
+    for arm in ("drop_on_evict", "tiered", "tiered_ps_chaos"):
+        row = storm[arm]
+        assert row["lost"] == 0 and row["shed"] == 0 \
+            and row["rejected"] == 0, (arm, row)
+        assert row["replica_restarts"] == 0, (arm, row)
+    assert storm["recompute_tokens_saved_delta"] > 0, storm
+    assert storm["tiered"]["recompute_tokens_saved"] > \
+        storm["drop_on_evict"]["recompute_tokens_saved"]
+    tst = storm["tiered"]["tiers"]
+    assert sum(tst["spills"].values()) > 0
+    assert sum(tst["fetches"].values()) > 0
+    assert tst["demotes"] > 0
+    cst = storm["tiered_ps_chaos"]["tiers"]
+    assert cst["ps_dead"] is True and cst["ps_entries"] == 0
+    assert storm["drop_on_evict"]["tiers"] is None
     with open(tmp_path / "BENCH_SERVE.json") as f:
         on_disk = json.load(f)
     assert on_disk["continuous"]["tokens_per_sec"] == cont
     assert on_disk["static_baseline"]["tokens_per_sec"] == stat
     assert on_disk["fast_path_ab"]["greedy_identical"] is True
     assert on_disk["fleet_ab"]["greedy_identical"] is True
+    assert on_disk["prefix_storm_ab"]["greedy_identical"] is True
