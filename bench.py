@@ -1238,6 +1238,8 @@ def bench_serve(platform, reduced):
                                n_req)
     spec_ab = _serve_spec_ab(params, cfg, dt_, platform, slots, s_max,
                              vocab, n_req)
+    ragged_ab = _serve_ragged_ab(params, cfg, dt_, platform, slots,
+                                 s_max, vocab, n_req)
 
     art = {
         "platform": platform,
@@ -1272,6 +1274,7 @@ def bench_serve(platform, reduced):
         "prefix_storm_ab": prefix_storm_ab,
         "quant_ab": quant_ab,
         "spec_ab": spec_ab,
+        "ragged_ab": ragged_ab,
         "trace": {"seed": 1234, "n_requests": n_req,
                   "prompt_len": "4..16", "short_new_tokens": "8..32",
                   "straggler_every": 8, "straggler_new_tokens": straggle,
@@ -2397,6 +2400,129 @@ def _serve_spec_ab(params, cfg, dt_, platform, slots, s_max, vocab,
             f"speculation at acceptance "
             f"{spec_hi['acceptance_rate']} shows no wall-clock win "
             f"(speedup {speedup}): {plain} vs {spec_hi}")
+    return result
+
+
+def _serve_ragged_ab(params, cfg, dt_, platform, slots, s_max, vocab,
+                     n_req):
+    """Mixed-mode ragged dispatch vs the phase-split scheduler
+    (ISSUE 18) on a trace that exercises BOTH regimes at once: half
+    the requests are prefill-heavy (long chunked prompts, short
+    tails), half decode-heavy (short prompts, long tails), so every
+    engine step mixes chunk continuations with decode streams — the
+    wave shape the phase barrier penalizes.  Greedy token identity
+    between the modes is asserted at the end; the ragged arm's
+    chunk_stall tail component must be EXACTLY zero (mixed mode folds
+    it at retirement after asserting the residue is bounded), and
+    tok/s must be no worse than phase-split (strict speedup floor
+    gated to TPU — the CPU harness runs both arms through XLA-batched
+    attention, so only dispatch-count savings show here; suite stage
+    4c on chip is the A/B of record)."""
+    from hetu_tpu.serving import Request, ServingEngine
+
+    chunk = max(8, s_max // 16)
+    rng = np.random.RandomState(999)
+    trace = []
+    for i in range(n_req):
+        if i % 2 == 0:      # prefill-heavy: chunked prompt, short tail
+            P = int(rng.randint(s_max // 4, s_max // 2))
+            gen = int(rng.randint(4, 9))
+        else:               # decode-heavy: short prompt, long tail
+            P = int(rng.randint(4, 13))
+            gen = int(rng.randint(16, 33))
+        trace.append((rng.randint(0, vocab, P).astype(np.int32), gen))
+    useful = sum(g for _, g in trace)
+
+    def run(ragged):
+        kw = dict(slots=slots, queue_limit=n_req, dtype=dt_,
+                  paged=True, kv_block=8, prefill_chunk=chunk,
+                  ragged=ragged)
+        mk = lambda: [Request(prompt=p, max_new_tokens=g,  # noqa: E731
+                              seed=i)
+                      for i, (p, g) in enumerate(trace)]
+        warm = ServingEngine(params, cfg, **kw)
+        warm.run(mk())
+        # best of two measured replays — the no-worse floor below is
+        # ASSERTED, so a background-load hiccup must not fail the gate
+        best = None
+        for _ in range(2):
+            e_ = ServingEngine(params, cfg, **kw)
+            t0 = time.perf_counter()
+            res_ = e_.run(mk())
+            w_ = time.perf_counter() - t0
+            if best is None or w_ < best[0]:
+                best = (w_, e_, res_)
+        wall, e, res = best
+        snap = e.metrics.snapshot()
+        tail = e.metrics.explain_tail()
+        stall = snap["components"].get("chunk_stall_ms")
+        row = {
+            "tokens_per_sec": round(useful / wall, 1),
+            "wall_s": round(wall, 3),
+            "steps": e.steps,
+            "prefill_dispatches": snap["prefill_dispatches"],
+            "ttft_p50_s": snap["ttft_p50_s"],
+            "ttft_p99_s": snap["ttft_p99_s"],
+            "tpot_p50_s": snap["tpot_p50_s"],
+            "chunk_stall_p99_ms": (stall["p99_ms"] if stall else None),
+            "tail_dominant": (tail["dominant_component"]
+                              if tail else None),
+            "tail_components_ms": (tail["components_mean_ms"]
+                                   if tail else None),
+        }
+        return row, sorted(r.tokens.tolist() for r in res.values())
+
+    phase, out_p = run(False)
+    mixed, out_m = run(True)
+    speedup = (round(mixed["tokens_per_sec"] / phase["tokens_per_sec"],
+                     3)
+               if phase["tokens_per_sec"] else None)
+    result = {
+        "provenance": "live",
+        "platform": platform,
+        "measured_at": time.strftime("%Y-%m-%d %H:%M UTC",
+                                     time.gmtime()),
+        "trace": {"seed": 999, "n_requests": n_req,
+                  "prefill_heavy_prompt": f"{s_max // 4}..{s_max // 2 - 1}",
+                  "decode_heavy_prompt": "4..12",
+                  "useful_tokens": useful, "prefill_chunk": chunk},
+        "phase_split": phase,
+        "ragged": mixed,
+        "speedup": speedup,
+        "greedy_identical": out_p == out_m,
+        "note": "ONE ragged wave per step (arrivals + chunk "
+                "continuations + decode; kernels/ragged_attention.py) "
+                "vs the prefill-then-decode phase-split scheduler; "
+                "chunk_stall vanishes by construction in mixed mode; "
+                "CPU harness runs masked attention in both arms — "
+                "stage 4c on chip is the A/B of record",
+    }
+    # floors asserted HERE so a mixed-mode regression can never bank a
+    # ragged_ab silently
+    assert result["greedy_identical"], (
+        "mixed-mode greedy outputs diverged from the phase-split engine")
+    assert mixed["chunk_stall_p99_ms"] in (None, 0.0), (
+        f"ragged arm still shows chunk_stall: {mixed}")
+    assert phase["chunk_stall_p99_ms"], (
+        "phase-split arm shows NO chunk_stall — the trace no longer "
+        "exercises chunked prefill and this A/B is vacuous")
+    assert speedup is not None and speedup > 0
+    # the CPU masked path computes the UNION wave width for every slot
+    # (a 16-token chunk in the wave makes each decode slot pay 16 rows
+    # of forward compute), so "no worse" is an on-chip claim — there
+    # the ragged kernel skips dead q rows and the dispatch savings are
+    # the point.  The CPU floor below is a regression backstop only
+    # (catches a mixed-mode scheduler pathology, not a kernel claim)
+    assert speedup >= 0.5, (
+        f"mixed mode collapsed to {speedup}x phase-split on the mixed "
+        f"trace — scheduler regression, not padding overhead: "
+        f"{phase} vs {mixed}")
+    if platform == "tpu":
+        # the strict no-worse floor, gated to the platform the ragged
+        # kernel actually runs on (stage 4c banks this on chip)
+        assert speedup >= 1.0, (
+            f"mixed mode shows no on-chip win (speedup {speedup}): "
+            f"{phase} vs {mixed}")
     return result
 
 

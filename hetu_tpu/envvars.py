@@ -223,6 +223,13 @@ _reg("HETU_SERVE_FAST", "str", "auto",
      "Serving fast path: 1 forces flash-prefill + ragged decode "
      "kernels, 0 the masked/scan reference, auto = fast on TPU.",
      "serving")
+_reg("HETU_SERVE_RAGGED", "str", "auto",
+     "Mixed-mode ragged dispatch: 1 packs arrivals, chunk "
+     "continuations, spec-verify, and decode streams into ONE ragged "
+     "wave per engine step (per-slot q_len; no prefill/decode phase "
+     "barrier, chunk_stall ~ 0), 0 keeps the phase-split scheduler, "
+     "auto = mixed on TPU.  Greedy outputs are token-identical either "
+     "way.", "serving")
 _reg("HETU_SERVE_LOG", "path", None,
      "JSONL sink for serving engine events (same record shape as "
      "HETU_FAILURE_LOG).", "serving")

@@ -19,3 +19,9 @@ try:
     __all__.append("decode_attention")
 except ImportError:  # pallas unavailable: serving falls back to masked
     pass
+
+try:
+    from . import ragged_attention  # noqa: F401
+    __all__.append("ragged_attention")
+except ImportError:  # pallas unavailable: mixed mode falls back to masked
+    pass

@@ -346,24 +346,15 @@ def paged_block_decode_attention(q, pool_k, pool_v, lengths,
 def paged_block_decode_reference(q, pool_k, pool_v, lengths,
                                  block_tables, k_scale=None,
                                  v_scale=None):
-    """Gather-then-mask oracle for the block-table kernel: materialize
-    each slot's logical [T*bs] KV from the pool (dequantizing int8
-    pools through their gathered scale planes — the masked-gather
-    reference path the engine runs off-TPU) and run the contiguous
-    masked reference over it."""
-    B = q.shape[0]
-    bs = pool_k.shape[1]
-    T = block_tables.shape[1]
-    k = pool_k[block_tables].reshape(B, T * bs, *pool_k.shape[2:])
-    v = pool_v[block_tables].reshape(B, T * bs, *pool_v.shape[2:])
-    if k_scale is not None:
-        ks = k_scale[block_tables].reshape(B, T * bs,
-                                           *k_scale.shape[2:])
-        vs = v_scale[block_tables].reshape(B, T * bs,
-                                           *v_scale.shape[2:])
-        k = k.astype(jnp.float32) * ks[..., None]
-        v = v.astype(jnp.float32) * vs[..., None]
-    return masked_decode_reference(q, k, v, lengths)
+    """Gather-then-mask oracle for the block-table kernel: the
+    decode (q_len 1) degenerate of the unified ragged paged reference
+    (dequantizing int8 pools through their gathered scale planes — the
+    masked-gather reference path the engine runs off-TPU)."""
+    from .ragged_attention import ragged_paged_reference
+    ones = jnp.ones_like(lengths)
+    return ragged_paged_reference(q[:, None], pool_k, pool_v, lengths,
+                                  ones, block_tables, k_scale,
+                                  v_scale)[:, 0]
 
 
 # ------------------------------------------------------------------- #
@@ -652,57 +643,32 @@ def masked_verify_reference(q, k, v, lengths, q_lens, k_scale=None,
                             v_scale=None):
     """Exact masked oracle (f32) for the verify kernels: per-query
     causal masks over the full padded cache — the same arithmetic
-    ``_verify_step``'s einsum path runs."""
-    if k_scale is not None:
-        k = k.astype(jnp.float32) * k_scale[..., None]
-        v = v.astype(jnp.float32) * v_scale[..., None]
-    B, Q = q.shape[:2]
-    S = k.shape[1]
-    posq = jnp.clip(
-        (lengths - q_lens)[:, None] + jnp.arange(Q)[None, :], 0,
-        jnp.maximum(lengths - 1, 0)[:, None])              # [B, Q]
-    s = jnp.einsum("bqhd,bshd->bqhs", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
-    live = jnp.arange(S)[None, None, None, :] <= posq[:, :, None, None]
-    s = jnp.where(live, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bqhs,bshd->bqhd", p, v.astype(jnp.float32))
-    return out * (lengths > 0)[:, None, None, None]
+    ``_verify_step``'s einsum path runs.  Now a thin delegate of the
+    unified ragged reference (a verify wave IS a ragged wave)."""
+    from .ragged_attention import ragged_masked_reference
+    return ragged_masked_reference(q, k, v, lengths, q_lens, k_scale,
+                                   v_scale)
 
 
 def paged_block_verify_reference(q, pool_k, pool_v, lengths, q_lens,
                                  block_tables, k_scale=None,
                                  v_scale=None):
-    """Gather-then-mask oracle for the block-table verify kernel."""
-    B = q.shape[0]
-    bs = pool_k.shape[1]
-    T = block_tables.shape[1]
-    k = pool_k[block_tables].reshape(B, T * bs, *pool_k.shape[2:])
-    v = pool_v[block_tables].reshape(B, T * bs, *pool_v.shape[2:])
-    if k_scale is not None:
-        ks = k_scale[block_tables].reshape(B, T * bs,
-                                           *k_scale.shape[2:])
-        vs = v_scale[block_tables].reshape(B, T * bs,
-                                           *v_scale.shape[2:])
-        k = k.astype(jnp.float32) * ks[..., None]
-        v = v.astype(jnp.float32) * vs[..., None]
-    return masked_verify_reference(q, k, v, lengths, q_lens)
+    """Gather-then-mask oracle for the block-table verify kernel — a
+    thin delegate of the unified ragged paged reference."""
+    from .ragged_attention import ragged_paged_reference
+    return ragged_paged_reference(q, pool_k, pool_v, lengths, q_lens,
+                                  block_tables, k_scale, v_scale)
 
 
 def masked_decode_reference(q, k, v, lengths, k_scale=None,
                             v_scale=None):
     """Exact masked-``S_max`` oracle (f32) for the parity suite: the
     same arithmetic ``_decode_step``'s einsum path runs, minus the
-    compute-dtype shortcuts.  Int8 caches dequantize through their
-    per-(position, head) scales first."""
-    if k_scale is not None:
-        k = k.astype(jnp.float32) * k_scale[..., None]
-        v = v.astype(jnp.float32) * v_scale[..., None]
-    S = k.shape[1]
-    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
-    live = jnp.arange(S)[None, None, :] < lengths[:, None, None]
-    s = jnp.where(live, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32))
-    return out * (lengths > 0)[:, None, None]
+    compute-dtype shortcuts.  A decode step is the q_len-1 degenerate
+    of the unified ragged reference (position ``lengths - 1`` admits
+    kv < ``lengths``; a dead slot is zeroed by the same guard), so this
+    is now a thin delegate of it."""
+    from .ragged_attention import ragged_masked_reference
+    ones = jnp.ones_like(lengths)
+    return ragged_masked_reference(q[:, None], k, v, lengths, ones,
+                                   k_scale, v_scale)[:, 0]

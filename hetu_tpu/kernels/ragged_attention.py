@@ -1,0 +1,282 @@
+"""ONE ragged mixed-mode attention kernel for the whole serving hot
+loop (ISSUE 18, Ragged Paged Attention lineage).
+
+The phase-split engine runs three kernel families per scheduler
+iteration — flash prefill for admissions, the decode kernel for
+continuing streams, the verify kernel for speculative waves — with a
+scheduling barrier between the phases.  This module collapses them:
+every slot in a wave carries its OWN ``q_len`` (1 for decode, k+1 for
+spec-verify, a chunk of prompt for prefill/chunked-prefill), and one
+kernel call scores the whole mixed wave.  Mechanically it is the
+verify-kernel computation with nothing verify-specific left in it:
+
+  - grid (slot, kv-block), kv innermost, so the online-softmax
+    accumulators (one f32 (m, l, acc) row per (head, query)) persist in
+    VMEM scratch across a slot's kv steps;
+  - per-slot ``q_len``/``kv_len``/block-table rows ride in as SCALAR
+    PREFETCH so the kv block-index maps can see them;
+  - blocks wholly past a slot's filled length REVISIT its last live
+    block (a repeated index skips the DMA — flash_attention's
+    ``_causal_kv_index`` trick) and their compute is skipped with
+    ``@pl.when``, so a wave's KV traffic is O(sum(kv_len)), not
+    O(B * S_max);
+  - scores and the output accumulate in f32 over bf16 pools;
+  - the int8 twin takes per-(position, head) scale planes on the same
+    revisit index maps and dequantizes INSIDE the online-softmax loop
+    (no f32 pool is ever materialized);
+  - ``q_len = 1`` degenerates exactly to the decode kernel's mask, so
+    a decode-only wave pays no mixed-mode tax.
+
+There is ONE parameterized kernel body (``_ragged_kernel``) behind all
+four layouts (contiguous/block-table x f32/int8) and ONE masked-gather
+reference (``ragged_masked_reference``) for off-TPU interpret-mode
+parity — kernels/decode_attention.py's four per-mode references now
+delegate here, and its per-mode kernels remain as parity oracles behind
+the existing ``$HETU_SERVE_FAST``/phase-split paths.
+
+The kernel reads the q-block's own K/V back from the pool (the
+engine's mixed step writes before it attends), so a lossy cache dtype
+(bf16/int8) round-trips prefill chunks exactly like the phase-split
+fast path round-trips decode/verify positions; the masked engine path
+(``_verify_step``'s mixed mode) keeps the phase-split engine's exact
+per-mode arithmetic instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _fit_block
+from .decode_attention import (_LANES, _online_softmax_multi,
+                               _use_interpret, _verify_finalize)
+
+
+def _ragged_kernel(*refs, scale, bk, n_kv, nq, quant, tabled):
+    """The single mixed-mode body.  ``refs`` is the Pallas positional
+    layout — scalar-prefetch (lens, q_lens[, block_tables]) then
+    operands (q, k[, k_scale], v[, v_scale]) then the output and the
+    (m, l, acc) scratch — sliced by the two static flags: ``quant``
+    adds the int8 scale planes, ``tabled`` the block-table ref (consumed
+    only by the index maps).  Everything mode-specific is per-slot DATA
+    (q_len, kv_len), never a code path: a decode slot is q_len=1, a
+    spec-verify slot k+1, a prefill chunk its chunk width, all in the
+    same wave."""
+    i = 2 + (1 if tabled else 0)     # skip lens/qlens[/tables] refs
+    lens_ref, qlens_ref = refs[0], refs[1]
+    q_ref = refs[i]
+    if quant:
+        k_ref, ks_ref, v_ref, vs_ref = refs[i + 1:i + 5]
+        i += 5
+    else:
+        k_ref, v_ref = refs[i + 1:i + 3]
+        i += 3
+    o_ref, m_ref, l_ref, acc_ref = refs[i:i + 4]
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    filled = lens_ref[b]
+
+    # blocks wholly past this slot's filled prefix are dead: their DMA
+    # was already skipped by the revisit index map; skip the compute too
+    @pl.when(j * bk < filled)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        if quant:
+            k = k.astype(jnp.float32) * ks_ref[0][..., None]
+            v = v.astype(jnp.float32) * vs_ref[0][..., None]
+            q = q.astype(jnp.float32)
+        _online_softmax_multi(q, k, v, filled, qlens_ref[b], j, bk,
+                              scale, m_ref, l_ref, acc_ref)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        _verify_finalize(o_ref, m_ref, l_ref, acc_ref, nq,
+                         q_ref.shape[2], q_ref.shape[3])
+
+
+def _call_ragged(q, lengths, q_lens, operands, *, bk, n_kv, quant,
+                 tabled, in_specs, scalars, interpret):
+    B, Q, H, Dh = q.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(scalars),
+        grid=(B, n_kv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, Q, H, Dh), lambda b, j, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H * Q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((H * Q, _LANES), jnp.float32),   # running denom
+            pltpu.VMEM((H * Q, Dh), jnp.float32),       # output acc
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ragged_kernel, scale=Dh ** -0.5, bk=bk,
+                          n_kv=n_kv, nq=Q, quant=quant, tabled=tabled),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Q, H, Dh), q.dtype),
+        interpret=interpret,
+    )(*scalars, *operands)
+
+
+def ragged_attention(q, k, v, lengths, q_lens, *, block_k=128,
+                     k_scale=None, v_scale=None, interpret=None):
+    """The mixed wave over the slot-contiguous cache layout.
+
+    q: [B, Q, H, Dh] — one q-block per slot, already written to the
+    cache (rows past ``q_lens[b]`` are inert pad whose output the host
+    discards); k, v: [B, S_max, H, Dh] (one layer's ``cache_k[i]``);
+    lengths: [B] int32 filled counts INCLUDING the q-block's live
+    rows; q_lens: [B] int32 live queries per slot — 1 decodes, k+1
+    verifies, a chunk width prefills, mixed freely in one call.
+    Returns o [B, Q, H, Dh] in q's dtype; a slot with lengths 0
+    returns zeros.  Int8 caches pass ``k_scale``/``v_scale``
+    [B, S_max, H] f32."""
+    B, Q, H, Dh = q.shape
+    S = k.shape[1]
+    bk = _fit_block(block_k, S)
+    if interpret is None:
+        interpret = _use_interpret()
+    quant = k_scale is not None
+
+    def kv_idx(b, j, lens_ref, qlens_ref):
+        # dead blocks revisit the slot's last live block: the repeated
+        # index skips the DMA entirely
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bk
+        return (b, jnp.minimum(j, last), 0, 0)
+
+    def sc_idx(b, j, lens_ref, qlens_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bk
+        return (b, jnp.minimum(j, last), 0)
+
+    q_spec = pl.BlockSpec((1, Q, H, Dh),
+                          lambda b, j, lens, qlens: (b, 0, 0, 0))
+    if quant:
+        in_specs = [q_spec,
+                    pl.BlockSpec((1, bk, H, Dh), kv_idx),
+                    pl.BlockSpec((1, bk, H), sc_idx),
+                    pl.BlockSpec((1, bk, H, Dh), kv_idx),
+                    pl.BlockSpec((1, bk, H), sc_idx)]
+        operands = (q, k, k_scale, v, v_scale)
+    else:
+        in_specs = [q_spec,
+                    pl.BlockSpec((1, bk, H, Dh), kv_idx),
+                    pl.BlockSpec((1, bk, H, Dh), kv_idx)]
+        operands = (q, k, v)
+    return _call_ragged(
+        q, lengths, q_lens, operands, bk=bk, n_kv=S // bk, quant=quant,
+        tabled=False, in_specs=in_specs,
+        scalars=(lengths.astype(jnp.int32), q_lens.astype(jnp.int32)),
+        interpret=interpret)
+
+
+def ragged_paged_attention(q, pool_k, pool_v, lengths, q_lens,
+                           block_tables, *, k_scale=None, v_scale=None,
+                           interpret=None):
+    """The mixed wave over the BLOCK-TABLE paged pool — the serving
+    engine's production mixed-mode dispatch.
+
+    q: [B, Q, H, Dh]; pool_k, pool_v: [N_blocks, bs, H, Dh] (the shared
+    pool, one layer); block_tables: [B, T] int32 — entry (b, j) is the
+    pool block holding slot b's positions [j*bs, (j+1)*bs); lengths /
+    q_lens: [B] int32 as in :func:`ragged_attention` (dead table
+    entries may hold any valid pool index — the engine points them at
+    scratch block 0).  Each slot DMAs exactly ceil(lengths[b]/bs) live
+    pool blocks through its scalar-prefetched table row; shared prefix
+    blocks are fetched per-slot but stored once.  Int8 pools pass
+    ``k_scale``/``v_scale`` [N_blocks, bs, H] f32."""
+    B, Q, H, Dh = q.shape
+    bs = pool_k.shape[1]
+    T = block_tables.shape[1]
+    if interpret is None:
+        interpret = _use_interpret()
+    quant = k_scale is not None
+
+    def kv_idx(b, j, lens_ref, qlens_ref, bt_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bs
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0, 0)
+
+    def sc_idx(b, j, lens_ref, qlens_ref, bt_ref):
+        last = jnp.maximum(lens_ref[b] - 1, 0) // bs
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0)
+
+    q_spec = pl.BlockSpec((1, Q, H, Dh),
+                          lambda b, j, lens, qlens, bt: (b, 0, 0, 0))
+    if quant:
+        in_specs = [q_spec,
+                    pl.BlockSpec((1, bs, H, Dh), kv_idx),
+                    pl.BlockSpec((1, bs, H), sc_idx),
+                    pl.BlockSpec((1, bs, H, Dh), kv_idx),
+                    pl.BlockSpec((1, bs, H), sc_idx)]
+        operands = (q, pool_k, k_scale, pool_v, v_scale)
+    else:
+        in_specs = [q_spec,
+                    pl.BlockSpec((1, bs, H, Dh), kv_idx),
+                    pl.BlockSpec((1, bs, H, Dh), kv_idx)]
+        operands = (q, pool_k, pool_v)
+    return _call_ragged(
+        q, lengths, q_lens, operands, bk=bs, n_kv=T, quant=quant,
+        tabled=True, in_specs=in_specs,
+        scalars=(lengths.astype(jnp.int32), q_lens.astype(jnp.int32),
+                 block_tables.astype(jnp.int32)),
+        interpret=interpret)
+
+
+def ragged_masked_reference(q, k, v, lengths, q_lens=None, k_scale=None,
+                            v_scale=None):
+    """THE masked-gather oracle (f32) — one parameterized reference for
+    every mode and layout: decode (q_lens 1), verify (k+1), prefill
+    chunks, and any mix, contiguous or gathered-from-pool, f32 or int8
+    (dequantized through the per-(position, head) scale planes first).
+    ``q_lens=None`` means every row is live (a full q-block).  Query
+    ``jq`` of slot b sits at absolute position
+    ``lengths[b] - q_lens[b] + jq`` and admits kv positions up to
+    itself; rows past ``q_lens[b]`` clip to the last live position so
+    their (discarded) softmax stays finite; a slot with lengths 0
+    returns zeros.  kernels/decode_attention.py's four per-mode
+    references are thin delegates of this function."""
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[..., None]
+        v = v.astype(jnp.float32) * v_scale[..., None]
+    B, Q = q.shape[:2]
+    if q_lens is None:
+        q_lens = jnp.full((B,), Q, jnp.int32)
+    S = k.shape[1]
+    posq = jnp.clip(
+        (lengths - q_lens)[:, None] + jnp.arange(Q)[None, :], 0,
+        jnp.maximum(lengths - 1, 0)[:, None])              # [B, Q]
+    s = jnp.einsum("bqhd,bshd->bqhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
+    live = jnp.arange(S)[None, None, None, :] <= posq[:, :, None, None]
+    s = jnp.where(live, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bqhs,bshd->bqhd", p, v.astype(jnp.float32))
+    return out * (lengths > 0)[:, None, None, None]
+
+
+def ragged_paged_reference(q, pool_k, pool_v, lengths, q_lens,
+                           block_tables, k_scale=None, v_scale=None):
+    """Gather-then-mask oracle for the block-table mixed kernel:
+    materialize each slot's logical [T*bs] KV view from the pool and
+    delegate to :func:`ragged_masked_reference`."""
+    B = q.shape[0]
+    bs = pool_k.shape[1]
+    T = block_tables.shape[1]
+    k = pool_k[block_tables].reshape(B, T * bs, *pool_k.shape[2:])
+    v = pool_v[block_tables].reshape(B, T * bs, *pool_v.shape[2:])
+    ks = vs = None
+    if k_scale is not None:
+        ks = k_scale[block_tables].reshape(B, T * bs, *k_scale.shape[2:])
+        vs = v_scale[block_tables].reshape(B, T * bs, *v_scale.shape[2:])
+    return ragged_masked_reference(q, k, v, lengths, q_lens, ks, vs)

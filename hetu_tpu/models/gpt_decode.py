@@ -159,6 +159,27 @@ def _resolve_fast(mode=None):
     return jax.default_backend() == "tpu"
 
 
+def resolve_serve_ragged(mode=None):
+    """Mixed-mode ragged dispatch selection (ISSUE 18), shared by the
+    serving engine and its callers: an explicit argument wins; else
+    ``$HETU_SERVE_RAGGED`` ("1" packs arrivals, chunk continuations,
+    spec-verify, and decode streams into ONE ragged wave per step,
+    "0" keeps the phase-split prefill-then-decode scheduler); else
+    auto — mixed on TPU (where the one-dispatch wave erases the phase
+    barrier), phase-split elsewhere (off-TPU the two schedulers cost
+    the same and phase-split is the longer-soaked path)."""
+    if mode is None:
+        mode = envvars.get_str("HETU_SERVE_RAGGED")
+    if isinstance(mode, bool):
+        return mode
+    s = str(mode).strip().lower()
+    if s in ("1", "on", "true", "mixed", "ragged"):
+        return True
+    if s in ("0", "off", "false", "phase", "split", "phased"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def resolve_spec_k(spec=None):
     """Speculative-decoding depth shared by the engine and offline
     ``generate_fast``: an explicit ``spec`` wins (None falls back to
@@ -784,21 +805,38 @@ def _verify_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
     return logits, cache_k, cache_v
 
 
-def _spec_sample(logits, temperature, top_k, rng_keys):
+def _spec_sample(logits, temperature, top_k, rng_keys, first_row=None,
+                 q_len=None):
     """Sequential per-position sampling over a verify q-block: position
     j's token comes from the (j+1)-th split of each slot's rng stream —
     EXACTLY the splits j+1 non-speculative steps would consume — and
     ``keys_after[b, j]`` is the stream state after those splits, so the
     host resumes at the accepted count and the stream stays aligned
-    with the non-speculative path token for token."""
+    with the non-speculative path token for token.
+
+    ``first_row``/``q_len`` [B] generalize this to a MIXED wave: slot b
+    splits its stream only at rows ``first_row[b] <= j < q_len[b]`` —
+    0/1 for a decode slot and 0/k+1 for spec-verify (both sequential
+    splits, as above), ``q_len-1``/``q_len`` for a prompt's FINAL
+    chunk (one split, matching the phase-split prefill paths' single
+    split per prompt), and ``q_len``/anything for a mid-prompt chunk
+    (no split; the returned keys equal the input and the host carries
+    the stream forward untouched).  Rows outside the window still
+    return a (discarded) sample so the wave stays one fused dispatch.
+    None (the default) keeps the pure-verify behavior: split at every
+    row."""
     B, Q = logits.shape[:2]
     toks, after = [], []
     keys = rng_keys
     for j in range(Q):
         splits = jax.vmap(jax.random.split)(keys)          # [B,2,2]
-        keys, subs = splits[:, 0], splits[:, 1]
+        if first_row is None:
+            keys = splits[:, 0]
+        else:
+            do = (j >= first_row) & (j < q_len)            # [B]
+            keys = jnp.where(do[:, None], splits[:, 0], keys)
         toks.append(jax.vmap(_sample_slot)(logits[:, j], temperature,
-                                           top_k, subs))
+                                           top_k, splits[:, 1]))
         after.append(keys)
     return jnp.stack(toks, 1), jnp.stack(after, 1)
 
@@ -943,6 +981,219 @@ def _serve_prefill_batch_paged(params, cfg_tuple, cache_k, cache_v,
     new_keys, subs = splits[:, 0], splits[:, 1]
     first = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
     return first, cache_k, cache_v, new_keys
+
+
+# --- mixed-mode ragged dispatch (ISSUE 18) ------------------------- #
+# ONE jitted core for the whole hot loop.  The phase-split engine runs
+# up to three kernel families per scheduler iteration (flash prefill,
+# decode, spec-verify) with a host barrier between the phases; the
+# mixed step consumes a RAGGED WAVE DESCRIPTOR — per-slot q_len + a
+# token block — in which a decode stream is a q-block of 1, a
+# spec-verify wave k+1, and a prompt (or prompt chunk) its chunk
+# width, all scored by one dispatch.  ``_verify_step`` was already
+# this computation for the uniform-mode case; ``_mixed_step``
+# generalizes its attention to per-slot SELF-FRESHNESS so every
+# phase-split path's exact arithmetic survives the merge (see below),
+# which is what keeps greedy outputs token-identical ragged-vs-phased
+# across contiguous/paged/int8/spec/chunked configs.
+
+
+def _mixed_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
+                q_len, self_fresh, attn="masked", block_tables=None,
+                has_fresh=False):
+    """One MIXED wave: slot b consumes ``tokens[b, :q_len[b]]`` at
+    positions ``pos[b] .. pos[b]+q_len[b]-1`` — whatever mode those
+    tokens are (prompt chunk, draft+bonus verify block, single decode
+    token).  Returns (logits [B, Q, V] f32, cache_k, cache_v); row
+    ``logits[b, j]`` is the next-token distribution after input j.
+    Dead positions and dead slots (``q_len`` 0) follow
+    ``_verify_step``'s write/mask conventions exactly.
+
+    The masked path's DEFAULT attention is ``_verify_step``'s full
+    causal mask over the just-written cache, bit for bit — so decode,
+    spec-verify, and contiguous-prefill slots produce exactly the
+    phase-split engine's logits (write-then-read self arithmetic,
+    including the int8 round-trip).  Paged PROMPT-CHUNK slots are the
+    one mode whose phase-split comparator (``_serve_prefill_chunk``)
+    keeps the chunk's own K/V FRESH; when a wave carries any
+    (``has_fresh``, static — steady-state decode waves skip the extra
+    compute entirely), the fresh-self two-part variant (context masked
+    strictly below ``pos`` + causal scores over the in-flight q-block)
+    is computed as well and selected for the slots ``self_fresh`` [B]
+    marks.  The ragged path hands the whole wave to the mixed-mode
+    kernel, which reads everything back from the pool (the fast path's
+    existing round-trip semantics)."""
+    name, L, H, Dh, S_max = cfg_tuple
+    B, Q = tokens.shape
+    hdim = H * Dh
+    paged = block_tables is not None
+    bidx = jnp.arange(B)
+    posns = pos[:, None] + jnp.arange(Q)[None, :]          # [B, Q]
+    valid = jnp.arange(Q)[None, :] < q_len[:, None]        # [B, Q]
+    lens = (pos + q_len).astype(jnp.int32)   # filled after the writes
+    wpe = params[f"{name}_wpe"]
+    h = params[f"{name}_wte_table"][tokens] \
+        + wpe[jnp.clip(posns, 0, wpe.shape[0] - 1)]        # [B, Q, hd]
+    if attn == "ragged":
+        from ..kernels.ragged_attention import (
+            ragged_attention, ragged_paged_attention,
+        )
+    if paged:
+        bs_blk = _kv_shape(cache_k)[2]
+        T = block_tables.shape[1]
+        posc = jnp.clip(posns, 0, S_max - 1)
+        wblk = jnp.where(valid,
+                         block_tables[bidx[:, None], posc // bs_blk], 0)
+        woff = posc % bs_blk
+        span = T * bs_blk
+    else:
+        span = S_max
+    ctx = jnp.arange(span)[None, None, :]
+    live = ctx <= posns[:, :, None]                        # [B, Q, S]
+    # fresh-self variant: context strictly below the write window plus
+    # a causal mask over the in-flight q-block
+    ctx_live = (jnp.arange(span)[None, :] < pos[:, None])  # [B, S]
+    jj = jnp.arange(Q)
+    self_live = (jj[None, None, :] <= jj[None, :, None]) \
+        & valid[:, None, :]                                # [B, Q, Q]
+    scale = Dh ** -0.5
+    quant = _kv_q(cache_k)
+    for i in range(L):
+        us = f"{name}_h{i}"
+        x = _ln(h, params[f"{us}_ln1_scale"], params[f"{us}_ln1_bias"])
+        q = (x @ params[f"{us}_attn_q_weight"]
+             + params[f"{us}_attn_q_bias"]).reshape(B, Q, H, Dh)
+        k = (x @ params[f"{us}_attn_k_weight"]
+             + params[f"{us}_attn_k_bias"]).reshape(B, Q, H, Dh)
+        v = (x @ params[f"{us}_attn_v_weight"]
+             + params[f"{us}_attn_v_bias"]).reshape(B, Q, H, Dh)
+        if paged:
+            cache_k = _kv_scatter(cache_k, (i, wblk, woff), k)
+            cache_v = _kv_scatter(cache_v, (i, wblk, woff), v)
+        else:
+            # descending j: dead (clipped) tail first, live wins last
+            for jq in reversed(range(Q)):
+                pw = jnp.minimum(posns[:, jq], S_max - 1)
+                cache_k = _kv_scatter(cache_k, (i, bidx, pw), k[:, jq])
+                cache_v = _kv_scatter(cache_v, (i, bidx, pw), v[:, jq])
+        if quant:
+            ks, ksc = cache_k[0][i], cache_k[1][i]
+            vs, vsc = cache_v[0][i], cache_v[1][i]
+        else:
+            ks, vs = cache_k[i], cache_v[i]
+            ksc = vsc = None
+        if paged and attn == "ragged":
+            o = ragged_paged_attention(
+                q, ks, vs, lens, q_len, block_tables, k_scale=ksc,
+                v_scale=vsc).reshape(B, Q, hdim)
+        elif attn == "ragged":
+            o = ragged_attention(q, ks, vs, lens, q_len, k_scale=ksc,
+                                 v_scale=vsc).reshape(B, Q, hdim)
+        else:
+            if paged:
+                kg = ks[block_tables].reshape(B, span, H, Dh)
+                vg = vs[block_tables].reshape(B, span, H, Dh)
+                if ksc is not None:
+                    kg = kg.astype(jnp.float32) * ksc[
+                        block_tables].reshape(B, span, H)[..., None]
+                    vg = vg.astype(jnp.float32) * vsc[
+                        block_tables].reshape(B, span, H)[..., None]
+            else:
+                kg, vg = ks, vs
+                if ksc is not None:
+                    kg = kv_decode(kg, ksc)
+                    vg = kv_decode(vg, vsc)
+            # default: _verify_step's full mask over the written cache
+            s_raw = jnp.einsum("bqhd,bshd->bqhs", q, kg) * scale
+            sw = jnp.where(live[:, :, None, :], s_raw, NEG_INF)
+            p = jax.nn.softmax(sw, axis=-1)
+            o = jnp.einsum("bqhs,bshd->bqhd", p, vg)
+            if has_fresh:
+                # _serve_prefill_chunk's arithmetic for chunk slots:
+                # read-back context + the chunk's own FRESH K/V
+                s1 = jnp.where(ctx_live[:, None, None, :], s_raw,
+                               NEG_INF)
+                s2 = jnp.einsum("bqhd,bjhd->bqhj", q, k) * scale
+                s2 = jnp.where(self_live[:, :, None, :], s2, NEG_INF)
+                pf = jax.nn.softmax(
+                    jnp.concatenate([s1, s2], axis=-1), axis=-1)
+                o_fresh = jnp.einsum("bqhs,bshd->bqhd",
+                                     pf[..., :span], vg) \
+                    + jnp.einsum("bqhj,bjhd->bqhd", pf[..., span:], v)
+                o = jnp.where(self_fresh[:, None, None, None],
+                              o_fresh, o)
+            o = o.reshape(B, Q, hdim)
+        o = o @ params[f"{us}_attn_proj_weight"] \
+            + params[f"{us}_attn_proj_bias"]
+        h = h + o
+        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
+                       + params[f"{us}_ffn_wi_bias"])
+        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
+        h = h + f
+    h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
+    logits = (h @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
+        + params.get(f"{name}_head_bias", 0.0)
+    return logits, cache_k, cache_v
+
+
+def _serve_mixed(params, cfg_tuple, cache_k, cache_v, pos, tokens,
+                 q_len, first_row, self_fresh, temperature, top_k,
+                 rng_keys, attn="masked"):
+    """One fused MIXED wave over all slots (contiguous layout): write +
+    score every slot's ragged q-block, then sample each slot's live
+    sampling window from its own rng stream (``first_row`` per
+    ``_spec_sample``).  Returns (sampled [B, Q], cache_k, cache_v,
+    keys_after [B, Q, 2])."""
+    logits, cache_k, cache_v = _mixed_step(
+        params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
+        self_fresh, attn=attn)
+    sampled, after = _spec_sample(logits, temperature, top_k, rng_keys,
+                                  first_row, q_len)
+    return sampled, cache_k, cache_v, after
+
+
+def _serve_mixed_paged(params, cfg_tuple, cache_k, cache_v, tables,
+                       pos, tokens, q_len, first_row, self_fresh,
+                       temperature, top_k, rng_keys, attn="masked",
+                       has_fresh=False):
+    """``_serve_mixed`` over the block-table paged pool (``q_len`` 0
+    marks inert slots, whose writes route to scratch block 0 and whose
+    samples/keys the host discards).  ``has_fresh`` (static) marks
+    waves carrying prompt-chunk slots — see ``_mixed_step``."""
+    logits, cache_k, cache_v = _mixed_step(
+        params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
+        self_fresh, attn=attn, block_tables=tables,
+        has_fresh=has_fresh)
+    sampled, after = _spec_sample(logits, temperature, top_k, rng_keys,
+                                  first_row, q_len)
+    return sampled, cache_k, cache_v, after
+
+
+@functools.lru_cache(maxsize=None)
+def serve_mixed_fn(donate=True, attn="masked"):
+    """Jitted ``_serve_mixed`` — the contiguous mixed-mode wave (see
+    ``serve_prefill_fn`` for the donation rationale).  Compiles per
+    q-block bucket Q; the engine pow2-buckets the wave width, so the
+    ladder is log-bounded."""
+    kw = {"static_argnames": ("cfg_tuple", "attn")}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    fn = jax.jit(_serve_mixed, **kw)
+    return functools.partial(fn, attn=attn)
+
+
+@functools.lru_cache(maxsize=None)
+def serve_mixed_paged_fn(donate=True, attn="masked"):
+    """Jitted ``_serve_mixed_paged`` — the block-table mixed-mode wave,
+    the production dispatch behind ``$HETU_SERVE_RAGGED``.  Compiles
+    per (Q bucket, has_fresh): steady-state decode waves skip the
+    chunk-slot variant's extra softmax entirely."""
+    kw = {"static_argnames": ("cfg_tuple", "attn", "has_fresh")}
+    if donate:
+        kw["donate_argnums"] = (2, 3)
+    fn = jax.jit(_serve_mixed_paged, **kw)
+    return functools.partial(fn, attn=attn)
 
 
 @functools.lru_cache(maxsize=None)

@@ -35,12 +35,14 @@ from ..telemetry import flight
 from ..telemetry import slo as slo_mod
 from ..models.gpt_decode import (
     _infer_name, _prep_param, _pow2, _resolve_fast, resolve_draft_layers,
-    resolve_spec_k, serve_decode_fn, serve_decode_paged_fn,
+    resolve_serve_ragged, resolve_spec_k, serve_decode_fn,
+    serve_decode_paged_fn, serve_mixed_fn, serve_mixed_paged_fn,
     serve_prefill_batch_fn, serve_prefill_batch_paged_fn,
     serve_prefill_chunk_fn, serve_prefill_fn, serve_verify_fn,
     serve_verify_paged_fn, spec_propose_fn,
 )
-from .kv_manager import (KVCacheManager, PagedKVManager, resolve_kv_block,
+from .kv_manager import (KVCacheManager, PagedKVManager,
+                         assemble_mixed_wave, resolve_kv_block,
                          resolve_kv_quant)
 from .metrics import ServingMetrics
 from .request import Request, Result
@@ -101,6 +103,18 @@ class ServingEngine:
     with paged/prefix-shared/chunked/int8 KV, the fast path, TP, and
     the fleet router; the draft keeps its own small contiguous cache.
 
+    ragged (``$HETU_SERVE_RAGGED``, auto = mixed on TPU): MIXED-MODE
+    RAGGED DISPATCH — every scheduler iteration packs fresh-prompt
+    prefills, chunk continuations, spec-verify blocks, and plain
+    decode into ONE ragged wave (per-slot ``q_len``) and launches ONE
+    fused step, instead of the phase-split prefill-then-decode
+    cadence.  Decode slots no longer stall behind another request's
+    prompt chunks (the ``chunk_stall`` lifecycle component collapses
+    to ~0) and a step costs one dispatch regardless of the mode mix.
+    Greedy outputs stay token-identical to the phase-split scheduler
+    across every layout (contiguous/paged, int8, chunked, prefix
+    sharing, speculation) — the parity suite pins it.
+
     Composes with ``tp_shard_params``: pass the placed dict and the
     fused step runs tensor-parallel (``_prep_param`` preserves the
     NamedShardings; GSPMD propagates them through prefill and decode).
@@ -120,7 +134,7 @@ class ServingEngine:
                  donate=True, fast_path=None, paged=None, kv_block=None,
                  pool_blocks=None, prefix_share=None, prefill_chunk=None,
                  kv_quant=None, slo=None, tags=None, spec=None,
-                 spec_adapt=None, spec_draft_layers=None):
+                 spec_adapt=None, spec_draft_layers=None, ragged=None):
         c = config
         self._name = _infer_name(params, name)
         # dtype=None FOLLOWS the params: bf16 weights stay bf16 and the
@@ -260,6 +274,17 @@ class ServingEngine:
             self._spec_acc = np.zeros(B, np.int64)
             self._spec_prop = np.zeros(B, np.int64)
             self._spec_bonus = np.zeros(B, np.int64)
+        # ---- mixed-mode ragged dispatch (ragged=/$HETU_SERVE_RAGGED):
+        # arrivals, chunk continuations, spec-verify, and decode pack
+        # into ONE ragged wave per step (see class docstring) ---- #
+        self.ragged = resolve_serve_ragged(ragged)
+        if self.ragged:
+            attn = "ragged" if self.fast_path else "masked"
+            self._mixed = (serve_mixed_paged_fn(donate, attn)
+                           if self.paged else serve_mixed_fn(donate, attn))
+            # tells the lifecycle accountant the wave IS the prefill:
+            # chunk_stall residue is asserted near-zero and folded
+            self.metrics.mixed_mode = True
 
     # ------------------------------------------------------------- #
     # live weight sync (serving/weight_sync.py)
@@ -370,6 +395,8 @@ class ServingEngine:
         (``$HETU_FLIGHT_LOG``) before propagating — the black box holds
         the records leading into the fault."""
         try:
+            if self.ragged:
+                return self._step_mixed()
             if self.paged:
                 return self._step_paged()
             return self._step_contiguous()
@@ -782,7 +809,8 @@ class ServingEngine:
         self._tok[slot] = tok0
         self._keys[slot] = key
         self._gen[slot] = [tok0]
-        self.kv.register_prefix(self._prompt_arr[slot], slot)
+        if self.paged:
+            self.kv.register_prefix(self._prompt_arr[slot], slot)
         self.metrics.record_admit(
             req.request_id, slot, now - req.submitted_at,
             now - req.submitted_at)
@@ -874,6 +902,246 @@ class ServingEngine:
             self._prefill_off[slot] = len(self._prompt_arr[slot])
         return ([int(first[i]) for i in range(n)],
                 [new_keys[i] for i in range(n)])
+
+    # ------------------------------------------------------------- #
+    # mixed-mode ragged dispatch (ragged=/$HETU_SERVE_RAGGED)
+    # ------------------------------------------------------------- #
+
+    def _admit_contiguous_mixed(self):
+        """Contiguous admission WITHOUT the eager prefill: the claimed
+        slot's prompt joins this step's mixed wave as one ragged
+        q-block (``_gen = None`` marks it mid-prefill, exactly like the
+        paged scheduler's chunk slots)."""
+        admitted = []
+        while self._queue and self.kv.free_slots:
+            req = self._queue.popleft()
+            t_a = time.perf_counter()
+            slot = self.kv.alloc(req.request_id, len(req.prompt))
+            self.metrics.lc_claimed(
+                req.request_id, (time.perf_counter() - t_a) * 1e3)
+            self._reqs[slot] = req
+            self._slot_version[slot] = self.weight_version
+            self._gen[slot] = None
+            self._prompt_arr[slot] = np.asarray(req.prompt, np.int32)
+            self._prefill_off[slot] = 0
+            self._pos[slot] = 0
+            self._tok[slot] = 0
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._keys[slot] = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32)
+            admitted.append(slot)
+        if admitted:
+            telemetry.inc("serve.admission_waves")
+        return admitted
+
+    def _step_mixed(self):
+        """One MIXED-MODE scheduler iteration: admissions, chunk
+        continuations, spec-verify blocks, and plain decode pack into
+        ONE ragged wave descriptor (per-slot ``q_len``/``first_row``)
+        and launch as ONE fused dispatch — no prefill/decode phase
+        barrier, so a decode slot never stalls behind another
+        request's prompt chunks.  Token-identical to the phase-split
+        schedulers: every slot's write positions, attention masks, and
+        rng splits reproduce exactly what its mode's dedicated step
+        would have done."""
+        done = []
+        # admission reuses the phase-split claim paths unchanged
+        # (prefix sharing/COW, tier fetch, deferral, backpressure) —
+        # minus the eager prefill: prompts join THIS step's wave
+        if self.paged:
+            self._admit_paged()
+        else:
+            self._admit_contiguous_mixed()
+        live = self.kv.live()
+        if not live:
+            return done
+        self.peak_live = max(self.peak_live, len(live))
+        B = self.kv.n_slots
+        pre = [s for s in live if self._gen[s] is None]
+        decoding = [s for s in live if self._gen[s] is not None]
+        wave_reqs = [self._reqs[s].request_id for s in live]
+        t0 = time.perf_counter()
+        # speculative draft rides AHEAD of the wave exactly as in the
+        # phase-split spec scheduler (mid-prefill slots' rows are dead)
+        k_cur = 0
+        draft = None
+        if decoding and self.spec_k:
+            k_cur = self._spec_kcur
+            draft, dck, dcv = self._propose(
+                self.params, self.cfg_tuple_draft,
+                self._draft_ck, self._draft_cv,
+                self._pos.copy(), self._tok.copy(), k=k_cur)
+            self._draft_ck, self._draft_cv = dck, dcv
+            draft = np.asarray(draft)
+        entries = {}
+        chunk_take = {}   # slot -> (take, final) for prefill q-blocks
+        for s in pre:
+            prompt = self._prompt_arr[s]
+            P = len(prompt)
+            off = int(self._prefill_off[s])
+            if self.paged and self.chunk > 0:
+                C_b = min(_pow2(self.chunk, floor=8), self.kv.s_max)
+                take = min(self.chunk, C_b, P - off)
+            else:
+                take = P - off
+            final = off + take >= P
+            # only the final chunk samples (and splits the rng) — at
+            # its last row; mid-prompt chunks pass first_row == q_len
+            entries[s] = ([int(t) for t in prompt[off:off + take]],
+                          off, take - 1 if final else take, self.paged)
+            chunk_take[s] = (take, final)
+        qlen_v = {}
+        for s in decoding:
+            if k_cur:
+                rem = self._reqs[s].max_new_tokens - len(self._gen[s])
+                ql = min(k_cur + 1, rem,
+                         self.kv.s_max - int(self._pos[s]))
+                toks = ([int(self._tok[s])]
+                        + [int(t) for t in draft[s, :ql - 1]])
+                qlen_v[s] = ql
+            else:
+                toks = [int(self._tok[s])]
+            entries[s] = (toks, int(self._pos[s]), 0, False)
+        wave = assemble_mixed_wave(B, entries)
+        if self.paged:
+            sampled, ck, cv, after = self._mixed(
+                self.params, self.cfg_tuple,
+                self.kv.cache_k, self.kv.cache_v,
+                self.kv.tables.copy(), wave["pos"], wave["tokens"],
+                wave["q_len"], wave["first_row"], wave["self_fresh"],
+                self._temp, self._topk, self._keys,
+                has_fresh=bool(pre))
+        else:
+            sampled, ck, cv, after = self._mixed(
+                self.params, self.cfg_tuple,
+                self.kv.cache_k, self.kv.cache_v,
+                wave["pos"], wave["tokens"], wave["q_len"],
+                wave["first_row"], wave["self_fresh"],
+                self._temp, self._topk, self._keys)
+        self.kv.cache_k, self.kv.cache_v = ck, cv
+        sampled = np.asarray(sampled)
+        after = np.array(after, np.uint32)
+        dt = time.perf_counter() - t0
+        # ---- per-mode unpack: prefill q-blocks ---- #
+        q_pre = 0
+        pre_credit = {}
+        if pre:
+            self.prefill_dispatches += 1
+        for s in pre:
+            req = self._reqs[s]
+            take, final = chunk_take[s]
+            q_pre += take
+            if self.paged:
+                self.kv.advance(s, take)
+                self.prefill_chunks += 1
+                telemetry.inc("serve.prefill_chunks")
+            self._prefill_off[s] += take
+            # the whole fused wave IS this request's prefill compute —
+            # there is no separate decode phase to stall behind, so
+            # the lifecycle's chunk_stall residue collapses to ~0.
+            # Credit the elapsed wall since dispatch, not just dt:
+            # an earlier slot's _finish_prefill in this same loop can
+            # compile the draft prefill (~100s of ms once per process)
+            # and that wall sits inside THIS request's prefill span
+            # too; _retire clamps the credit to the observed wall, so
+            # over-crediting is safe and the stall residue stays ~0.
+            # (A LATER slot's compile is covered by the end-of-wave
+            # top-up below — this eager credit exists so a request
+            # that retires AT prefill still carries its share.)
+            e = time.perf_counter() - t0
+            self.metrics.lc_prefill(req.request_id, e)
+            pre_credit[req.request_id] = e
+            if final:
+                r = self._finish_prefill(
+                    s, int(sampled[s, take - 1]),
+                    np.asarray(after[s, take - 1], np.uint32))
+                if r:
+                    done.append(r)
+        if pre:
+            self.metrics.record_prefill(len(pre), wave["q"], dt,
+                                        batched=True)
+        # ---- verify / decode q-blocks ---- #
+        n_dec = 0
+        wave_emit = wave_acc = wave_prop = 0
+        for s in decoding:
+            req = self._reqs[s]
+            if k_cur:
+                ql = qlen_v[s]
+                toks = entries[s][0]
+                a = 0
+                while a < ql - 1 and sampled[s, a] == toks[a + 1]:
+                    a += 1
+                emit = [int(t) for t in sampled[s, :a + 1]]
+                if req.eos_id is not None and req.eos_id in emit:
+                    emit = emit[:emit.index(req.eos_id) + 1]
+                n_emit = len(emit)
+                accepted = min(a, n_emit)
+                wave_emit += n_emit
+                wave_acc += accepted
+                wave_prop += ql - 1
+                self._spec_acc[s] += accepted
+                self._spec_prop[s] += ql - 1
+                self._spec_bonus[s] += n_emit - accepted
+                base = int(self._pos[s])
+                self.kv.advance(s, ql)
+                self.kv.truncate(s, base + n_emit)
+                self._pos[s] = base + n_emit
+                self._tok[s] = emit[-1]
+                self._keys[s] = after[s, n_emit - 1]
+                self._gen[s].extend(emit)
+                if req.stream_cb:
+                    for t in emit:
+                        req.stream_cb(req, t)
+                r = self._maybe_finish(s, emit[-1])
+            else:
+                t = int(sampled[s, 0])
+                n_dec += 1
+                self._pos[s] += 1
+                self._tok[s] = t
+                self._keys[s] = after[s, 0]
+                self._gen[s].append(t)
+                self.kv.advance(s)
+                if req.stream_cb:
+                    req.stream_cb(req, t)
+                r = self._maybe_finish(s, t)
+            if r:
+                done.append(r)
+        if pre_credit:
+            # top every still-live prefill rider up to the FULL wave
+            # elapsed: a later slot's _finish_prefill (draft-prefill
+            # compile) or the verify/decode unpack runs after the
+            # rider's eager credit above but inside its prefill wall —
+            # without this the difference surfaces as a phantom
+            # chunk_stall residue (lc_prefill no-ops for requests that
+            # already retired; _retire clamps over-credit to the wall)
+            t_wave = time.perf_counter() - t0
+            for rid, e in pre_credit.items():
+                if t_wave > e:
+                    self.metrics.lc_prefill(rid, t_wave - e,
+                                            count=False)
+        self.steps += 1
+        spec = None
+        if k_cur:
+            self.spec_waves += 1
+            self.spec_k_sum += k_cur
+            self.spec_proposed += wave_prop
+            self.spec_accepted += wave_acc
+            self.spec_emitted += wave_emit
+            self._acc_window.append((wave_acc, wave_prop))
+            self._adapt_k()
+            spec = {"k": k_cur, "proposed": wave_prop,
+                    "accepted": wave_acc}
+        q_ver = sum(qlen_v.values())
+        q_tot = max(q_pre + q_ver + n_dec, 1)
+        self.metrics.record_step(
+            live=len(live), slots=B, queue_depth=len(self._queue),
+            dt_s=dt, new_tokens=wave_emit if k_cur else n_dec,
+            prefill_s=dt * q_pre / q_tot, step=self.steps,
+            requests=wave_reqs, end_perf=t0 + dt, spec=spec,
+            mix={"q_prefill": q_pre, "q_verify": q_ver,
+                 "q_decode": n_dec})
+        return done
 
     # ------------------------------------------------------------- #
     # speculative decoding (spec=/$HETU_SPEC_K)

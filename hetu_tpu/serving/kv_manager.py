@@ -51,6 +51,53 @@ def _bucket_prompt(p, s_max, pos_cap):
     return b
 
 
+def assemble_mixed_wave(n_slots, entries, q_floor=1):
+    """Pack per-slot ragged q-blocks into ONE padded mixed-wave
+    descriptor (the `$HETU_SERVE_RAGGED` hot loop).
+
+    ``entries`` maps slot -> ``(tokens, pos, first_row, self_fresh)``:
+
+    * ``tokens``     the slot's q-block this step — a full prompt, a
+                     prompt chunk, ``[cur] + draft`` for spec-verify,
+                     or ``[cur]`` for plain decode (len >= 1);
+    * ``pos``        cache position of ``tokens[0]``;
+    * ``first_row``  index of the first row whose rng stream splits
+                     (== ``len(tokens)`` for mid-prompt chunks that
+                     sample nothing);
+    * ``self_fresh`` True when the q-block's own K/V must be read
+                     through the two-part fresh-self softmax (paged
+                     prompt chunks) rather than the written cache.
+
+    Width is bucketed to a power of two so waves with nearby shapes
+    land on the same jit entry.  Slots absent from ``entries`` ride
+    along inactive (``q_len = 0``): the kernel masks their attention
+    and their clipped writes land on dead positions, same as free
+    slots in the phase-split decode wave.
+    """
+    width = max((len(t) for t, *_ in entries.values()), default=1)
+    q = round_up_pow2(width, floor=q_floor)
+    tokens = np.zeros((n_slots, q), np.int32)
+    pos = np.zeros(n_slots, np.int32)
+    q_len = np.zeros(n_slots, np.int32)
+    first_row = np.zeros(n_slots, np.int32)
+    self_fresh = np.zeros(n_slots, bool)
+    for s, (toks, p, fr, fresh) in entries.items():
+        n = len(toks)
+        tokens[s, :n] = toks
+        pos[s] = p
+        q_len[s] = n
+        first_row[s] = fr
+        self_fresh[s] = fresh
+    return {
+        "q": q,
+        "tokens": tokens,
+        "pos": pos,
+        "q_len": q_len,
+        "first_row": first_row,
+        "self_fresh": self_fresh,
+    }
+
+
 def _is_int8(dtype):
     """True when ``dtype`` selects the quantized int8 cache layout
     (the string sentinel "int8" or jnp.int8 itself)."""

@@ -189,6 +189,13 @@ class ServingMetrics(MetricsCore):
         self.prefill_reqs = 0      # requests prefilled
         self.prefill_batched = 0   # batched (fast-path) dispatches
         self.components = {c: [] for c in COMPONENTS}
+        # mixed-mode ragged dispatch ($HETU_SERVE_RAGGED): the engine
+        # sets this when every step is ONE unified wave — prefill
+        # attribution then covers the whole ragged dispatch, so the
+        # chunk_stall component is asserted near-zero at retirement and
+        # folded to exactly 0 (kept in COMPONENTS for back-compat:
+        # dashboards and the tail report keep their schema)
+        self.mixed_mode = False
         # per-request breakdowns explain_tail() slices (ring: the tail
         # report is about RECENT behavior, same cap as the event ring)
         cap = max(1, envvars.get_int("HETU_TELEMETRY_BUFFER"))
@@ -217,13 +224,17 @@ class ServingMetrics(MetricsCore):
             lc.t_claim = time.perf_counter()
             lc.kv_alloc_ms = float(kv_alloc_ms)
 
-    def lc_prefill(self, request_id, dt_s):
+    def lc_prefill(self, request_id, dt_s, count=True):
         """Attribute one prefill dispatch's wall time to this request
-        (a chunked prompt accumulates across chunks)."""
+        (a chunked prompt accumulates across chunks).  ``count=False``
+        adds wall without counting a dispatch — the mixed-mode engine
+        uses it to top a rider up to the full wave elapsed after the
+        wave's unpack completes."""
         lc = self._lc.get(request_id)
         if lc is not None:
             lc.prefill_ms += dt_s * 1e3
-            lc.n_prefills += 1
+            if count:
+                lc.n_prefills += 1
 
     def lc_handoff(self, request_id, handoff_ms):
         """Credit the prefill->decode disaggregation detour: wall time
@@ -261,7 +272,7 @@ class ServingMetrics(MetricsCore):
 
     def record_step(self, live, slots, queue_depth, dt_s, new_tokens,
                     prefill_s=0.0, step=None, requests=None,
-                    end_perf=None, spec=None):
+                    end_perf=None, spec=None, mix=None):
         """One fused decode step; ``prefill_s`` is the prefill wall time
         this scheduler iteration paid before decoding, so the per-step
         JSONL event attributes the phases separately (the masked vs
@@ -279,7 +290,13 @@ class ServingMetrics(MetricsCore):
         histogram — TPOT is computed from these, never from a
         one-token-per-step assumption.  ``spec`` (a
         {k, proposed, accepted} dict) stamps a speculative wave's
-        draft accounting onto the event."""
+        draft accounting onto the event.
+
+        ``mix`` (a {q_prefill, q_verify, q_decode} dict, mixed-mode
+        engines only) stamps the wave's per-mode q-token split onto the
+        event — how many of the ragged dispatch's query rows were
+        prompt prefill, spec-verify, and plain decode (hetu_top's
+        mixed-wave columns and the tail report read these)."""
         self._mark()
         self._slots = slots
         self.step_live.append(live)
@@ -300,6 +317,10 @@ class ServingMetrics(MetricsCore):
             fields["spec_k"] = int(spec.get("k", 0))
             fields["spec_proposed"] = int(spec.get("proposed", 0))
             fields["spec_accepted"] = int(spec.get("accepted", 0))
+        if mix is not None:
+            fields["q_prefill"] = int(mix.get("q_prefill", 0))
+            fields["q_verify"] = int(mix.get("q_verify", 0))
+            fields["q_decode"] = int(mix.get("q_decode", 0))
         self.event("serve_step", live=live, queue_depth=queue_depth,
                    slots=slots, new_tokens=int(new_tokens),
                    prefill_ms=round(prefill_s * 1e3, 3),
@@ -336,6 +357,17 @@ class ServingMetrics(MetricsCore):
         prefill_wall_ms = max(lc.t_first - claim_end, 0.0) * 1e3
         prefill_ms = min(lc.prefill_ms, prefill_wall_ms)
         chunk_stall_ms = max(prefill_wall_ms - prefill_ms, 0.0)
+        if self.mixed_mode:
+            # unified wave: the whole ragged dispatch IS this request's
+            # prefill compute — any residue is host bookkeeping between
+            # claim and dispatch, noise-scale by construction.  Assert
+            # that (an accounting regression shows up HERE, not as a
+            # quietly wrong dashboard) and fold the component to 0.
+            assert chunk_stall_ms <= max(50.0, 0.5 * prefill_wall_ms), (
+                f"mixed-mode chunk_stall residue {chunk_stall_ms:.1f}ms "
+                f"of {prefill_wall_ms:.1f}ms prefill wall for "
+                f"{request_id}: wave attribution is broken")
+            chunk_stall_ms = 0.0
         decode_ms = max(now - lc.t_first, 0.0) * 1e3 \
             if n_generated > 1 else 0.0
         ttft_ms = max(lc.t_first - lc.t_submit, 0.0) * 1e3
@@ -491,12 +523,20 @@ class ServingMetrics(MetricsCore):
             "components_mean_ms": {c: round(v, 3)
                                    for c, v in means.items()},
             "tail_requests": [b["request"] for b in tail[:8]],
+            "mixed_mode": self.mixed_mode,
         }
         report["summary"] = (
             f"p{q} TTFT {cut:.1f}ms ({len(tail)}/{len(rows)} requests): "
             f"dominated by {dominant.replace('_ms', '')} "
             f"({ttft_parts[dominant]:.1f}ms, {share:.0%} of the "
             f"pre-token wall)")
+        if self.mixed_mode:
+            # the unified wave carries all modes: prefill_ms here means
+            # "ragged dispatches this prompt rode in" and chunk_stall
+            # is 0 by construction (folded at retirement)
+            report["summary"] += (
+                " [mixed-mode: prefill attributed to unified ragged "
+                "waves; chunk_stall folded to 0]")
         return report
 
 

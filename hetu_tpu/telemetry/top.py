@@ -146,12 +146,22 @@ def summarize(events, window=512):
         tpot_ms = step_tpot
     drafted = accepted = 0
     spec_ks = []
+    # mixed-mode ragged dispatch ($HETU_SERVE_RAGGED): serve_step
+    # events carry the wave's per-mode q-token split — how many query
+    # rows were prompt prefill vs spec-verify vs plain decode
+    mix_tot = {"q_prefill": 0, "q_verify": 0, "q_decode": 0}
+    mix_steps = 0
     for s in steps:
         if isinstance(s.get("spec_proposed"), int):
             drafted += s["spec_proposed"]
             accepted += s.get("spec_accepted", 0)
             if isinstance(s.get("spec_k"), int):
                 spec_ks.append(s["spec_k"])
+        if isinstance(s.get("q_prefill"), int):
+            mix_steps += 1
+            for f in mix_tot:
+                mix_tot[f] += s.get(f, 0) or 0
+    mix = {**mix_tot, "steps": mix_steps} if mix_steps else None
     spec = {
         "drafted": drafted,
         "accepted": accepted,
@@ -183,6 +193,7 @@ def summarize(events, window=512):
         "tpot_p99_ms": _pct_ms(tpot_ms, 99),
         "requests": counts,
         "spec": spec,
+        "mix": mix,
         "slo": slo,
         "flight_dumps": flight_dumps,
         "weight_version": weight_version,
@@ -207,6 +218,7 @@ def summarize_fleet(events, window=4096):
             "requeued": 0, "rejects": 0, "deaths": 0, "restarts": 0,
             "finished": 0, "drafted": 0, "accepted": 0,
             "dir_lookups": 0, "dir_hits": 0,
+            "q_prefill": 0, "q_verify": 0, "q_decode": 0,
         })
 
     shed = {"latency": 0, "throughput": 0}
@@ -244,6 +256,11 @@ def summarize_fleet(events, window=4096):
             if isinstance(e.get("spec_proposed"), int):
                 r["drafted"] += e["spec_proposed"]
                 r["accepted"] += e.get("spec_accepted", 0)
+            if isinstance(e.get("q_prefill"), int):
+                # mixed-mode wave: per-replica mode split
+                r["q_prefill"] += e["q_prefill"]
+                r["q_verify"] += e.get("q_verify", 0) or 0
+                r["q_decode"] += e.get("q_decode", 0) or 0
         elif kind == "slo_health" and rep is not None:
             row(rep)["health"] = e.get("state")
         elif kind == "serve_finish" and rep is not None:
@@ -381,10 +398,15 @@ def render_fleet(stats, clock=None):
         f"{'health':<9} {'occ':>5} "
         f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
         f"{'requeued':>8} {'rejects':>7} {'deaths':>6} "
-        f"{'drafted':>7} {'acc':>5} {'dir%':>5}",
+        f"{'drafted':>7} {'acc':>5} {'dir%':>5} "
+        f"{'qpre':>6} {'qver':>6} {'qdec':>6}",
     ]
     for r in stats["replicas"]:
         ver = r.get("version")
+        # mixed-mode columns stay "-" for phase-split replicas (their
+        # serve_step events carry no per-mode q split)
+        mixed = (r.get("q_prefill", 0) or r.get("q_verify", 0)
+                 or r.get("q_decode", 0))
         lines.append(
             f"{r['replica']:>3} {r['state']:<7} "
             f"{str(r.get('life') or '-'):<8} "
@@ -397,7 +419,10 @@ def render_fleet(stats, clock=None):
             f"{r['routed']:>6} {r['requeued']:>8} {r['rejects']:>7} "
             f"{r['deaths']:>6} {r['drafted']:>7} "
             f"{_fmt(r['acceptance'], nd=2):>5} "
-            f"{_fmt(r.get('dir_hit_rate'), nd=2):>5}")
+            f"{_fmt(r.get('dir_hit_rate'), nd=2):>5} "
+            f"{_fmt(r['q_prefill'] if mixed else None):>6} "
+            f"{_fmt(r['q_verify'] if mixed else None):>6} "
+            f"{_fmt(r['q_decode'] if mixed else None):>6}")
     shed = stats["shed"]
     pre = stats.get("prefix") or {}
     lines.append("-" * 72)
@@ -495,6 +520,15 @@ def render(stats, clock=None):
             f"  accepted {sp['accepted']}"
             f"  acceptance {_fmt(sp['acceptance'], nd=2)}"
             f"  mean_k {_fmt(sp['mean_k'], nd=1)}"))
+    mx = s.get("mix")
+    if mx:
+        # mixed-mode ragged dispatch: the per-step prefill/verify/
+        # decode q-token split of the unified waves
+        lines.insert(-1, (
+            f"mixed     q_prefill {mx['q_prefill']}"
+            f"  q_verify {mx['q_verify']}"
+            f"  q_decode {mx['q_decode']}"
+            f"  waves {mx['steps']}"))
     return "\n".join(lines)
 
 

@@ -460,6 +460,21 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     cst = storm["tiered_ps_chaos"]["tiers"]
     assert cst["ps_dead"] is True and cst["ps_entries"] == 0
     assert storm["drop_on_evict"]["tiers"] is None
+    # mixed-mode ragged dispatch (ISSUE 18): greedy token-identity
+    # ragged-vs-phase-split on the mixed trace, chunk_stall EXACTLY
+    # zero in the ragged arm while the phase-split arm still pays it,
+    # and tok/s no worse (strict speedup is an on-chip claim — stage
+    # 4c; floors also asserted in-bench)
+    ra = art["ragged_ab"]
+    assert ra["provenance"] == "live" and ra["platform"] == "cpu"
+    assert ra["greedy_identical"] is True
+    assert ra["ragged"]["chunk_stall_p99_ms"] in (None, 0.0), ra
+    assert ra["phase_split"]["chunk_stall_p99_ms"] > 0, ra
+    assert ra["speedup"] > 0
+    assert ra["ragged"]["tail_dominant"] != "chunk_stall_ms"
+    for arm in ("phase_split", "ragged"):
+        assert ra[arm]["tokens_per_sec"] > 0
+        assert ra[arm]["ttft_p99_s"] is not None
     with open(tmp_path / "BENCH_SERVE.json") as f:
         on_disk = json.load(f)
     assert on_disk["continuous"]["tokens_per_sec"] == cont
