@@ -817,6 +817,138 @@ python bin/hetu_trace.py "$LOG/mixed_trace.jsonl" --check \
   exit 1
 }
 
+# 00k. concurrency gate (ISSUE 19): the sanitizer itself, on CPU.
+#      Green half: the deterministic interleaving fuzzer must be a
+#      pure function of its seed (planted lost-update race reproduces
+#      same-seed-twice across a sweep, pinned CI seed loses updates,
+#      TracedLock'd variant exact on every seed), then the cstable/PS
+#      hammer runs under seeded preemption with LOCKDEP ARMED — every
+#      delta lands exactly once (cache == PS row for row) and the
+#      acquisition-order graph stays clean; the merged stream must
+#      pass hetu_trace --check including the lockdep rule.  Red half:
+#      a second process plants a lock-order inversion and its stream
+#      must FAIL the same check — the rule is proven live, not just
+#      absent.
+run concurrency_gate 600 env HETU_TELEMETRY=1 HETU_LOCKDEP=1 \
+    HETU_TELEMETRY_LOG="$LOG/concurrency_trace.jsonl" \
+    JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+from hetu_tpu import locks
+from hetu_tpu.analysis.concurrency import (assert_lockdep_clean,
+                                           run_interleaved)
+from hetu_tpu.cache.cstable import CacheSparseTable
+from hetu_tpu.ps.server import PSServer
+
+VOCAB, W, CI_SEED = 64, 4, 3
+
+def racy(seed):
+    state = {"n": 0}
+    def worker():
+        for _ in range(10):
+            v = state["n"]
+            locks.sched_point()
+            state["n"] = v + 1
+    run_interleaved(worker, worker, worker, seed=seed)
+    return state["n"]
+
+def locked(seed):
+    state = {"n": 0}
+    mu = locks.TracedLock("gate.counter")
+    def worker():
+        for _ in range(10):
+            with mu:
+                v = state["n"]
+                locks.sched_point()
+                state["n"] = v + 1
+    run_interleaved(worker, worker, worker, seed=seed)
+    return state["n"]
+
+results = set()
+for seed in range(6):
+    a, b = racy(seed), racy(seed)
+    assert a == b, f"seed {seed} not reproducible: {a} vs {b}"
+    results.add(a)
+    assert locked(seed) == 30, f"locked counter lost updates, seed {seed}"
+assert racy(CI_SEED) < 30, "CI seed failed to surface the planted race"
+assert len(results) >= 2, "seed sweep explored a single schedule"
+
+class YieldingComm:
+    # hands the scheduler token away inside every RPC: preemption
+    # lands mid-transaction, where the bugs live
+    def __init__(self, server):
+        self._server = server
+    def __getattr__(self, name):
+        fn = getattr(self._server, name)
+        def wrapper(*a, **kw):
+            locks.sched_point()
+            return fn(*a, **kw)
+        return wrapper
+
+for seed in range(4):
+    server = PSServer()
+    server.param_init("emb", (VOCAB, W), "normal", 0.0, 1.0, seed=3)
+    t = CacheSparseTable(limit=32, vocab_size=VOCAB, width=W,
+                         key="emb", comm=YieldingComm(server),
+                         policy="LRU", push_bound=0)
+    rngs = [np.random.RandomState(100 * seed + i) for i in range(2)]
+    def lookups(rng=rngs[0]):
+        for _ in range(6):
+            assert t.embedding_lookup(
+                rng.randint(0, VOCAB, 8)).shape == (8, W)
+    def updates(rng=rngs[1]):
+        for _ in range(6):
+            ids = rng.randint(0, VOCAB, 4)
+            t.embedding_update(ids,
+                               rng.randn(4, W).astype(np.float32) * .01)
+    run_interleaved(lookups, updates, seed=seed)
+    t.flush()
+    ids = np.arange(VOCAB)
+    np.testing.assert_allclose(t.embedding_lookup(ids),
+                               server.sparse_pull("emb", ids),
+                               rtol=1e-4, atol=1e-5)
+assert_lockdep_clean("suite cstable/PS hammer")
+print("concurrency gate OK: fuzzer seed-exact over 6 seeds,",
+      "cstable/PS hammer clean over 4 seeds under lockdep")
+PYEOF
+if ! grep -q 'concurrency gate OK' "$LOG/concurrency_gate.log"; then
+  echo "concurrency gate FAILED — see $LOG/concurrency_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/concurrency_trace.jsonl" --check \
+    > "$LOG/concurrency_trace_contract.log" || {
+  echo "concurrency trace contract/lockdep check FAILED — see" \
+       "$LOG/concurrency_trace_contract.log" >&2
+  exit 1
+}
+run lockdep_red 300 env HETU_TELEMETRY=1 HETU_LOCKDEP=1 \
+    HETU_TELEMETRY_LOG="$LOG/lockdep_red.jsonl" \
+    JAX_PLATFORMS=cpu python - <<'PYEOF'
+from hetu_tpu import locks
+a = locks.TracedLock("red.A")
+b = locks.TracedLock("red.B")
+with a:
+    with b:
+        pass
+with b:
+    with a:                 # the planted inversion
+        pass
+(v,) = locks.lockdep_violations()
+assert v["kind"] == "order"
+rep = locks.format_violation(v)
+assert "red.A" in rep and "red.B" in rep
+print("lockdep red gate OK: inversion detected and emitted")
+PYEOF
+if ! grep -q 'lockdep red gate OK' "$LOG/lockdep_red.log"; then
+  echo "lockdep red gate FAILED — see $LOG/lockdep_red.log" >&2
+  exit 1
+fi
+if python bin/hetu_trace.py "$LOG/lockdep_red.jsonl" --check \
+    > "$LOG/lockdep_red_contract.log" 2>&1; then
+  echo "lockdep trace rule FAILED to flag a planted inversion — see" \
+       "$LOG/lockdep_red_contract.log" >&2
+  exit 1
+fi
+
 # 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
 #     must pass BEFORE any chip time is spent).  Speculative-decoding
 #     trace-replay gate: the draft-propose / batched-verify path must

@@ -18,8 +18,16 @@ dump (or an on-chip crash) minutes later:
   sibling of ``parallel/collective_check.py``).
 - :mod:`.lint` — AST rules over the repo itself (env-var registry
   discipline, no host calls in ``Op.compute``, no wall-clock/RNG
-  seeding in jitted code, donation on hot-path jits); CLI at
-  ``bin/hetu_lint.py``.
+  seeding in jitted code, donation on hot-path jits, lock discipline:
+  raw-lock / unguarded-shared-write / sleep-under-lock / dead-knob);
+  CLI at ``bin/hetu_lint.py``.
+- :mod:`.concurrency` — the concurrency sanitizer's analysis surface:
+  lockdep violation reporting over :mod:`hetu_tpu.locks` and the
+  seeded deterministic-interleaving fuzz driver
+  (``run_interleaved``/``HETU_SCHED_FUZZ``).
+- :mod:`.jit_audit` — recompile sentinel: engines register their
+  jitted steps under ``HETU_VALIDATE=1`` and snapshots assert the
+  "one compile per (bucket, config) signature" contract.
 
 ``Executor`` and ``ServingEngine`` run verify + shard_check at build
 when ``HETU_VALIDATE=1`` (default-on under pytest), emitting JSONL
@@ -35,8 +43,16 @@ from .shard_check import (ShardCheckError, check_parallelism,
 from .report import emit_records, validation_log_path
 from .integration import validate_executor_build, validate_subgraph_feeds, \
     validate_serving
+from .concurrency import (LockdepError, lockdep_report,
+                          assert_lockdep_clean, run_interleaved,
+                          sched_point, lockdep_reset,
+                          lockdep_violations)
+from .jit_audit import JitAuditError
 
 __all__ = [
+    "LockdepError", "lockdep_report", "assert_lockdep_clean",
+    "run_interleaved", "sched_point", "lockdep_reset",
+    "lockdep_violations", "JitAuditError",
     "GraphVerifyError", "VerifyReport", "verify_graph", "check_cycles",
     "ShardCheckError", "check_parallelism", "check_mesh_axes",
     "check_divisibility", "check_pipeline_stages", "check_stage_assignment",

@@ -1,0 +1,142 @@
+"""Concurrency sanitizer driver: lockdep reports + the interleaving
+fuzzer's test harness.
+
+The instrumented primitives live in :mod:`hetu_tpu.locks` (every lock
+in the repo is constructed there — lint rule ``raw-lock``); this
+module is the ANALYSIS surface over them, sibling to ``verify``/
+``shard_check``/``lint``:
+
+- **Lockdep reporting** — :func:`lockdep_report` formats the recorded
+  violations (lock-order inversions, blocking-work-under-a-lock,
+  over-threshold holds) as a ``GraphVerifyError``-style multi-line
+  diagnostic naming both lock sites and both acquisition stacks;
+  :func:`assert_lockdep_clean` raises :class:`LockdepError` on any —
+  the suite's red/green seam, mirrored at trace level by
+  ``hetu_trace --check``'s ``lockdep`` rule (any ``lockdep_violation``
+  event in a merged stream = red).
+
+- **Deterministic interleaving** — :func:`run_interleaved` runs N
+  thunks on N threads under a seeded cooperative scheduler
+  (``HETU_SCHED_FUZZ=<seed>`` or an explicit ``seed=``): every traced
+  lock acquire/release and every explicit :func:`sched_point` is a
+  preemption point where a ``random.Random(seed)`` picks the next
+  runnable thread.  The schedule is a pure function of the seed, so
+  hammer tests sweep a seed RANGE and any invariant violation found
+  on seed N replays on seed N — the ``HETU_CHAOS`` reproducibility
+  contract applied to thread schedules.  With no seed (env unset,
+  ``seed=None``) the thunks run on free OS threads: a byte-identical
+  no-op next to plain ``threading.Thread`` use.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .. import envvars, locks
+from ..locks import (TracedLock, TracedRLock, TracedCondition,     # noqa: F401
+                     sched_point, note_blocking, lockdep_enabled,
+                     lockdep_reset, lockdep_violations, lockdep_edges,
+                     format_violation)
+
+__all__ = [
+    "LockdepError", "lockdep_report", "assert_lockdep_clean",
+    "run_interleaved", "fuzz_seed", "sched_point", "note_blocking",
+    "lockdep_enabled", "lockdep_reset", "lockdep_violations",
+    "lockdep_edges", "format_violation",
+    "TracedLock", "TracedRLock", "TracedCondition",
+]
+
+
+class LockdepError(RuntimeError):
+    """Raised by :func:`assert_lockdep_clean`; ``.violations`` carries
+    the structured records behind the formatted message."""
+
+    def __init__(self, msg, violations):
+        super().__init__(msg)
+        self.violations = violations
+
+
+def lockdep_report() -> str:
+    """Every recorded violation, formatted; '' when clean."""
+    return "\n\n".join(format_violation(v) for v in lockdep_violations())
+
+
+def assert_lockdep_clean(context=""):
+    """Raise :class:`LockdepError` if any lockdep violation has been
+    recorded since the last reset (suite stages and tests call this
+    after a hammer run)."""
+    vs = lockdep_violations()
+    if vs:
+        head = f"{len(vs)} lockdep violation(s)" \
+               + (f" in {context}" if context else "")
+        raise LockdepError(head + ":\n\n" + lockdep_report(), vs)
+
+
+def fuzz_seed():
+    """The active fuzz seed (``HETU_SCHED_FUZZ``), or None."""
+    return envvars.get_int("HETU_SCHED_FUZZ")
+
+
+def run_interleaved(*thunks, seed=None, max_wait=30.0):
+    """Run each thunk on its own thread; with a seed, under the
+    deterministic scheduler.
+
+    ``seed=None`` defers to ``HETU_SCHED_FUZZ``; if that is unset too,
+    the thunks run on free OS threads (no scheduler installed, no
+    instrumentation cost anywhere).  Thread identity for scheduling is
+    the thunk's INDEX in the call, so the schedule does not depend on
+    OS start order.  The first exception any thunk raises is re-raised
+    here after all threads finish."""
+    if seed is None:
+        seed = fuzz_seed()
+    errors = []
+
+    if seed is None:
+        def _plain(i, fn):
+            try:
+                fn()
+            except BaseException as e:       # noqa: BLE001 — re-raised
+                errors.append((i, e))
+        threads = [threading.Thread(target=_plain, args=(i, fn),
+                                    name=f"interleave-{i}", daemon=True)
+                   for i, fn in enumerate(thunks)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(max_wait)
+    else:
+        sched = locks.InterleaveScheduler(seed, expected=len(thunks),
+                                          max_wait=max_wait)
+
+        def _fuzzed(i, fn):
+            sched.register(i)
+            try:
+                fn()
+            except BaseException as e:       # noqa: BLE001 — re-raised
+                errors.append((i, e))
+            finally:
+                sched.unregister()
+
+        locks.install_scheduler(sched)
+        try:
+            threads = [threading.Thread(target=_fuzzed, args=(i, fn),
+                                        name=f"interleave-{i}",
+                                        daemon=True)
+                       for i, fn in enumerate(thunks)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(max_wait + 5.0)
+        finally:
+            locks.install_scheduler(None)
+
+    alive = [t.name for t in threads if t.is_alive()]
+    if alive:
+        raise RuntimeError(
+            f"run_interleaved(seed={seed}): threads {alive} did not "
+            f"finish within {max_wait}s")
+    if errors:
+        # re-raise the thunk's own exception (the docstring's
+        # contract; a wrapper type would break pytest.raises at every
+        # caller) — the traceback already points into the thunk
+        raise errors[0][1]

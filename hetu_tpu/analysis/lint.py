@@ -28,6 +28,27 @@ Four rules, each born from a real regression class in this codebase:
   record shape; ``telemetry.emit()`` is the one pipeline, and this
   rule keeps it that way the same way ``env-registry`` keeps the env
   registry authoritative.
+- ``raw-lock`` — any ``threading.Lock/RLock/Condition`` constructed
+  outside ``hetu_tpu/locks.py``: every lock in the tree must be a
+  Traced wrapper so the lockdep sanitizer and the interleaving fuzzer
+  (``HETU_LOCKDEP``/``HETU_SCHED_FUZZ``) see EVERY synchronization
+  point — one raw lock is a blind spot in both.
+- ``unguarded-shared-write`` — in a class that owns a lock, an
+  attribute that is mutated under a ``with <lock>`` somewhere must be
+  mutated under it EVERYWHERE (public methods): a single bare
+  ``self._x = ...`` next to ten guarded ones is exactly how the
+  flight-ring snapshot race survived three PRs.  Underscore-prefixed
+  methods are exempt — they are the documented caller-holds-the-lock
+  internals (cstable's ``_replay``/``_lookup`` contract).
+- ``sleep-under-lock`` — ``time.sleep`` lexically inside a ``with``
+  on a lock-ish attribute: sleeping in a critical section stalls every
+  waiter for the full duration; move the sleep out or use a condvar
+  wait with a timeout.
+- ``dead-knob`` — a registry entry (a literal ``_reg("HETU_X", ...)``
+  declaration, i.e. ``envvars.py``) whose name appears nowhere else in
+  the linted tree: a knob nothing reads is documentation that lies.
+  Cross-file; runs only when the linted paths include a declaring
+  file, so linting a subtree without the registry stays quiet.
 
 ``bin/hetu_lint.py`` is the CLI; ``tests/test_lint_clean.py`` keeps the
 repo itself clean, making the gate permanent tier-1.
@@ -40,7 +61,8 @@ import os
 from dataclasses import dataclass
 
 RULES = ("env-registry", "np-in-compute", "time-in-jit", "jit-donate",
-         "event-emit")
+         "event-emit", "raw-lock", "unguarded-shared-write",
+         "sleep-under-lock", "dead-knob")
 
 # trace-safe static/metadata helpers: run on python ints at trace time
 _NP_ALLOWED = frozenset({
@@ -295,6 +317,223 @@ def _check_event_emit(tree, path, findings):
 
 
 # --------------------------------------------------------------------- #
+# rules: lock discipline (raw-lock / unguarded-shared-write /
+# sleep-under-lock)
+# --------------------------------------------------------------------- #
+
+# constructor names that make an attribute a "lock" for these rules
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "TracedLock",
+                         "TracedRLock", "TracedCondition"})
+# attribute-name fragments treated as lock-ish guards in with-blocks
+_LOCKISH = ("lock", "_mu", "mutex", "cv", "cond")
+
+
+def _is_lock_ctor(node):
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _attr_chain(node.func)
+    return bool(chain) and chain[-1] in _LOCK_CTORS
+
+
+def _lockish_name(name):
+    low = name.lower()
+    return any(h in low for h in _LOCKISH) \
+        or low.endswith("_mu") or low in ("mu", "cv")
+
+
+def _self_attr(node):
+    """'self.<attr>' -> attr name (or None)."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _check_raw_lock(tree, path, findings):
+    if os.path.basename(path) == "locks.py":
+        return    # the one legal construction site (and the wrappers'
+        # own raw internals, which must not recurse into themselves)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain and chain[0] == "threading" \
+                and chain[-1] in ("Lock", "RLock", "Condition"):
+            findings.append(Finding(
+                path, node.lineno, node.col_offset, "raw-lock",
+                f"raw threading.{chain[-1]}() outside hetu_tpu/locks.py;"
+                f" use locks.Traced{chain[-1]}(name) so lockdep and the"
+                f" interleaving fuzzer see this synchronization point"))
+
+
+def _guard_names(items):
+    """Lock-ish self attributes guarding a With statement."""
+    names = set()
+    for item in items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Call):
+            ctx = ctx.func
+        attr = _self_attr(ctx)
+        if attr and _lockish_name(attr):
+            names.add(attr)
+    return names
+
+
+def _write_targets(node):
+    if isinstance(node, ast.Assign):
+        return node.targets
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return [node.target]
+    return []
+
+
+def _check_lock_discipline(tree, path, findings):
+    """unguarded-shared-write + sleep-under-lock (one class walker)."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        owns_lock = any(
+            _is_lock_ctor(n.value)
+            and any(_self_attr(t) for t in n.targets)
+            for n in ast.walk(cls) if isinstance(n, ast.Assign))
+        # pass 1: attributes the class itself treats as lock-protected
+        # (assigned under a with on a lock-ish self attribute anywhere)
+        protected = set()
+
+        def scan_protected(node, guarded):
+            if isinstance(node, ast.With):
+                g = guarded or bool(_guard_names(node.items))
+                for child in node.body:
+                    scan_protected(child, g)
+                return
+            if guarded:
+                for t in _write_targets(node):
+                    attr = _self_attr(t)
+                    if attr and attr.startswith("_") \
+                            and not _lockish_name(attr):
+                        protected.add(attr)
+            for child in ast.iter_child_nodes(node):
+                scan_protected(child, guarded)
+
+        if owns_lock:
+            scan_protected(cls, False)
+
+        # pass 2: public methods writing a protected attr outside the
+        # lock, and time.sleep inside any lock-ish with (any method)
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            public = not fn.name.startswith("_")
+
+            def scan(node, guarded):
+                if isinstance(node, ast.With):
+                    g = guarded or bool(_guard_names(node.items))
+                    for child in node.body:
+                        scan(child, g)
+                    return
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    return   # nested defs run later, on other threads
+                if isinstance(node, ast.Call) and guarded:
+                    chain = _attr_chain(node.func)
+                    if chain == ["time", "sleep"]:
+                        findings.append(Finding(
+                            path, node.lineno, node.col_offset,
+                            "sleep-under-lock",
+                            f"time.sleep inside a with-lock block in "
+                            f"{cls.name}.{fn.name}: every waiter "
+                            f"stalls for the full sleep; move it out "
+                            f"or wait on a condvar with a timeout"))
+                if public and owns_lock and not guarded:
+                    for t in _write_targets(node):
+                        attr = _self_attr(t)
+                        if attr in protected:
+                            findings.append(Finding(
+                                path, node.lineno, node.col_offset,
+                                "unguarded-shared-write",
+                                f"{cls.name}.{fn.name} writes "
+                                f"self.{attr} without the lock, but "
+                                f"{cls.name} mutates it under a "
+                                f"with-lock elsewhere: every mutation "
+                                f"of shared state must hold the lock"))
+                for child in ast.iter_child_nodes(node):
+                    scan(child, guarded)
+
+            for stmt in fn.body:
+                scan(stmt, False)
+
+
+# --------------------------------------------------------------------- #
+# rule: dead-knob (cross-file; driven from lint_paths)
+# --------------------------------------------------------------------- #
+
+_KNOB_RE = None
+
+
+def _declared_knobs(tree):
+    """``_reg("HETU_X", ...)`` registry declarations -> {(name, line)}.
+
+    Parsed from the AST rather than importing the live REGISTRY so the
+    rule works on any tree (and on its own test fixture), and so each
+    finding anchors at the declaring line instead of file:1."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "_reg" \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and node.args[0].value.startswith("HETU_"):
+            names.add((node.args[0].value, node.lineno))
+    return names
+
+
+def _check_dead_knobs(py_files):
+    """Registry declarations that no OTHER linted file references (any
+    textual ``HETU_*`` occurrence counts — getter calls, launcher env
+    stamping, f-string prefixes in docs).  Declaring files contribute
+    declarations, not references: the registry row itself never keeps
+    a knob alive."""
+    global _KNOB_RE
+    import re
+    if _KNOB_RE is None:
+        _KNOB_RE = re.compile(r"HETU_[A-Z0-9_]+")
+    declares = []                 # (path, name, lineno)
+    refs = set()
+    for f in py_files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        decl = set()
+        try:
+            decl = _declared_knobs(ast.parse(src))
+        except SyntaxError:
+            pass
+        if decl:
+            declares.extend((f, n, ln) for n, ln in decl)
+        else:
+            refs.update(_KNOB_RE.findall(src))
+    findings = []
+    for path, name, lineno in sorted(declares):
+        if name not in refs:
+            findings.append(Finding(
+                path, lineno, 0, "dead-knob",
+                f"registered env var {name!r} is read nowhere in the "
+                f"linted tree: delete the registry row or wire the "
+                f"knob up (a documented knob nothing reads is a lie)"))
+    return findings
+
+
+def _noop_rule(tree, path, findings):
+    """dead-knob is cross-file; per-file linting contributes nothing."""
+
+
+# --------------------------------------------------------------------- #
 # driver
 # --------------------------------------------------------------------- #
 
@@ -304,6 +543,10 @@ _RULE_FNS = {
     "time-in-jit": _check_trace_bodies,     # time-in-jit
     "jit-donate": _check_jit_donate,
     "event-emit": _check_event_emit,
+    "raw-lock": _check_raw_lock,
+    "unguarded-shared-write": _check_lock_discipline,  # shares a class
+    "sleep-under-lock": _check_lock_discipline,        # walker
+    "dead-knob": _noop_rule,    # cross-file: handled in lint_paths
 }
 
 
@@ -346,8 +589,11 @@ def iter_py_files(paths):
 
 def lint_paths(paths, rules=RULES):
     findings = []
-    for f in iter_py_files(paths):
+    files = list(iter_py_files(paths))
+    for f in files:
         findings.extend(lint_file(f, rules=rules))
+    if "dead-knob" in rules:
+        findings.extend(_check_dead_knobs(files))
     return findings
 
 

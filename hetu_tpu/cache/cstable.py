@@ -38,7 +38,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from .. import envvars, telemetry
+from .. import envvars, locks, telemetry
 
 import numpy as np
 
@@ -70,7 +70,7 @@ class CacheSparseTable:
         # called with the lock held (they are internal to the locked
         # region, never a public surface).  RLock, not Lock: the fused
         # push_pull holds it across _update + _lookup.
-        self._lock = threading.RLock()
+        self._lock = locks.TracedRLock("cstable")
         # perf counters (reference cstable.py:126-187)
         self.num_lookups = 0
         self.num_rows_looked = 0

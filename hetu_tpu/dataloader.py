@@ -23,6 +23,7 @@ import time
 
 import numpy as np
 
+from . import locks
 from .graph.node import Op
 from .context import cpu
 
@@ -35,7 +36,7 @@ class _PrefetchRing:
         self.transform = transform
         self.depth = depth
         self.buf = collections.deque()
-        self.cv = threading.Condition()
+        self.cv = locks.TracedCondition(name="dataloader.ring")
         self.stopped = False
         self.error = None
         self.thread = threading.Thread(target=self._work, daemon=True)

@@ -56,6 +56,28 @@ _reg("HETU_VALIDATE_LOG", "path", None,
      "failure-log record shape ({t, event, ...}).", "validate")
 
 # --------------------------------------------------------------------- #
+# concurrency sanitizer (hetu_tpu/locks.py + analysis/concurrency.py)
+# --------------------------------------------------------------------- #
+_reg("HETU_LOCKDEP", "bool", False,
+     "Lock-order/deadlock sanitizer: every TracedLock acquisition "
+     "records the per-thread held stack into a global lock-order "
+     "graph; a cycle (potential deadlock), blocking work under a lock "
+     "(note_blocking: PS RPC, big wire encodes), or an over-threshold "
+     "hold is reported as a lockdep_violation event.  Also feeds the "
+     "per-lock-class lock.hold_ms.* histograms.  0 = wrappers are "
+     "plain pass-throughs (near-zero overhead).", "concurrency")
+_reg("HETU_SCHED_FUZZ", "int", None,
+     "Deterministic interleaving fuzz seed (the HETU_CHAOS analog for "
+     "thread schedules): analysis/concurrency.run_interleaved drives "
+     "registered threads through a seeded cooperative scheduler, so a "
+     "race found on seed N reproduces on seed N.  Unset = threads run "
+     "free (byte-identical no-op).", "concurrency")
+_reg("HETU_LOCKDEP_HOLD_MS", "float", 0.0,
+     "> 0 with HETU_LOCKDEP=1: any single lock hold longer than this "
+     "many milliseconds is reported as a long_hold lockdep_violation "
+     "(0 = histogram only, no per-hold threshold).", "concurrency")
+
+# --------------------------------------------------------------------- #
 # telemetry (hetu_tpu/telemetry/)
 # --------------------------------------------------------------------- #
 _reg("HETU_TELEMETRY", "bool", True,

@@ -21,7 +21,7 @@ import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor, Future
 
-from .. import envvars, quant
+from .. import envvars, locks, quant
 
 import numpy as np
 
@@ -122,6 +122,10 @@ class _TCPTransport:
 
     def call(self, method, *args, **kwargs):
         from .. import telemetry
+        # lockdep held-across seam: an RPC (connect + send + recv, up
+        # to retries x timeout seconds) under any traced lock turns
+        # that lock's critical section into an unbounded wait
+        locks.note_blocking("ps_rpc", method=method)
         st = self._state()
         st.seq += 1
         payload = wire.dumps(
@@ -289,7 +293,7 @@ class PSClient:
         # finalize() can close the ones pool threads created.
         self._van_local = threading.local()
         self._van_clients = []
-        self._van_clients_mu = threading.Lock()
+        self._van_clients_mu = locks.TracedLock("ps.van_clients")
 
     def start_heartbeat(self, interval=5.0, role="worker", node_id=None):
         """Beat the scheduler's liveness map (HETU_SCHEDULER_ADDR) every

@@ -125,7 +125,8 @@ class FaultPlan:
         self.wedge = None if wedge is None else int(wedge)
         self.role = role
         self._n = 0
-        self._mu = threading.Lock()
+        from .. import locks
+        self._mu = locks.TracedLock("chaos.plan")
         # observability: how often each kind actually fired
         self.fired = {k: 0 for k in
                       ("drop", "dup", "reset", "delay", "slow", "kill",
@@ -236,7 +237,14 @@ class FaultPlan:
 # ---------------- env activation ---------------- #
 
 _plans = {}
-_plans_mu = threading.Lock()
+
+
+def _make_plans_mu():
+    from .. import locks
+    return locks.TracedLock("chaos.plans")
+
+
+_plans_mu = _make_plans_mu()
 
 
 def plan_from_env():

@@ -31,7 +31,7 @@ import time
 import numpy as np
 
 from . import faults, wire
-from .. import envvars
+from .. import envvars, locks
 from ..quant import QuantArray, maybe_decode, should_quantize, wire_chunk
 
 
@@ -310,7 +310,7 @@ class _Param:
         # per-row version counters (only meaningful for 2D tables)
         self.versions = np.zeros(value.shape[0], np.int64) \
             if value.ndim == 2 else None
-        self.lock = threading.Lock()
+        self.lock = locks.TracedLock("ps.param")
 
 
 _AUTOSERVE = object()     # sentinel: serve_van registers future tables too
@@ -327,19 +327,19 @@ class PSServer:
         # key -> (payload, version) — a namespace of its own, never
         # cast through the f32 param path
         self.kv_cold = {}
-        self.lock = threading.Lock()
+        self.lock = locks.TracedLock("ps.server")
         # SSP: per-key worker clocks (reference ssp_handler.h)
         self.ssp_clocks = {}
         self.ssp_bound = {}
-        self.ssp_cv = threading.Condition()
+        self.ssp_cv = locks.TracedCondition(name="ps.ssp")
         # preduce matchmaking (reference preduce_handler.cc)
         self._preduce_groups = {}
         self._preduce_seq = 0
         self._preduce_last = {}   # (key, rank) -> last match seq
-        self._preduce_cv = threading.Condition()
+        self._preduce_cv = locks.TracedCondition(name="ps.preduce")
         # barrier for BSP (reference PSFHandle BarrierWorker)
         self._barrier_count = {}
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = locks.TracedCondition(name="ps.barrier")
 
     # ---------------- lifecycle ---------------- #
 
@@ -880,7 +880,7 @@ def _serve_object_tcp(obj, port, block=True):
       after a lost response would double-apply a push)."""
     import collections as _collections
     replay = _collections.OrderedDict()   # client_id -> (seq, payload)
-    replay_cv = threading.Condition()
+    replay_cv = locks.TracedCondition(name="ps.replay")
     _MAX_CLIENTS = 1024                   # LRU bound: one slot per client
 
     class Handler(socketserver.BaseRequestHandler):
@@ -986,7 +986,7 @@ class Scheduler:
 
     def __init__(self):
         self._servers = {}           # index -> addr
-        self._cv = threading.Condition()
+        self._cv = locks.TracedCondition(name="scheduler")
         self._beats = {}             # "role:id" -> last monotonic beat
 
     def register_server(self, index, addr):

@@ -57,6 +57,7 @@ import numpy as np
 
 from .client import (PSClient, PSConnectionError, _TCPTransport,
                      _LocalTransport, _local_chaos_call)
+from .. import locks
 
 REPLICA_PREFIX = "__rep__"
 
@@ -126,7 +127,7 @@ class ShardedPSClient:
             if self.replicate else None
         self._row_sharded = {}      # key -> (rows, width) or None
         self._failed = set()        # shard indices currently failed over
-        self._fail_mu = threading.Lock()
+        self._fail_mu = locks.TracedLock("ps.shard_fail")
         self.failure_events = []    # structured failover/resync log
 
     # ------------------------------------------------------------------ #

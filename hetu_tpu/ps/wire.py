@@ -122,6 +122,12 @@ def _enc(obj, out):
             f"type {type(obj).__name__} is outside the PS wire envelope")
 
 
+# above this many payload bytes, encoding under a held lock is flagged
+# by lockdep (note_blocking): a multi-megabyte join/copy is real wall
+# time inside someone's critical section
+_BLOCKING_BYTES = 1 << 20
+
+
 def dumps(obj) -> bytes:
     out = []
     try:
@@ -130,6 +136,14 @@ def dumps(obj) -> bytes:
         raise
     except Exception as e:   # out-of-range ints, oversized strings, ...
         raise WireError(f"cannot encode for the PS wire: {e}") from e
+    from .. import locks
+    if locks.lockdep_enabled():
+        # len() of the array memoryviews counts ELEMENTS; nbytes is
+        # the wire size
+        n = sum(b.nbytes if isinstance(b, memoryview) else len(b)
+                for b in out)
+        if n >= _BLOCKING_BYTES:
+            locks.note_blocking("wire_dumps", bytes=n)
     return b"".join(out)
 
 

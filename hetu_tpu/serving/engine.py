@@ -285,6 +285,11 @@ class ServingEngine:
             # tells the lifecycle accountant the wave IS the prefill:
             # chunk_stall residue is asserted near-zero and folded
             self.metrics.mixed_mode = True
+        if envvars.get_bool("HETU_VALIDATE"):
+            # recompile sentinel: snapshot()/assert_no_recompile() can
+            # now prove the steady state stays ONE compiled core
+            from ..analysis import jit_audit
+            jit_audit.register_engine(self)
 
     # ------------------------------------------------------------- #
     # live weight sync (serving/weight_sync.py)

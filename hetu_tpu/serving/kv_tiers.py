@@ -48,7 +48,7 @@ is a cold prefill, never an error.
 
 from __future__ import annotations
 
-from .. import envvars, telemetry
+from .. import envvars, locks, telemetry
 from ..ps import faults
 from ..telemetry import flight
 from .prefix_directory import prefix_hash
@@ -94,6 +94,15 @@ class TieredKVStore:
         self.directory = directory     # PrefixDirectory or None: gets
         self.block = None              # the tier column stamped
         self.ps_dead = False
+        # one reentrant guard over the whole ladder: spill/fetch from
+        # replica threads race each other on the ring dict and its
+        # byte counter, and a transport death inside fetch/_ps_put
+        # re-enters through kill_ps.  Reentrant, not plain: kill_ps is
+        # both a public entry point and an under-lock internal.  (The
+        # PS rung RPC runs under the lock; with an in-process server
+        # that is a dict op, and with a real TCP transport lockdep's
+        # held-across seam flags it — by design.)
+        self._mu = locks.TracedRLock("kv.tiers")
         self._ring = {}                # hash -> _RingEntry (dict IS
         self._ring_bytes = 0           # the LRU: insertion-ordered,
         #                                re-insert on refresh)
@@ -168,35 +177,37 @@ class TieredKVStore:
             return False
         toks = tuple(int(t) for t in tokens)
         h = prefix_hash(toks)
-        e = self._ring.pop(h, None)
-        if e is not None:
-            # refresh: newest payload (byte-identical for immutable KV,
-            # but the re-export is authoritative), MRU position
-            self._ring_bytes -= e.nbytes
-            ne = _RingEntry(toks, payload)
-            self._ring[h] = ne
-            self._ring_bytes += ne.nbytes
-            self.refreshes += 1
-            return True
-        if h in self._ps_index:
-            self.refreshes += 1    # already cold-resident: nothing to
-            return True            # move (the payload is identical)
-        nbytes = int(payload["nbytes"])
-        if self.host_bytes > 0 and nbytes <= self.host_bytes:
-            self._ring[h] = _RingEntry(toks, payload)
-            self._ring_bytes += nbytes
-            self._note_spill(h, payload, "host", replica)
-            if self.directory is not None:
-                self.directory.set_tier(toks, "host")
-            self._shrink_ring()
-            return True
-        if self._ps_put(h, toks, payload):
-            self._note_spill(h, payload, "ps", replica)
-            if self.directory is not None:
-                self.directory.set_tier(toks, "ps")
-            return True
-        self.spill_rejects += 1
-        return False
+        with self._mu:
+            e = self._ring.pop(h, None)
+            if e is not None:
+                # refresh: newest payload (byte-identical for
+                # immutable KV, but the re-export is authoritative),
+                # MRU position
+                self._ring_bytes -= e.nbytes
+                ne = _RingEntry(toks, payload)
+                self._ring[h] = ne
+                self._ring_bytes += ne.nbytes
+                self.refreshes += 1
+                return True
+            if h in self._ps_index:
+                self.refreshes += 1   # already cold-resident: nothing
+                return True           # to move (payload is identical)
+            nbytes = int(payload["nbytes"])
+            if self.host_bytes > 0 and nbytes <= self.host_bytes:
+                self._ring[h] = _RingEntry(toks, payload)
+                self._ring_bytes += nbytes
+                self._note_spill(h, payload, "host", replica)
+                if self.directory is not None:
+                    self.directory.set_tier(toks, "host")
+                self._shrink_ring()
+                return True
+            if self._ps_put(h, toks, payload):
+                self._note_spill(h, payload, "ps", replica)
+                if self.directory is not None:
+                    self.directory.set_tier(toks, "ps")
+                return True
+            self.spill_rejects += 1
+            return False
 
     def _note_spill(self, h, payload, tier, replica):
         self.spills[tier] += 1
@@ -244,26 +255,27 @@ class TieredKVStore:
         directory — the usable share is capped below the last prompt
         position, so the full prompt is never probed."""
         block = self.block if block is None else int(block)
-        if not self.enabled or block is None \
-                or (not self._ring and not self._ps_index):
+        with self._mu:
+            if not self.enabled or block is None \
+                    or (not self._ring and not self._ps_index):
+                return None
+            p = [int(t) for t in prompt]
+            if len(p) < 2:
+                return None
+            top = ((len(p) - 1) // block) * block
+            for n in range(top, 0, -block):
+                cut = p[:n]
+                h = prefix_hash(cut)
+                e = self._ring.get(h)
+                if e is not None and list(e.tokens) == cut:
+                    self.lookup_hits += 1
+                    return tuple(cut), n, "host"
+                cold = self._ps_index.get(h)
+                if cold is not None and list(cold[0]) == cut:
+                    self.lookup_hits += 1
+                    return tuple(cut), n, "ps"
+            self.lookup_misses += 1
             return None
-        p = [int(t) for t in prompt]
-        if len(p) < 2:
-            return None
-        top = ((len(p) - 1) // block) * block
-        for n in range(top, 0, -block):
-            cut = p[:n]
-            h = prefix_hash(cut)
-            e = self._ring.get(h)
-            if e is not None and list(e.tokens) == cut:
-                self.lookup_hits += 1
-                return tuple(cut), n, "host"
-            cold = self._ps_index.get(h)
-            if cold is not None and list(cold[0]) == cut:
-                self.lookup_hits += 1
-                return tuple(cut), n, "ps"
-        self.lookup_misses += 1
-        return None
 
     def fetch(self, tokens, *, replica=None):
         """Pop a resident prefix's payload back out of the ladder —
@@ -274,52 +286,56 @@ class TieredKVStore:
         degrade to a cold prefill at the caller."""
         toks = tuple(int(t) for t in tokens)
         h = prefix_hash(toks)
-        e = self._ring.get(h)
-        if e is not None:
-            if self._chaos_corrupt("kvtier.ring_get"):
-                # corrupted host copy: never land garbage KV — drop the
-                # residency and admit cold (zero loss, warmth lost)
+        with self._mu:
+            e = self._ring.get(h)
+            if e is not None:
+                if self._chaos_corrupt("kvtier.ring_get"):
+                    # corrupted host copy: never land garbage KV —
+                    # drop the residency and admit cold (zero loss,
+                    # warmth lost)
+                    del self._ring[h]
+                    self._ring_bytes -= e.nbytes
+                    self.corruptions += 1
+                    telemetry.inc("kvtier.corruptions")
+                    self._drop(h, toks, "host", "corrupt")
+                    return None
                 del self._ring[h]
                 self._ring_bytes -= e.nbytes
-                self.corruptions += 1
-                telemetry.inc("kvtier.corruptions")
-                self._drop(h, toks, "host", "corrupt")
+                self._note_fetch(h, e.payload, "host", replica)
+                if self.directory is not None:
+                    self.directory.clear_tier(toks)
+                return e.payload
+            cold = self._ps_index.get(h)
+            if cold is None:
                 return None
-            del self._ring[h]
-            self._ring_bytes -= e.nbytes
-            self._note_fetch(h, e.payload, "host", replica)
+            _toks0, _length, _nbytes, version = cold
+            if self._chaos_kill("kvtier.ps_get"):
+                return None        # kill_ps just dropped every cold
+                #                    residency, this one included
+            try:
+                got = self._ps_client().kv_get(PS_NAMESPACE + h)
+            except Exception as err:  # noqa: BLE001 — transport death
+                self.kill_ps(reason=f"kv_get: {type(err).__name__}")
+                return None
+            if got is None or int(got[1]) != version:
+                # vanished or overwritten behind our back: a cold
+                # entry we cannot vouch for must not land — drop the
+                # residency
+                del self._ps_index[h]
+                self._drop(h, toks, "ps", "version_skew"
+                           if got is not None else "missing")
+                return None
+            payload = got[0]
+            del self._ps_index[h]
+            try:
+                self._ps_client().kv_del(PS_NAMESPACE + h)
+            except Exception:  # noqa: BLE001 — the payload is in
+                pass           # hand; a failed delete only leaks a
+                #                cold blob
+            self._note_fetch(h, payload, "ps", replica)
             if self.directory is not None:
                 self.directory.clear_tier(toks)
-            return e.payload
-        cold = self._ps_index.get(h)
-        if cold is None:
-            return None
-        _toks0, _length, _nbytes, version = cold
-        if self._chaos_kill("kvtier.ps_get"):
-            return None            # kill_ps just dropped every cold
-            #                        residency, this one included
-        try:
-            got = self._ps_client().kv_get(PS_NAMESPACE + h)
-        except Exception as err:  # noqa: BLE001 — any transport death
-            self.kill_ps(reason=f"kv_get: {type(err).__name__}")
-            return None
-        if got is None or int(got[1]) != version:
-            # vanished or overwritten behind our back: a cold entry we
-            # cannot vouch for must not land — drop the residency
-            del self._ps_index[h]
-            self._drop(h, toks, "ps",
-                       "version_skew" if got is not None else "missing")
-            return None
-        payload = got[0]
-        del self._ps_index[h]
-        try:
-            self._ps_client().kv_del(PS_NAMESPACE + h)
-        except Exception:  # noqa: BLE001 — the payload is in hand;
-            pass           # a failed delete only leaks a cold blob
-        self._note_fetch(h, payload, "ps", replica)
-        if self.directory is not None:
-            self.directory.clear_tier(toks)
-        return payload
+            return payload
 
     def _note_fetch(self, h, payload, tier, replica):
         self.fetches[tier] += 1
@@ -373,12 +389,13 @@ class TieredKVStore:
         drop (unreachable warmth is not warmth) and future spills stop
         at the host ring — beyond it, today's drop-on-evict.  Zero
         request loss by construction: a tier miss is a cold prefill."""
-        if self.ps_dead:
-            return
-        self.ps_dead = True
-        for h, (toks, _l, _n, _v) in list(self._ps_index.items()):
-            del self._ps_index[h]
-            self._drop(h, toks, "ps", "ps_killed")
+        with self._mu:
+            if self.ps_dead:
+                return
+            self.ps_dead = True
+            for h, (toks, _l, _n, _v) in list(self._ps_index.items()):
+                del self._ps_index[h]
+                self._drop(h, toks, "ps", "ps_killed")
         telemetry.emit("kvtier_ps_killed", _stream="failure",
                        reason=reason)
         flight.RECORDER.dump("kvtier_ps_killed", detail=reason)
@@ -413,24 +430,29 @@ class TieredKVStore:
         terminal drop so a COMPLETED run's spill/fetch ledger balances
         (the tier-balance trace rule treats an open residency at end
         of stream as a violation).  PS blobs are best-effort deleted."""
-        for h in list(self._ring):
-            e = self._ring.pop(h)
-            self._ring_bytes -= e.nbytes
-            self._drop(h, e.tokens, "host", reason)
-        for h, (toks, _l, _n, _v) in list(self._ps_index.items()):
-            del self._ps_index[h]
-            if not self.ps_dead:
-                try:
-                    self._ps_client().kv_del(PS_NAMESPACE + h)
-                except Exception:  # noqa: BLE001
-                    pass
-            self._drop(h, toks, "ps", reason)
+        with self._mu:
+            for h in list(self._ring):
+                e = self._ring.pop(h)
+                self._ring_bytes -= e.nbytes
+                self._drop(h, e.tokens, "host", reason)
+            for h, (toks, _l, _n, _v) in list(self._ps_index.items()):
+                del self._ps_index[h]
+                if not self.ps_dead:
+                    try:
+                        self._ps_client().kv_del(PS_NAMESPACE + h)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._drop(h, toks, "ps", reason)
 
     def _event(self, kind, **fields):
         telemetry.emit(kind, _stream="serve", **fields)
 
     def stats(self):
         """JSON-able ladder view (router snapshot / bench rows)."""
+        with self._mu:
+            return self._stats()
+
+    def _stats(self):
         return {
             "enabled": self.enabled,
             "host_bytes": self.host_bytes,

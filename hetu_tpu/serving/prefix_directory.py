@@ -28,6 +28,13 @@ whole fleet to exact PR 8 session-affinity behavior.  Counters:
 
 Hit/steal are stamped by the router at placement time (only it knows
 where the request actually landed); miss/stale are counted here.
+
+The map is shared mutable state: replica callbacks (register/evict)
+and the router's lookup can run on different threads once engines
+step concurrently, and ``lookup``/``drop_replica`` iterate dicts the
+callbacks mutate.  One ``locks.TracedLock`` guards every entry-table
+touch; ``_drop_replica`` is the caller-holds-the-lock internal
+(attach reuses it under the same acquisition).
 """
 
 from __future__ import annotations
@@ -37,7 +44,7 @@ import time
 
 import numpy as np
 
-from .. import envvars
+from .. import envvars, locks
 
 
 def prefix_hash(tokens):
@@ -75,6 +82,7 @@ class PrefixDirectory:
             ttl = envvars.get_float("HETU_DIRECTORY_TTL")
         self.ttl = float(ttl or 0.0)
         self._now = now or time.perf_counter
+        self._mu = locks.TracedLock("prefix.dir")
         self._entries = {}               # hash -> _DirEntry
         self._block = None               # fleet block size (from attach)
         self.hits = 0
@@ -102,7 +110,8 @@ class PrefixDirectory:
         dropped first — its fresh pool holds nothing.  A contiguous or
         non-sharing manager attaches as a no-op (the fleet then simply
         never produces directory hits for that replica)."""
-        self.drop_replica(replica)
+        with self._mu:
+            self._drop_replica(replica)
         if not getattr(kv, "prefix_share", False):
             return
         block = getattr(kv, "block", None)
@@ -118,13 +127,14 @@ class PrefixDirectory:
         """Record that ``replica`` now holds the prefix ``tokens``
         (or refresh its last-use stamp)."""
         h = prefix_hash(tokens)
-        e = self._entries.get(h)
-        if e is None:
-            blocks = len(entry.blocks) if entry is not None else 0
-            e = self._entries[h] = _DirEntry(len(tokens), blocks)
-        e.refs += 1
-        e.replicas[replica] = self._now()
-        self.registrations += 1
+        with self._mu:
+            e = self._entries.get(h)
+            if e is None:
+                blocks = len(entry.blocks) if entry is not None else 0
+                e = self._entries[h] = _DirEntry(len(tokens), blocks)
+            e.refs += 1
+            e.replicas[replica] = self._now()
+            self.registrations += 1
 
     def evict(self, replica, tokens):
         """Drop ``replica``'s claim on ``tokens`` (LRU eviction on the
@@ -134,16 +144,17 @@ class PrefixDirectory:
         the tier column keeps it routable until the tier fetch/drop
         clears it."""
         h = prefix_hash(tokens)
-        e = self._entries.get(h)
-        if e is None:
-            return
-        e.replicas.pop(replica, None)
-        if not e.replicas:
-            if self.tiered and e.tier is not None:
-                self.demotions += 1
-            else:
-                del self._entries[h]
-        self.evictions += 1
+        with self._mu:
+            e = self._entries.get(h)
+            if e is None:
+                return
+            e.replicas.pop(replica, None)
+            if not e.replicas:
+                if self.tiered and e.tier is not None:
+                    self.demotions += 1
+                else:
+                    del self._entries[h]
+            self.evictions += 1
 
     def set_tier(self, tokens, tier):
         """Stamp the tier column: a spilled copy of this prefix now
@@ -151,10 +162,11 @@ class PrefixDirectory:
         deleted it — spill and evict race by a callback ordering the
         directory must not depend on."""
         h = prefix_hash(tokens)
-        e = self._entries.get(h)
-        if e is None:
-            e = self._entries[h] = _DirEntry(len(tokens), 0)
-        e.tier = tier
+        with self._mu:
+            e = self._entries.get(h)
+            if e is None:
+                e = self._entries[h] = _DirEntry(len(tokens), 0)
+            e.tier = tier
 
     def clear_tier(self, tokens):
         """Drop the tier stamp (the copy was fetched back up or tier-
@@ -162,12 +174,13 @@ class PrefixDirectory:
         delete semantics resume once nothing holds the prefix
         anywhere."""
         h = prefix_hash(tokens)
-        e = self._entries.get(h)
-        if e is None:
-            return
-        e.tier = None
-        if not e.replicas:
-            del self._entries[h]
+        with self._mu:
+            e = self._entries.get(h)
+            if e is None:
+                return
+            e.tier = None
+            if not e.replicas:
+                del self._entries[h]
 
     def known(self, tokens):
         """True when ANY replica currently claims this exact prefix.
@@ -175,12 +188,18 @@ class PrefixDirectory:
         prefixes the directory can actually route — a prefix no entry
         names attracts no directed traffic, so its blocks are not
         worth the wire bytes."""
-        return prefix_hash(tokens) in self._entries
+        with self._mu:
+            return prefix_hash(tokens) in self._entries
 
     def drop_replica(self, replica):
         """Purge every entry naming ``replica`` (death/respawn) —
         except tier-demoted ones: a spilled copy outlives the replica
         that spilled it (that is the point of the tier ladder)."""
+        with self._mu:
+            self._drop_replica(replica)
+
+    def _drop_replica(self, replica):
+        # caller holds self._mu (attach purges under its acquisition)
         dead = []
         for h, e in self._entries.items():
             e.replicas.pop(replica, None)
@@ -210,34 +229,35 @@ class PrefixDirectory:
         ``(None, cached_len)`` — warm somewhere, fetched at engine
         admission), else "miss" (nothing known) or "stale" (only
         TTL-expired claims) — all but hit/steal counted here."""
-        if self._block is None or len(prompt) < 2:
+        with self._mu:
+            if self._block is None or len(prompt) < 2:
+                self.misses += 1
+                return None, "miss"
+            now = self._now() if now is None else now
+            p = [int(t) for t in prompt]
+            top = ((len(p) - 1) // self._block) * self._block
+            saw_stale = False
+            for n in range(top, 0, -self._block):
+                e = self._entries.get(prefix_hash(p[:n]))
+                if e is None:
+                    continue
+                fresh = {r: ts for r, ts in e.replicas.items()
+                         if not self._expired(ts, now)}
+                if fresh:
+                    return (max(fresh, key=fresh.get), n), None
+                if e.tier is not None:
+                    # no pool holds this cut but the tier ladder
+                    # does: route normally — the landing replica's
+                    # admission fetch re-imports the span (tier
+                    # column = "warm somewhere", not "warm at")
+                    self.tier_hits += 1
+                    return (None, n), "tier"
+                saw_stale = True
+            if saw_stale:
+                self.stale += 1
+                return None, "stale"
             self.misses += 1
             return None, "miss"
-        now = self._now() if now is None else now
-        p = [int(t) for t in prompt]
-        top = ((len(p) - 1) // self._block) * self._block
-        saw_stale = False
-        for n in range(top, 0, -self._block):
-            e = self._entries.get(prefix_hash(p[:n]))
-            if e is None:
-                continue
-            fresh = {r: ts for r, ts in e.replicas.items()
-                     if not self._expired(ts, now)}
-            if fresh:
-                return (max(fresh, key=fresh.get), n), None
-            if e.tier is not None:
-                # no pool holds this cut but the tier ladder does:
-                # route normally — the landing replica's admission
-                # fetch re-imports the span (tier column = "warm
-                # somewhere", not "warm at")
-                self.tier_hits += 1
-                return (None, n), "tier"
-            saw_stale = True
-        if saw_stale:
-            self.stale += 1
-            return None, "stale"
-        self.misses += 1
-        return None, "miss"
 
     # ------------------------------------------------------------- #
 
@@ -251,6 +271,10 @@ class PrefixDirectory:
 
     def snapshot(self):
         """JSON-able directory view (router snapshot / hetu_top)."""
+        with self._mu:
+            return self._snapshot()
+
+    def _snapshot(self):
         return {
             "entries": len(self._entries),
             "ttl": self.ttl,

@@ -35,7 +35,7 @@ import os
 import threading
 import time
 
-from .. import envvars
+from .. import envvars, locks
 from . import flight
 from .metrics import REGISTRY
 
@@ -81,6 +81,11 @@ REQUIRED_FIELDS = {
     "graph_verified": ("subgraph", "phase"),
     "graph_verify_error": ("kind", "error"),
     "serving_verified": ("model",),
+    # concurrency sanitizer (hetu_tpu/locks.py; validate stream):
+    # kind = order (lock-order inversion) / held_across (blocking work
+    # under a lock) / long_hold (> HETU_LOCKDEP_HOLD_MS); any one in a
+    # merged stream turns hetu_trace --check red
+    "lockdep_violation": ("kind", "lock"),
     # request lifecycle (serve stream; ISSUE 7)
     "req_span": ("request", "phase", "ms"),
     "req_retire": ("request", "ttft_ms"),
@@ -195,7 +200,7 @@ class TelemetrySink:
     """Process-wide sink: bounded in-memory ring + JSONL fan-out."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("telemetry.sink")
         self._buffer = collections.deque(
             maxlen=max(1, envvars.get_int("HETU_TELEMETRY_BUFFER")))
         self.emitted = 0

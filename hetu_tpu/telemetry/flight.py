@@ -6,7 +6,7 @@ Post-mortem debugging of a killed or wedged process needs the records
 buffering writer.  The flight recorder closes that gap the way an
 aircraft FDR does: every record that flows through the one event
 pipeline (``events.TelemetrySink.emit``) is ALSO appended to a bounded
-in-memory ring — one deque append, always on, cheap even under
+in-memory ring — one locked deque append, always on, cheap even under
 ``HETU_TELEMETRY=0`` (explicit failure/serve/validate events still flow
 through ``emit()`` with telemetry off; only spans/metrics go quiet) —
 and ``dump()`` writes the ring to ``$HETU_FLIGHT_LOG`` as contract-shaped
@@ -37,35 +37,42 @@ from __future__ import annotations
 import collections
 import json
 import os
-import threading
 import time
 
-from .. import envvars
+from .. import envvars, locks
 
 
 class FlightRecorder:
     """Bounded ring of recent contract-shaped records + dump-to-JSONL.
 
-    ``record()`` is the hot path — a single deque append (atomic under
-    the GIL), no lock, no env read.  ``dump()`` is the cold path: it
-    snapshots the ring under a lock and writes header + records with an
-    fsync, because the usual caller is about to die (chaos kill) or
-    raise."""
+    ``record()`` is the hot path — one lock acquire + one deque append,
+    no env read.  The lock is NOT optional: ``list(deque)`` raises
+    ``RuntimeError: deque mutated during iteration`` when another
+    thread appends mid-snapshot, so the old lock-free append could
+    break ``dump()`` at exactly the moment it matters (a dying process
+    snapshotting its black box under emit load) and lose the in-flight
+    record.  Under the lock, a dump is an exact point-in-time snapshot.
+    ``dump()`` is the cold path: snapshot under the lock, then write
+    header + records with an fsync OUTSIDE it, because the usual caller
+    is about to die (chaos kill) or raise."""
 
     def __init__(self, depth=None):
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("telemetry.flight")
         self._ring = collections.deque(
             maxlen=max(1, depth or envvars.get_int("HETU_FLIGHT_DEPTH")))
         self.dumps = 0
 
     def record(self, rec):
-        self._ring.append(rec)
+        with self._lock:
+            self._ring.append(rec)
 
     def extend(self, recs):
-        self._ring.extend(recs)
+        with self._lock:
+            self._ring.extend(recs)
 
     def recent(self):
-        return list(self._ring)
+        with self._lock:
+            return list(self._ring)
 
     def __len__(self):
         return len(self._ring)
@@ -78,8 +85,7 @@ class FlightRecorder:
         path = path or envvars.get_path("HETU_FLIGHT_LOG")
         if not path:
             return None
-        with self._lock:
-            recs = list(self._ring)
+        recs = self.recent()
         header = {"t": round(time.time(), 3), "event": "flight_dump",
                   "reason": str(reason), "records": len(recs),
                   "pid": os.getpid(), **fields}

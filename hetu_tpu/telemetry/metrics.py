@@ -8,7 +8,8 @@ and the bench artifacts.  Metrics are named with dotted paths
 (``ps.rpc.retries``, ``cache.hits``) plus an optional ``[tag]`` suffix
 for low-cardinality breakdowns (``ps.rpc.calls[host:port]``).
 
-Cost model: one ``threading.Lock`` per metric, plain python arithmetic
+Cost model: one ``locks.TracedLock`` per metric (plain pass-through
+unless the lockdep sanitizer is on), plain python arithmetic
 under it — ~1 µs per record, invisible next to a training step.  The
 hot-path guard lives one level up (``telemetry.enabled()``): when
 ``HETU_TELEMETRY=0`` the instrumented call sites skip the registry
@@ -23,7 +24,8 @@ million-step run.
 from __future__ import annotations
 
 import collections
-import threading
+
+from .. import locks
 
 _RESERVOIR = 512
 
@@ -57,7 +59,7 @@ class Counter:
     def __init__(self, name):
         self.name = name
         self.value = 0
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("metrics.counter")
 
     def inc(self, n=1):
         with self._lock:
@@ -76,7 +78,7 @@ class Gauge:
     def __init__(self, name):
         self.name = name
         self.value = None
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("metrics.gauge")
 
     def set(self, v):
         with self._lock:
@@ -100,7 +102,7 @@ class Histogram:
         self.min = None
         self.max = None
         self._recent = collections.deque(maxlen=reservoir)
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("metrics.hist")
 
     def observe(self, v):
         v = float(v)
@@ -135,7 +137,7 @@ class MetricsRegistry:
     a crash on the hot path)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.TracedLock("metrics.registry")
         self._metrics = {}
 
     def _get(self, name, cls):

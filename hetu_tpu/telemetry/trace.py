@@ -73,6 +73,12 @@ requeue (or a handoff pair) means a swap landed under a live request.
 drained must retire exactly once AFTER the drain, on a peer — never
 on the draining replica itself, never twice, never zero times
 (deadline-expired rids excepted).
+
+``--check`` also enforces the lockdep rule (ISSUE 19): any
+``lockdep_violation`` record fails the gate outright — the sanitizer
+(``hetu_tpu/locks.py`` under ``HETU_LOCKDEP=1``) only emits one after
+proving a lock-order inversion, a blocking call under a held lock, or
+a hold past ``HETU_LOCKDEP_HOLD_MS``, so presence is the finding.
 """
 
 from __future__ import annotations
@@ -553,6 +559,29 @@ def check_spec_attribution(events):
     return problems
 
 
+def check_lockdep(events):
+    """The lockdep rule (ISSUE 19): a ``lockdep_violation`` record in
+    the stream IS a finding — the sanitizer only emits after it proved
+    a lock-order inversion (a cycle in the acquisition graph), a
+    blocking call (PS RPC, multi-MB wire encode) under a held lock, or
+    a hold longer than ``HETU_LOCKDEP_HOLD_MS``.  Presence fails the
+    gate; the record's ``kind``/``lock``/``other``/``site`` fields and
+    the in-process report (``analysis.concurrency.lockdep_report``)
+    carry both acquisition stacks."""
+    problems = []
+    for e in events:
+        if e.get("event") != "lockdep_violation":
+            continue
+        msg = (f"lockdep: {e.get('kind')} violation on lock "
+               f"{e.get('lock')!r}")
+        if e.get("other"):
+            msg += f" vs {e.get('other')!r}"
+        if e.get("site"):
+            msg += f" at {e.get('site')}"
+        problems.append(msg)
+    return problems
+
+
 def check_version_coherence(events):
     """The live-weight-sync rule (ISSUE 15): no retirement may mix
     tokens from two weight versions.  Every per-request record
@@ -629,7 +658,11 @@ def main(argv=None):
                          "retire exactly once on a peer), and the "
                          "tier-balance rule (every kv_spill closes "
                          "with exactly one kv_fetch or kv_tier_drop "
-                         "for its prefix); exit 1 on violations")
+                         "for its prefix), and the lockdep rule (any "
+                         "lockdep_violation record — a proved lock-"
+                         "order inversion, blocking-under-lock, or "
+                         "long hold — fails the gate); exit 1 on "
+                         "violations")
     args = ap.parse_args(argv)
 
     paths = args.paths or configured_logs()
@@ -664,6 +697,8 @@ def main(argv=None):
         problems.extend(scale)
         tier = check_tier_balance(events)
         problems.extend(tier)
+        lockdep = check_lockdep(events)
+        problems.extend(lockdep)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
@@ -675,7 +710,8 @@ def main(argv=None):
                           "gather_violations": len(gather),
                           "version_violations": len(version),
                           "scale_balance_violations": len(scale),
-                          "tier_balance_violations": len(tier)}))
+                          "tier_balance_violations": len(tier),
+                          "lockdep_violations": len(lockdep)}))
         return 1 if problems or bad else 0
 
     if args.export:

@@ -1,0 +1,12 @@
+"""Lint fixture: a registry declaration nothing reads (rule
+dead-knob).  The ``_reg`` call below is what marks this file as a
+registry file to the cross-file sweep."""
+
+REGISTRY = {}
+
+
+def _reg(name, typ, default, doc):
+    REGISTRY[name] = (typ, default, doc)
+
+
+_reg("HETU_FIXTURE_UNUSED_KNOB", "bool", False, "never read anywhere")

@@ -1,0 +1,6 @@
+"""Lint fixture: bare threading lock construction (rule raw-lock)."""
+import threading
+
+_mu = threading.Lock()
+_rmu = threading.RLock()
+_cv = threading.Condition(_mu)

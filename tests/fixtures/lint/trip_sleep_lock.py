@@ -1,0 +1,15 @@
+"""Lint fixture: time.sleep inside a critical section (rule
+sleep-under-lock)."""
+
+import time
+
+from hetu_tpu import locks
+
+
+class Poller:
+    def __init__(self):
+        self._mu = locks.TracedLock("fixture.poller")
+
+    def poll(self):
+        with self._mu:
+            time.sleep(0.5)

@@ -1,0 +1,17 @@
+"""Lint fixture: public method mutates lock-protected state without
+the lock (rule unguarded-shared-write)."""
+
+from hetu_tpu import locks
+
+
+class Counter:
+    def __init__(self):
+        self._mu = locks.TracedLock("fixture.counter")
+        self._n = 0
+
+    def bump(self):
+        with self._mu:
+            self._n += 1
+
+    def reset(self):
+        self._n = 0     # guarded everywhere else: the rule fires here

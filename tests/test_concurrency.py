@@ -1,0 +1,494 @@
+"""Concurrency sanitizer (ISSUE 19): lockdep + the deterministic
+interleaving fuzzer, end to end.
+
+Three layers under test:
+
+- **lockdep** (``hetu_tpu/locks.py``, ``HETU_LOCKDEP=1``): the
+  acquisition-order graph must catch a seeded lock-order inversion and
+  the held-across seams (PS RPC, multi-MB wire encode) — naming both
+  lock sites and both stacks — and must be a no-op with the knob off.
+- **fuzzer** (``HETU_SCHED_FUZZ`` / ``run_interleaved(seed=)``): a
+  planted race must reproduce EXACTLY on the same seed, twice, and on
+  the pinned CI seed — "flaky" is banned from this suite's vocabulary.
+- **hammers**: the threaded core (CacheSparseTable, PrefixDirectory,
+  TieredKVStore, FlightRecorder) under seeded interleavings across a
+  seed sweep, invariants checked after every seed, with lockdep armed
+  so any ordering bug the sweep surfaces is named, not just crashed.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hetu_tpu import locks, telemetry
+from hetu_tpu.analysis.concurrency import (
+    LockdepError, assert_lockdep_clean, run_interleaved)
+from hetu_tpu.cache.cstable import CacheSparseTable
+from hetu_tpu.ps import wire
+from hetu_tpu.ps.server import PSServer
+from hetu_tpu.ps.sharded import ShardedPSClient
+from hetu_tpu.serving.kv_tiers import TieredKVStore
+from hetu_tpu.serving.prefix_directory import PrefixDirectory
+from hetu_tpu.telemetry.events import validate_record
+from hetu_tpu.telemetry.flight import FlightRecorder
+from hetu_tpu.telemetry.trace import check_lockdep
+
+pytestmark = pytest.mark.smoke
+
+W = 4
+VOCAB = 64
+# the pinned CI seed: seed 3 loses 19 of 30 increments in the planted
+# race below, reproducibly (see test_fuzzer_reproduces_planted_race)
+CI_SEED = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_lockdep():
+    locks.lockdep_reset()
+    yield
+    locks.lockdep_reset()
+
+
+# ------------------------------------------------------------------ #
+# lockdep
+# ------------------------------------------------------------------ #
+
+def test_lockdep_detects_order_inversion(monkeypatch):
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    a = locks.TracedLock("test.A")
+    b = locks.TracedLock("test.B")
+    with a:
+        with b:
+            pass
+    assert locks.lockdep_violations() == []   # one order is fine
+    with b:
+        with a:                               # the inversion
+            pass
+    vs = locks.lockdep_violations()
+    assert len(vs) == 1 and vs[0]["kind"] == "order"
+    report = locks.format_violation(vs[0])
+    # the diagnostic names BOTH locks and carries BOTH acquisition
+    # stacks (each pointing into this test file)
+    assert "test.A" in report and "test.B" in report
+    assert report.count("test_concurrency.py") >= 2
+    with pytest.raises(LockdepError) as ei:
+        assert_lockdep_clean("inversion test")
+    assert "test.A" in str(ei.value)
+
+
+def test_lockdep_duplicate_inversions_dedupe(monkeypatch):
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    a = locks.TracedLock("test.A")
+    b = locks.TracedLock("test.B")
+    for _ in range(5):
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert len(locks.lockdep_violations()) == 1
+
+
+def test_lockdep_held_across_rpc_seam(monkeypatch):
+    """The instrumented PS-RPC seam: blocking while holding any traced
+    lock is reported with the held lock's name and acquisition
+    stack."""
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    mu = locks.TracedLock("test.holder")
+    locks.note_blocking("ps_rpc", method="pull")
+    assert locks.lockdep_violations() == []   # not held: fine
+    with mu:
+        locks.note_blocking("ps_rpc", method="pull")
+    vs = locks.lockdep_violations()
+    assert len(vs) == 1 and vs[0]["kind"] == "held_across"
+    report = locks.format_violation(vs[0])
+    assert "test.holder" in report and "ps_rpc" in report
+    assert "test_concurrency.py" in report
+
+
+def test_lockdep_wire_dumps_seam(monkeypatch):
+    """wire.dumps of a multi-MB payload under a held lock is the other
+    blocking seam (the join/copy is real wall time in someone's
+    critical section)."""
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    big = np.zeros(1 << 19, np.float32)       # 2 MiB
+    wire.dumps(big)                           # unheld: fine
+    assert locks.lockdep_violations() == []
+    mu = locks.TracedLock("test.wire_holder")
+    with mu:
+        wire.dumps(big)
+    vs = locks.lockdep_violations()
+    assert len(vs) == 1 and vs[0]["kind"] == "held_across"
+    assert "wire_dumps" in locks.format_violation(vs[0])
+    # small payloads never trip it, held or not
+    locks.lockdep_reset()
+    with mu:
+        wire.dumps(np.zeros(16, np.float32))
+    assert locks.lockdep_violations() == []
+
+
+def test_lockdep_event_contract_and_trace_rule(monkeypatch):
+    """The emitted record is contract-valid and hetu_trace --check's
+    lockdep rule flags it."""
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    a = locks.TracedLock("test.ev_A")
+    b = locks.TracedLock("test.ev_B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    (v,) = locks.lockdep_violations()
+    rec = {"t": 0.0, "event": "lockdep_violation",
+           "kind": v["kind"], "lock": v["lock"], "other": v["other"],
+           "site": v["site"], "msg": v["msg"]}
+    assert validate_record(rec) == []
+    problems = check_lockdep([rec])
+    assert len(problems) == 1
+    assert "test.ev_A" in problems[0] or "test.ev_B" in problems[0]
+    assert check_lockdep([{"t": 0.0, "event": "serve_step"}]) == []
+
+
+def test_lockdep_long_hold(monkeypatch):
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    monkeypatch.setenv("HETU_LOCKDEP_HOLD_MS", "1")
+    mu = locks.TracedLock("test.long_holder")
+    with mu:
+        time.sleep(0.02)
+    vs = locks.lockdep_violations()
+    assert len(vs) == 1 and vs[0]["kind"] == "long_hold"
+    assert "test.long_holder" in locks.format_violation(vs[0])
+
+
+def test_lockdep_hold_histogram(monkeypatch):
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    monkeypatch.setenv("HETU_TELEMETRY", "1")
+    telemetry.reset()
+    mu = locks.TracedLock("test.hist_lock")
+    for _ in range(3):
+        with mu:
+            pass
+    hists = telemetry.snapshot()["histograms"]
+    h = hists.get("lock.hold_ms.test.hist_lock")
+    assert h is not None and h["count"] == 3
+
+
+def test_lockdep_rlock_reentrancy(monkeypatch):
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    mu = locks.TracedRLock("test.re")
+    other = locks.TracedLock("test.re_other")
+    with mu:
+        with mu:          # re-entry: no self-edge, no violation
+            with other:
+                pass
+    assert locks.lockdep_violations() == []
+    assert ("test.re", "test.re_other") in locks.lockdep_edges()
+
+
+def test_lockdep_off_is_inert():
+    """Knob off (the default): no graph, no violations, and the
+    wrapper stays cheap enough for hot paths."""
+    a = locks.TracedLock("test.off_A")
+    b = locks.TracedLock("test.off_B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert locks.lockdep_violations() == []
+    assert locks.lockdep_edges() == {}
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with a:
+            pass
+    traced = time.perf_counter() - t0
+    # generous absolute bound: ~100x headroom over observed cost, but
+    # catches the class of regression where the off-path starts doing
+    # per-acquire graph work (which measures in ms, not us)
+    assert traced < 0.075 * n / 1000 + 0.5, \
+        f"TracedLock off-path cost {traced / n * 1e6:.2f}us/acquire"
+
+
+# ------------------------------------------------------------------ #
+# deterministic interleaving fuzzer
+# ------------------------------------------------------------------ #
+
+def _racy_counter(seed):
+    """Three workers x 10 unprotected read-modify-write increments
+    with the fuzzer's preemption point inside the window."""
+    state = {"n": 0}
+
+    def worker():
+        for _ in range(10):
+            v = state["n"]
+            locks.sched_point()
+            state["n"] = v + 1
+
+    run_interleaved(worker, worker, worker, seed=seed)
+    return state["n"]
+
+
+def _locked_counter(seed):
+    state = {"n": 0}
+    mu = locks.TracedLock("test.counter")
+
+    def worker():
+        for _ in range(10):
+            with mu:
+                v = state["n"]
+                locks.sched_point()
+                state["n"] = v + 1
+
+    run_interleaved(worker, worker, worker, seed=seed)
+    return state["n"]
+
+
+def test_fuzzer_reproduces_planted_race():
+    """The acceptance criterion itself: the planted lost-update race
+    reproduces on the same seed twice and on the pinned CI seed, and
+    the TracedLock'd variant is exact on every seed."""
+    for seed in range(6):
+        first, second = _racy_counter(seed), _racy_counter(seed)
+        assert first == second, f"seed {seed} not reproducible"
+        assert _locked_counter(seed) == 30
+    # the pinned CI seed demonstrably loses updates (30 would mean the
+    # schedule happened to serialize — seed 3 does not)
+    assert _racy_counter(CI_SEED) == 11
+
+
+def test_fuzzer_seeds_differ():
+    """Different seeds explore different interleavings (else the sweep
+    is one schedule run N times)."""
+    assert len({_racy_counter(s) for s in range(8)}) >= 2
+
+
+def test_fuzzer_env_knob(monkeypatch):
+    """HETU_SCHED_FUZZ=<seed> arms run_interleaved without code
+    changes; unset means free OS threads."""
+    monkeypatch.setenv("HETU_SCHED_FUZZ", str(CI_SEED))
+    assert _racy_counter(None) == 11
+    monkeypatch.delenv("HETU_SCHED_FUZZ")
+    state = {"n": 0}
+    mu = threading.Lock()
+
+    def worker():
+        for _ in range(10):
+            with mu:
+                state["n"] += 1
+
+    run_interleaved(worker, worker, seed=None)
+    assert state["n"] == 20
+    assert locks.current_scheduler() is None
+
+
+def test_fuzzer_reraises_thunk_error():
+    def boom():
+        raise ValueError("planted")
+
+    with pytest.raises(ValueError, match="planted"):
+        run_interleaved(boom, lambda: None, seed=0)
+
+
+def test_planted_cstable_race_reproduces(monkeypatch):
+    """Re-introduce the bug class the cstable lock prevents — a public
+    method doing a counter read-modify-write OUTSIDE the lock — and
+    pin it: same seed -> same (wrong) count, twice; guarded variant ->
+    exact on every seed.  comm=None keeps the real update path from
+    touching the planted counter."""
+    real_update = CacheSparseTable.embedding_update
+
+    def planted(self, ids, deltas, assume_unique=False):
+        n = self.num_pushed_rows
+        locks.sched_point()                  # the preemption window
+        self.num_pushed_rows = n + len(ids)
+        real_update(self, ids, deltas, assume_unique)
+
+    def guarded(self, ids, deltas, assume_unique=False):
+        with self._lock:
+            n = self.num_pushed_rows
+            locks.sched_point()
+            self.num_pushed_rows = n + len(ids)
+        real_update(self, ids, deltas, assume_unique)
+
+    def hammer(seed):
+        t = CacheSparseTable(limit=16, vocab_size=VOCAB, width=W,
+                             key="emb", comm=None)
+
+        def worker():
+            for _ in range(5):
+                t.embedding_update([1, 2], np.zeros((2, W), np.float32))
+
+        run_interleaved(worker, worker, worker, seed=seed)
+        return t.num_pushed_rows
+
+    monkeypatch.setattr(CacheSparseTable, "embedding_update", planted)
+    runs = [(hammer(s), hammer(s)) for s in (0, 1, CI_SEED)]
+    assert all(a == b for a, b in runs), runs   # seed-exact, wrong ok
+    assert runs[2][0] < 30, "CI seed failed to surface the plant"
+    monkeypatch.setattr(CacheSparseTable, "embedding_update", guarded)
+    assert all(hammer(s) == 30 for s in (0, 1, CI_SEED))
+
+
+# ------------------------------------------------------------------ #
+# seeded hammers over the threaded core (lockdep armed throughout)
+# ------------------------------------------------------------------ #
+
+class _YieldingComm:
+    """PS comm that hands the scheduler token away inside every RPC —
+    preemption lands mid-transaction, where the bugs live."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def __getattr__(self, name):
+        fn = getattr(self._server, name)
+
+        def wrapper(*a, **kw):
+            locks.sched_point()
+            return fn(*a, **kw)
+        return wrapper
+
+
+def test_cstable_hammer_seed_sweep(monkeypatch):
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    for seed in range(4):
+        server = PSServer()
+        server.param_init("emb", (VOCAB, W), "normal", 0.0, 1.0, seed=3)
+        t = CacheSparseTable(limit=32, vocab_size=VOCAB, width=W,
+                             key="emb", comm=_YieldingComm(server),
+                             policy="LRU", push_bound=0)
+        rngs = [np.random.RandomState(100 * seed + i) for i in range(2)]
+
+        def lookups(rng=rngs[0]):
+            for _ in range(6):
+                rows = t.embedding_lookup(rng.randint(0, VOCAB, 8))
+                assert rows.shape == (8, W)
+
+        def updates(rng=rngs[1]):
+            for _ in range(6):
+                ids = rng.randint(0, VOCAB, 4)
+                t.embedding_update(
+                    ids, rng.randn(4, W).astype(np.float32) * .01)
+
+        run_interleaved(lookups, updates, seed=seed)
+        t.flush()
+        # every delta landed exactly once: cache == PS row for row
+        ids = np.arange(VOCAB)
+        np.testing.assert_allclose(t.embedding_lookup(ids),
+                                   server.sparse_pull("emb", ids),
+                                   rtol=1e-4, atol=1e-5)
+    assert_lockdep_clean("cstable hammer")
+
+
+def test_prefix_directory_hammer_seed_sweep(monkeypatch):
+    """register/evict/drop_replica vs lookup: pre-lock, lookup's dict
+    comprehension over e.replicas raced the register callbacks
+    (RuntimeError: dict changed size during iteration)."""
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    prefixes = [list(range(8 * (i + 1))) for i in range(4)]
+    for seed in range(4):
+        d = PrefixDirectory(ttl=0)
+        d._block = 8
+        registers = 12
+
+        def churn():
+            for i in range(registers):
+                p = prefixes[i % len(prefixes)]
+                d.register(f"r{i % 2}", p)
+                locks.sched_point()
+                if i % 3 == 2:
+                    d.evict(f"r{i % 2}", p)
+
+        def reaper():
+            for i in range(6):
+                locks.sched_point()
+                d.drop_replica(f"r{i % 2}")
+
+        def prober():
+            for _ in range(10):
+                hint, outcome = d.lookup(list(range(17)))
+                assert outcome in (None, "miss", "stale", "tier")
+                locks.sched_point()
+                assert d.snapshot()["entries"] >= 0
+
+        run_interleaved(churn, reaper, prober, seed=seed)
+        assert d.snapshot()["registrations"] == registers
+        d.drop_replica("r0")
+        d.drop_replica("r1")
+        assert d.snapshot()["entries"] == 0
+    assert_lockdep_clean("prefix directory hammer")
+
+
+def _payload(n, nbytes=64):
+    return {"nbytes": nbytes, "length": 8, "blob": b"x" * nbytes,
+            "tag": n}
+
+
+def test_kv_tiers_hammer_seed_sweep(monkeypatch):
+    """spill/fetch/demote vs a mid-hammer PS kill: the residency
+    ledger must balance after close on EVERY seed (each spill ends in
+    exactly one fetch or drop), with zero host-ring residue."""
+    monkeypatch.setenv("HETU_LOCKDEP", "1")
+    prefixes = [tuple(range(8 * (i + 1))) for i in range(4)]
+    for seed in range(4):
+        store = TieredKVStore(
+            host_bytes=160, ps_tier=True,    # ~2 entries: forces
+            ps=ShardedPSClient(servers=[PSServer(), PSServer()]))
+        store.block = 8                      # demotes to the PS rung
+
+        def spiller():
+            for i in range(10):
+                store.spill(prefixes[i % len(prefixes)], _payload(i))
+                locks.sched_point()
+
+        def fetcher():
+            for i in range(10):
+                locks.sched_point()
+                hit = store.lookup(list(prefixes[-1]) + [99])
+                if hit is not None:
+                    store.fetch(hit[0])
+                store.stats()
+
+        def killer():
+            for _ in range(3):
+                locks.sched_point()
+            store.kill_ps("hammer chaos")
+
+        run_interleaved(spiller, fetcher, killer, seed=seed)
+        store.close("hammer done")
+        st = store.stats()
+        assert st["ps_dead"] is True
+        assert sum(st["spills"].values()) == \
+            sum(st["fetches"].values()) + sum(st["drops"].values()), st
+        assert st["host_entries"] == 0 and st["host_used_bytes"] == 0
+        assert st["ps_entries"] == 0
+    assert_lockdep_clean("kv tiers hammer")
+
+
+def test_flight_ring_hammer_seed_sweep(monkeypatch):
+    """The PR's thread-safety fix: record() vs recent()/dump() used to
+    be a lock-free deque append racing list(deque) — RuntimeError at
+    exactly the moment a dying process snapshots its black box."""
+    monkeypatch.setenv("HETU_FLIGHT_DEPTH", "32")
+    for seed in range(4):
+        rec = FlightRecorder(depth=32)
+
+        def writer():
+            for i in range(20):
+                rec.record({"t": 0.0, "event": "span", "i": i})
+                locks.sched_point()
+
+        def snapshotter():
+            for _ in range(15):
+                got = rec.recent()
+                assert all(r["event"] == "span" for r in got)
+                locks.sched_point()
+
+        run_interleaved(writer, writer, snapshotter, seed=seed)
+        assert len(rec.recent()) == 32       # ring full, intact

@@ -54,6 +54,28 @@ def test_cli_env_table():
     assert "| Variable | Type | Default | Description |" in proc.stdout
 
 
+def test_readme_env_table_in_sync():
+    """The drift gate for the knob table: README's env-var section must
+    be byte-for-byte the registry's generated table (``hetu_lint
+    --env-table``).  A knob added without regenerating the table — or
+    documented by hand-editing the README — fails here; the dead-knob
+    lint rule covers the other direction (registered but never
+    read)."""
+    from hetu_tpu.envvars import env_table
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    start = lines.index("| Variable | Type | Default | Description |")
+    table = []
+    for ln in lines[start:]:
+        if not ln.startswith("|"):
+            break
+        table.append(ln)
+    generated = env_table().splitlines()
+    assert table == generated, (
+        "README env table drifted from the registry — regenerate with "
+        "`python bin/hetu_lint.py --env-table` and paste it in")
+
+
 def test_every_rule_documented():
     # the CLI help names each rule's purpose via the module docstring
     from hetu_tpu.analysis import lint as lint_mod
