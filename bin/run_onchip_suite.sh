@@ -949,6 +949,88 @@ if python bin/hetu_trace.py "$LOG/lockdep_red.jsonl" --check \
   exit 1
 fi
 
+# 00l. MoE serving gate (ISSUE 20): one CPU process decodes the MoE
+#      GPT (top-2 of 4 experts, alternating blocks) through the engine
+#      across THREE cache configurations — contiguous fast path,
+#      block-table paged, paged + int8 KV — and requires greedy
+#      TOKEN-IDENTICAL outputs vs offline generate_fast in every one,
+#      plus the routing-attribution invariant on the engine counters
+#      (routed + dropped == tokens x top_k x MoE layers).  A second,
+#      capacity-starved run (cf=0.25) must actually DROP and its serve
+#      stream must still pass hetu_trace --check — the MoE attribution
+#      rule is proven against overflow, not just the easy case.  The
+#      on-chip HETU_BENCH_SERVE run (stage 4c) banks moe_ab with
+#      native kernels — that run is the A/B of record; this gate
+#      proves the path before chip time is spent.
+run moe_gate 900 env HETU_TELEMETRY=1 \
+    HETU_TELEMETRY_LOG="$LOG/moe_trace.jsonl" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np
+import hetu_tpu as ht  # noqa: F401
+from hetu_tpu.models.gpt_decode import generate_fast
+from hetu_tpu.models.moe_decode import (MoEDecodeConfig,
+                                        init_moe_params, moe_spec_of)
+from hetu_tpu.serving import Request, ServingEngine
+
+cfg = MoEDecodeConfig(
+    vocab_size=97, hidden_size=32, num_hidden_layers=4,
+    num_attention_heads=2, ffn_mult=2, seq_len=48, dropout_rate=0.0,
+    max_position_embeddings=48, num_experts=4, top_k=2,
+    capacity_factor=2.0, moe_every=2)
+p = init_moe_params(cfg, name="moe", seed=0)
+prompts = [[5, 9, 2], [7, 1, 4, 3, 8], [11, 6], [13, 2, 2, 7]]
+NEW = 8
+ref = {i: [int(t) for t in np.asarray(
+           generate_fast(p, cfg, [pr], NEW, temperature=0.0, seed=0,
+                         name="moe"))[0][len(pr):]]
+       for i, pr in enumerate(prompts)}
+n_moe = moe_spec_of(cfg).moe_layers(cfg.num_hidden_layers)
+mk = lambda: [Request(request_id=str(i), prompt=pr, max_new_tokens=NEW,
+                      temperature=0.0, seed=0)
+              for i, pr in enumerate(prompts)]
+configs = [("contiguous", dict(fast_path=True)),
+           ("paged", dict(fast_path=True, paged=16)),
+           ("paged_int8", dict(fast_path=True, paged=16,
+                               kv_quant="int8"))]
+for label, kw in configs:
+    eng = ServingEngine(p, cfg, slots=4, name="moe", **kw)
+    out = eng.run(mk())
+    got = {int(i): [int(t) for t in np.asarray(r.tokens)[r.prompt_len:]]
+           for i, r in out.items()}
+    assert got == ref, f"{label}: engine diverged from offline"
+    tot = int(eng.expert_load.sum() + eng.expert_drops.sum())
+    assert tot == eng.moe_tokens * cfg.top_k * n_moe, label
+# capacity-overflow arm: cf=0.25 must drop; identity is NOT claimed
+# here (dropped tokens ride the residual) but the accounting must
+# still close and the stream must pass the trace contract below
+scfg = MoEDecodeConfig(
+    vocab_size=97, hidden_size=32, num_hidden_layers=4,
+    num_attention_heads=2, ffn_mult=2, seq_len=48, dropout_rate=0.0,
+    max_position_embeddings=48, num_experts=4, top_k=2,
+    capacity_factor=0.25, moe_every=2)
+seng = ServingEngine(p, scfg, slots=4, name="moe", fast_path=True,
+                     paged=16)
+seng.run(mk())
+assert int(seng.expert_drops.sum()) > 0, \
+    "cf=0.25 dropped nothing — the overflow path went untested"
+stot = int(seng.expert_load.sum() + seng.expert_drops.sum())
+assert stot == seng.moe_tokens * scfg.top_k * n_moe
+print("moe gate OK: identity over", len(configs), "cache configs,",
+      "overflow drops", int(seng.expert_drops.sum()),
+      "accounted, imbalance",
+      round(float(seng.expert_imbalance), 3))
+PYEOF
+if ! grep -q 'moe gate OK' "$LOG/moe_gate.log"; then
+  echo "MoE serving gate FAILED — see $LOG/moe_gate.log" >&2
+  exit 1
+fi
+python bin/hetu_trace.py "$LOG/moe_trace.jsonl" --check \
+    > "$LOG/moe_trace_contract.log" || {
+  echo "MoE trace contract check FAILED — see" \
+       "$LOG/moe_trace_contract.log" >&2
+  exit 1
+}
+
 # 4e (ordered with the 00-gates: pure-CPU via JAX_PLATFORMS=cpu, so it
 #     must pass BEFORE any chip time is spent).  Speculative-decoding
 #     trace-replay gate: the draft-propose / batched-verify path must
@@ -1074,7 +1156,16 @@ HETU_BENCH_DECODE=1 run decode 3600 python bench.py
 #     everywhere, and the strict tok/s no-worse floor binds HERE
 #     because it is gated to TPU — the CPU harness pays union-width
 #     padding in the masked path and the stage-00j gate only proves
-#     the path).  Runs after decode so the scan compile is already in
+#     the path), PLUS the MoE-vs-dense A/B of record (moe_ab: top-2 of
+#     4 experts at EQUAL ACTIVE PARAMS — expert_size = ffn_size /
+#     top_k — on the same trace/engine config; tok/s + TTFT p99 per
+#     arm, per-expert load, imbalance and drop rate in the artifact;
+#     greedy identity vs offline and the zero-drop-at-serving-cf floor
+#     asserted in-bench, capacity-binding probe must drop with the
+#     accounting invariant intact; the CPU harness pays the full
+#     E-expert einsum whatever the routing, so THIS on-chip row is the
+#     throughput number of record — the stage-00l gate only proves the
+#     path).  Runs after decode so the scan compile is already in
 #     the shared compilation cache.
 HETU_BENCH_SERVE=1 run serve 3600 python bench.py
 

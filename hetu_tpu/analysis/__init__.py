@@ -39,7 +39,8 @@ from .verify import (GraphVerifyError, VerifyReport, verify_graph,
 from .shard_check import (ShardCheckError, check_parallelism,
                           check_mesh_axes, check_divisibility,
                           check_pipeline_stages, check_stage_assignment,
-                          collective_sequence, check_collective_order_static)
+                          collective_sequence, check_collective_order_static,
+                          check_expert_mesh, check_expert_alltoall)
 from .report import emit_records, validation_log_path
 from .integration import validate_executor_build, validate_subgraph_feeds, \
     validate_serving
@@ -57,6 +58,7 @@ __all__ = [
     "ShardCheckError", "check_parallelism", "check_mesh_axes",
     "check_divisibility", "check_pipeline_stages", "check_stage_assignment",
     "collective_sequence", "check_collective_order_static",
+    "check_expert_mesh", "check_expert_alltoall",
     "emit_records", "validation_log_path",
     "validate_executor_build", "validate_subgraph_feeds", "validate_serving",
 ]

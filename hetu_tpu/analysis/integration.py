@@ -178,11 +178,56 @@ def validate_serving(params, config, name, mesh=None):
                     f"{tuple(wpe.shape)[0]} positions, config asks "
                     f"{int(config.max_position_embeddings)}",
                     kind="shape")
+        # MoE serving configs (models/moe_decode.py): every MoE block
+        # must carry the gate + stacked expert weights with the expert
+        # count the config declares — a per-expert leaf with the wrong
+        # leading dim is exactly the corrupt rolling-swap payload the
+        # PR 15 shape validation exists to catch, so catch it at build
+        # too
+        from ..models.moe_decode import moe_spec_of
+        spec = moe_spec_of(config)
+        if spec is not None:
+            E = spec.num_experts
+            for i in range(int(config.num_hidden_layers)):
+                if not spec.is_moe_layer(i):
+                    continue
+                us = f"{name}_h{i}"
+                gate = params.get(f"{us}_moe_gate_weight")
+                w1 = params.get(f"{us}_moe_expert_stack_w1")
+                w2 = params.get(f"{us}_moe_expert_stack_w2")
+                for leaf, v in (("moe_gate_weight", gate),
+                                ("moe_expert_stack_w1", w1),
+                                ("moe_expert_stack_w2", w2)):
+                    if v is None:
+                        raise GraphVerifyError(
+                            f"serving params: MoE layer {i} is missing "
+                            f"{us}_{leaf} (config routes every "
+                            f"{spec.moe_every}th block through "
+                            f"{E} experts)", kind="shape")
+                if tuple(gate.shape) != (H, E):
+                    raise GraphVerifyError(
+                        f"serving params: {us}_moe_gate_weight has "
+                        f"shape {tuple(gate.shape)}, config wants "
+                        f"({H}, {E})", kind="shape")
+                for leaf, v, dim, want in (
+                        ("moe_expert_stack_w1", w1, 0, E),
+                        ("moe_expert_stack_w2", w2, 0, E),
+                        ("moe_expert_stack_w1", w1, 1, H),
+                        ("moe_expert_stack_w2", w2, 2, H)):
+                    if tuple(v.shape)[dim] != want:
+                        raise GraphVerifyError(
+                            f"serving params: {us}_{leaf} dim {dim} is "
+                            f"{tuple(v.shape)[dim]}, config wants "
+                            f"{want} (shape {tuple(v.shape)})",
+                            kind="shape")
         dtypes = sorted({str(v.dtype) for v in params.values()
                          if hasattr(v, "dtype")})
         records.append(make_record(
             "serving_verified", model=name, params=len(params),
-            hidden=H, heads=heads, dtypes=dtypes))
+            hidden=H, heads=heads, dtypes=dtypes,
+            moe=(None if spec is None else
+                 {"experts": spec.num_experts, "top_k": spec.top_k,
+                  "moe_every": spec.moe_every})))
     except (GraphVerifyError, ShardCheckError) as e:
         records.append(make_record(
             "graph_verify_error", model=name, phase="serving",

@@ -24,6 +24,14 @@ host in microseconds:
   complete quantize→collective→dequantize trio on one axis (a quantize
   without its paired dequantize across the collective is rejected
   before compile — the HETU_COMM_QUANT pair contract).
+- :func:`check_expert_mesh` — MoE expert-parallel placement: the expert
+  mesh axis exists and num_experts divides evenly across it (the
+  ``ep_shard_params``/MoE-serving gate).
+- :func:`check_expert_alltoall` — expert dispatch/combine all-to-all
+  pairing (the quant-pair analog): every capacity dispatch reaches a
+  weighted combine, the exchanges between them come in matched pairs on
+  one agreed axis — an odd or axis-mixed exchange chain leaves tokens
+  on the wrong device.
 
 :func:`check_parallelism` is the umbrella the executor wires in under
 ``HETU_VALIDATE=1``: hard violations raise :class:`ShardCheckError`;
@@ -49,6 +57,113 @@ class ShardCheckError(Exception):
         super().__init__(message)
         self.node = node
         self.kind = kind
+
+
+# --------------------------------------------------------------------- #
+# MoE expert-parallel placement (ISSUE 20; Synthesizing Optimal
+# Parallelism Placement — PAPERS.md — grounds the layout choices)
+# --------------------------------------------------------------------- #
+
+def check_expert_mesh(mesh, num_experts, axis="ep"):
+    """Validate an expert-parallel placement BEFORE any device_put or
+    compile: the expert ``axis`` must exist in ``mesh`` and
+    ``num_experts`` must divide evenly across it (each shard owns
+    E/size whole experts — a ragged split would misalign every
+    dispatch/combine all-to-all block).  Raises
+    ShardCheckError(kind='expert_mesh'); returns the axis size."""
+    if mesh is None:
+        raise ShardCheckError(
+            "expert-parallel placement needs a mesh (got None)",
+            kind="expert_mesh")
+    names = tuple(mesh.axis_names)
+    if axis not in names:
+        raise ShardCheckError(
+            f"expert mesh axis {axis!r} absent from mesh axes {names} "
+            f"— the expert stacks would silently replicate and the "
+            f"dispatch all-to-all would no-op", kind="expert_mesh")
+    size = dict(zip(names, mesh.devices.shape))[axis]
+    if num_experts % size != 0:
+        raise ShardCheckError(
+            f"num_experts={num_experts} is not divisible by expert "
+            f"mesh axis {axis!r} (size {size}) — each shard must own "
+            f"E/size whole experts for the a2a block layout to hold",
+            kind="expert_mesh")
+    return size
+
+
+def check_expert_alltoall(eval_nodes):
+    """Expert dispatch/combine all-to-all pairing — the quant-pair
+    analog for MoE graphs (``layers/moe.py`` emits
+    LayoutTransform → a2a → expert FFN → a2a → ReverseLayoutTransform):
+
+    - every capacity dispatch (``LayoutTransformOp``) must reach a
+      weighted combine (a ``ReverseLayoutTransform*`` descendant) —
+      an uncombined dispatch leaves expert-major capacity buffers in
+      the graph exactly like a quantize without its dequantize;
+    - every combine must descend from a dispatch (its
+      indices/locations are meaningless otherwise);
+    - the exchanges BETWEEN a dispatch and its combine must come in
+      matched pairs (dispatch-side + return-side) — an odd count ends
+      the combine on the wrong device's rows;
+    - all exchanges in one dispatch↔combine span agree on the axis.
+
+    Raises ShardCheckError(kind='a2a_pair'); returns the
+    (dispatch, [a2a...], combine) spans found."""
+    from ..graph.ops_moe import (AllToAllOp, HAllToAllOp,
+                                 LayoutTransformOp)
+    topo = _topo_of(eval_nodes)
+    anc = {}
+    for n in topo:
+        s = set()
+        for i in n.inputs:
+            s.add(id(i))
+            s |= anc.get(id(i), set())
+        anc[id(n)] = s
+
+    def _axes(n):
+        return (tuple(n.axes) if isinstance(n, HAllToAllOp)
+                else (n.axis,))
+
+    a2a = [n for n in topo if isinstance(n, (AllToAllOp, HAllToAllOp))]
+    disp = [n for n in topo if isinstance(n, LayoutTransformOp)]
+    comb = [n for n in topo
+            if type(n).__name__.startswith("ReverseLayoutTransform")
+            and "Gradient" not in type(n).__name__]
+    spans = []
+    for d in disp:
+        outs = [c for c in comb if id(d) in anc[id(c)]]
+        if not outs:
+            raise ShardCheckError(
+                f"expert dispatch {d.name} has no paired "
+                f"ReverseLayoutTransform combine downstream — the "
+                f"capacity buffers never return to token order (the "
+                f"a2a analog of a quantize without its dequantize)",
+                node=d, kind="a2a_pair")
+        for c in outs:
+            between = [a for a in a2a
+                       if id(d) in anc[id(a)] and id(a) in anc[id(c)]]
+            if len(between) % 2 != 0:
+                raise ShardCheckError(
+                    f"expert dispatch {d.name} -> combine {c.name} "
+                    f"crosses {len(between)} all-to-all exchange(s) — "
+                    f"exchanges must pair (dispatch-side + "
+                    f"return-side); an odd chain combines another "
+                    f"device's expert rows", node=c, kind="a2a_pair")
+            ax = {_axes(a) for a in between}
+            if len(ax) > 1:
+                raise ShardCheckError(
+                    f"expert dispatch {d.name} -> combine {c.name} "
+                    f"mixes all-to-all axes {sorted(ax)} — the return "
+                    f"exchange must undo the dispatch exchange on the "
+                    f"SAME axis", node=c, kind="a2a_pair")
+            spans.append((d, between, c))
+    for c in comb:
+        if not any(id(d) in anc[id(c)] for d in disp):
+            raise ShardCheckError(
+                f"expert combine {c.name} has no dispatch ancestor — "
+                f"its indices/locations never routed these rows",
+                node=c, kind="a2a_pair")
+    return spans
 
 
 # --------------------------------------------------------------------- #
@@ -393,6 +508,11 @@ def check_parallelism(eval_nodes, mesh, config=None, feed_shapes=None):
     findings = []
     check_mesh_axes(eval_nodes, mesh)
     check_quantized_collectives(eval_nodes)
+    if mesh is not None:
+        # the dispatch/combine pairing rule only bites under a parallel
+        # mesh — a mesh-less executor may legitimately evaluate a bare
+        # LayoutTransform (e.g. to inspect the capacity buffer directly)
+        check_expert_alltoall(eval_nodes)
     findings += check_divisibility(eval_nodes, mesh,
                                    feed_shapes=feed_shapes)
     if config is not None and getattr(config, "pipeline", None):

@@ -297,6 +297,18 @@ _reg("HETU_KV_PS_TIER", "bool", False,
      "ring (prefix payloads keyed by prefix hash, versioned put/get).  "
      "A dead/killed PS degrades the ladder to drop-on-evict with zero "
      "request loss — never an error.", "serving")
+_reg("HETU_MOE_CAPACITY", "float", 0.0,
+     "MoE serving: capacity-factor override for routed expert "
+     "dispatch — per-expert slots per wave are top_k * ceil(tokens / "
+     "num_experts * cf).  Tokens past capacity take the residual path "
+     "(dropped, counted in serve.expert_drops) — never a wrong token.  "
+     "0 = use the model config's own capacity_factor.", "serving")
+_reg("HETU_MOE_QUANT", "str", None,
+     "MoE expert-parallel dispatch/combine all-to-all wire format "
+     "('int8' = symmetric per-row int8 payload + f32 scales over the "
+     "expert exchange, the HETU_COMM_QUANT codec; empty/0/off = full "
+     "precision).  Applies to the explicit shard_map EP reference "
+     "path.", "serving")
 _reg("HETU_EMBED_WAVE", "int", 8,
      "Embedding serving: max requests the engine claims per scoring "
      "wave (one embedding gather + one jitted tower forward per wave; "

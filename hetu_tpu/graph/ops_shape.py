@@ -332,10 +332,15 @@ def argmax_partial_op(a, mask, dim=-1, ctx=None):
 
 
 def cumsum_with_bias_op(a, bias=0.0, dim=0, ctx=None):
-    """cumsum(x + bias) along dim (reference gpu_ops/CumSum.py; used by MoE
-    position computation, TopGate.py)."""
+    """cumsum(x) + bias along dim (reference gpu_ops/CumSum.py; used by MoE
+    position computation, TopGate.py).  The bias is added ONCE per element
+    after the inclusive cumsum — with bias=-1 over a one-hot routing mask
+    this yields each token's 0-based arrival position at its expert, which
+    LayoutTransformOp scatters as ``expert * capacity + location``.
+    (cumsum(x + bias) would accumulate the bias t+1 times and send almost
+    every location negative, silently dropping the token at dispatch.)"""
     return _simple("CumsumWithBias",
-                   lambda x: jnp.cumsum(x + bias, axis=dim), a, ctx=ctx)
+                   lambda x: jnp.cumsum(x, axis=dim) + bias, a, ctx=ctx)
 
 
 def cumsum_op(a, dim=0, ctx=None):

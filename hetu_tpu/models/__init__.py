@@ -34,3 +34,8 @@ from .ctr import (
 )
 from .ncf import neural_mf
 from .moe_models import moe_mlp, moe_transformer_block
+from .moe_decode import (
+    MoEDecodeConfig, MoESpec, moe_spec_of, moe_capacity, moe_ffn,
+    moe_ffn_ep_reference, ep_shard_params, init_moe_params,
+    convert_dense_to_moe, resolve_moe_capacity, resolve_moe_quant,
+)

@@ -223,8 +223,83 @@ def _gelu_tanh(x):
         0.7978845608028654 * (x + 0.044715 * x ** 3)))
 
 
+# ------------------------- MoE plumbing ------------------------- #
+#
+# A MoE GPT rides the SAME six compiled cores: the cfg_tuple grows an
+# optional sixth element — a hashable ``moe_decode.MoESpec`` — and the
+# FFN sublayer (factored into ``_ffn_block`` below) swaps the dense
+# wi/wo matmuls for top-k routed expert dispatch on the spec's MoE
+# layers.  Every existing 5-tuple stays a dense GPT bit for bit; the
+# spec is jit-static, so dense and MoE models compile separate programs
+# through one code path.  A ``draft=True`` spec (the truncated-layer
+# speculative draft) SKIPS ROUTING ENTIRELY — its MoE blocks are
+# attention-only (zero FFN contribution), so drafting needs no
+# dispatch, no capacity, and no expert reads; verification still owns
+# every emitted token, so acceptance semantics are untouched.
+
+
+def _moe_of(cfg_tuple):
+    """The cfg_tuple's optional sixth element: a ``MoESpec`` routing
+    descriptor, or None for a dense GPT (every pre-MoE tuple)."""
+    return cfg_tuple[5] if len(cfg_tuple) > 5 else None
+
+
+def _moe_active(cfg_tuple):
+    """True when this core ROUTES (and therefore reports per-expert
+    load/drop stats): a MoE spec that is not the routing-skipping
+    draft."""
+    moe = _moe_of(cfg_tuple)
+    return moe is not None and not moe.draft
+
+
+def _strip_moe(out, cfg_tuple):
+    """Drop the trailing (load, drop, tokens) stats element the serve
+    wrappers append under an active MoE cfg_tuple — the offline
+    callers (``_generate_spec``) discard routing telemetry."""
+    return out[:-1] if _moe_active(cfg_tuple) else out
+
+
+def _moe_stats_out(stats, moe, tokens):
+    """The serve wrappers' trailing return element: (load [E] int32,
+    drop [E] int32, routed-token count scalar int32) summed over every
+    MoE layer of the call."""
+    z = jnp.zeros((moe.num_experts,), jnp.int32)
+    return (jnp.asarray(stats.get("load", z), jnp.int32),
+            jnp.asarray(stats.get("drop", z), jnp.int32),
+            jnp.asarray(tokens, jnp.int32))
+
+
+def _ffn_block(params, us, h, i, moe=None, valid=None, stats=None):
+    """The FFN sublayer every core shares: LN2 then dense
+    wi→gelu→wo normally; on a MoE block (``moe`` set and layer ``i``
+    routed), the top-k expert dispatch of ``moe_decode.moe_ffn`` over
+    ALL of the call's token positions flattened (capacity is per
+    dispatch, matching training's per-batch capacity); a draft spec
+    returns ``h`` untouched (attention-only block).  ``valid`` (bool,
+    h's leading shape) masks pad/dead positions out of routing so they
+    never compete for expert capacity; ``stats`` accumulates the
+    per-expert load/drop counts."""
+    if moe is not None and moe.is_moe_layer(i):
+        if moe.draft:
+            return h
+        from .moe_decode import moe_ffn
+        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+        shp = x.shape
+        xf = x.reshape(-1, shp[-1])
+        vf = None if valid is None else jnp.broadcast_to(
+            valid, shp[:-1]).reshape(-1)
+        y = moe_ffn(params, us, xf, moe, valid=vf, stats=stats)
+        return h + y.reshape(shp)
+    x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
+    f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
+                   + params[f"{us}_ffn_wi_bias"])
+    f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
+    return h + f
+
+
 def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
-                 attn="masked", block_tables=None, live_mask=None):
+                 attn="masked", block_tables=None, live_mask=None,
+                 moe_stats=None, token_valid=None):
     """One incremental position: token [B] int32 at position ``pos``.
     Returns (logits [B, V], new cache_k, new cache_v).
 
@@ -250,8 +325,16 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
     mid-chunked-prefill must not have its freshly written prompt KV
     clobbered by the frozen-position write the contiguous layout could
     shrug off.  Offline ``generate_fast`` and the serving engine share
-    this one core; the layout is a parameter, not a fork."""
-    name, L, H, Dh, S_max = cfg_tuple
+    this one core; the layout is a parameter, not a fork.
+
+    ``token_valid`` ([B] bool) excludes ride-along rows from MoE
+    routing (falling back to ``live_mask`` when paged); ``moe_stats``
+    (dict) accumulates per-expert load/drop across the MoE layers.
+    Both are ignored by dense cfg_tuples."""
+    name, L, H, Dh, S_max = cfg_tuple[:5]
+    moe = _moe_of(cfg_tuple)
+    if token_valid is None:
+        token_valid = live_mask
     B = token.shape[0]
     hdim = H * Dh
     per_slot = jnp.ndim(pos) > 0
@@ -340,11 +423,8 @@ def _decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
         o = o @ params[f"{us}_attn_proj_weight"] \
             + params[f"{us}_attn_proj_bias"]
         h = h + o
-        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
-        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
-                       + params[f"{us}_ffn_wi_bias"])
-        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
-        h = h + f
+        h = _ffn_block(params, us, h, i, moe=moe, valid=token_valid,
+                       stats=moe_stats)
 
     h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
     # logits in f32 regardless of compute dtype: sampling compares and
@@ -419,7 +499,7 @@ def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
     then pads with ``pad_id``; once EVERY row is done the per-step body
     is skipped via lax.cond — a runtime short-circuit inside the single
     compiled scan."""
-    name, L, H, Dh, S_max = cfg_tuple
+    name, L, H, Dh, S_max = cfg_tuple[:5]
     B = prompt_padded.shape[0]
     # cache dtype follows the weights: bf16 decode halves the KV cache
     # and runs the matmuls on the fast MXU path
@@ -463,7 +543,8 @@ def _generate_scan(params, cfg_tuple, prompt_padded, prompt_len,
 # --------------------------- flash prefill --------------------------- #
 
 
-def _prefill_forward(params, cfg_tuple, tokens, kv_lens):
+def _prefill_forward(params, cfg_tuple, tokens, kv_lens,
+                     row_valid=None, moe_stats=None):
     """ONE full-prompt forward over a bucket-padded token block: every
     layer's K/V for all positions in one batched pass — the MXU sees
     [P, D] matmuls instead of P sequential launches of [1, D], and
@@ -474,12 +555,22 @@ def _prefill_forward(params, cfg_tuple, tokens, kv_lens):
     K/V are deterministic garbage the decode mask never admits before
     overwrite); kv_lens: [N] int32.  Returns (logits [N, V] f32 at each
     row's prompt_len-1, ks, vs [L, N, P_b, H, Dh]).
-    """
+
+    ``row_valid`` ([N] bool) marks REAL rows: the engine pads a group
+    to a pow2 N by replicating entry 0, and while those duplicate rows'
+    cache writes are order-safe no-ops, a MoE cfg must keep them (and
+    every pad position) out of expert routing — they would compete for
+    capacity and skew the load counters.  ``moe_stats`` as in
+    ``_decode_step``."""
     from ..kernels.flash_attention import flash_attention
-    name, L, H, Dh, S_max = cfg_tuple
+    name, L, H, Dh, S_max = cfg_tuple[:5]
+    moe = _moe_of(cfg_tuple)
     N, P_b = tokens.shape
     hdim = H * Dh
     kv_lens = kv_lens.astype(jnp.int32)
+    tok_valid = jnp.arange(P_b)[None, :] < kv_lens[:, None]  # [N, P_b]
+    if row_valid is not None:
+        tok_valid = tok_valid & row_valid[:, None]
     h = params[f"{name}_wte_table"][tokens] \
         + params[f"{name}_wpe"][jnp.arange(P_b)][None]
     ks, vs = [], []
@@ -496,11 +587,8 @@ def _prefill_forward(params, cfg_tuple, tokens, kv_lens):
         o = o.reshape(N, P_b, hdim) @ params[f"{us}_attn_proj_weight"] \
             + params[f"{us}_attn_proj_bias"]
         h = h + o
-        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
-        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
-                       + params[f"{us}_ffn_wi_bias"])
-        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
-        h = h + f
+        h = _ffn_block(params, us, h, i, moe=moe, valid=tok_valid,
+                       stats=moe_stats)
         ks.append(k)
         vs.append(v)
     h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
@@ -527,7 +615,7 @@ def _generate_flash(params, cfg_tuple, prompt_bucket, prompt_len,
     Returns (first_gen [B] — the token at position prompt_len — and
     toks [B, S_max-1] where toks[:, t] is the token at position t+1,
     junk for t < prompt_len; the caller overlays the prompt)."""
-    name, L, H, Dh, S_max = cfg_tuple
+    name, L, H, Dh, S_max = cfg_tuple[:5]
     B, P_b = prompt_bucket.shape
     cdtype = params[f"{name}_wte_table"].dtype
     logits, ks, vs = _prefill_forward(
@@ -587,15 +675,31 @@ def _serve_prefill(params, cfg_tuple, cache_k, cache_v, slot, prompt,
     Positions at or past prompt_len are skipped via lax.cond (the
     bucket's padded tail costs no compute); recompiles once per prompt-
     length BUCKET, not per length.  Returns (first_token, cache_k,
-    cache_v, new_rng_key)."""
-    name, L, H, Dh, S_max = cfg_tuple
+    cache_v, new_rng_key[, moe stats] — the trailing (load, drop,
+    tokens) element appears only under an active MoE cfg_tuple)."""
+    name, L, H, Dh, S_max = cfg_tuple[:5]
+    moe_on = _moe_active(cfg_tuple)
     P_b = prompt.shape[0]
     V = params[f"{name}_wte_table"].shape[0]
     ck = _kv_slot_slice(cache_k, slot, (L, 1, S_max, H, Dh))
     cv = _kv_slot_slice(cache_v, slot, (L, 1, S_max, H, Dh))
+    if moe_on:
+        E = _moe_of(cfg_tuple).num_experts
+        st0 = (jnp.zeros((E,), jnp.int32), jnp.zeros((E,), jnp.int32),
+               jnp.int32(0))
 
     def step(carry, t):
         def live(carry):
+            if moe_on:
+                ck, cv, last, st = carry
+                sd = {}
+                logits, ck, cv = _decode_step(
+                    params, cfg_tuple, ck, cv, t, prompt[t][None],
+                    moe_stats=sd)
+                st = (st[0] + sd["load"], st[1] + sd["drop"],
+                      st[2] + 1)
+                last = jnp.where(t == prompt_len - 1, logits[0], last)
+                return ck, cv, last, st
             ck, cv, last = carry
             logits, ck, cv = _decode_step(
                 params, cfg_tuple, ck, cv, t, prompt[t][None])
@@ -603,18 +707,24 @@ def _serve_prefill(params, cfg_tuple, cache_k, cache_v, slot, prompt,
             return ck, cv, last
         return jax.lax.cond(t < prompt_len, live, lambda c: c, carry), None
 
-    (ck, cv, last), _ = jax.lax.scan(
-        step, (ck, cv, jnp.zeros((V,), jnp.float32)), jnp.arange(P_b))
+    carry0 = (ck, cv, jnp.zeros((V,), jnp.float32))
+    if moe_on:
+        carry0 = carry0 + (st0,)
+    carry, _ = jax.lax.scan(step, carry0, jnp.arange(P_b))
+    ck, cv, last = carry[:3]
     cache_k = _kv_slot_update(cache_k, ck, slot)
     cache_v = _kv_slot_update(cache_v, cv, slot)
     rng_key, sub = jax.random.split(rng_key)
     first = _sample_slot(last, temperature, top_k, sub)
-    return first, cache_k, cache_v, rng_key
+    out = (first, cache_k, cache_v, rng_key)
+    if moe_on:
+        out = out + (carry[3],)
+    return out
 
 
 def _serve_prefill_batch(params, cfg_tuple, cache_k, cache_v, slots,
                          prompts, prompt_lens, temperature, top_k,
-                         rng_keys):
+                         rng_keys, row_valid=None):
     """Flash prefill of a BUCKETED GROUP of admissions in one dispatch:
     ``_prefill_forward`` computes every layer's K/V for all N prompts
     at once, the rows scatter into their cache slots, and each request
@@ -622,11 +732,15 @@ def _serve_prefill_batch(params, cfg_tuple, cache_k, cache_v, slots,
     prompts [N, P_b]; prompt_lens/temperature/top_k [N]; rng_keys
     [N, 2].  The engine pads a group to a pow2 N by REPLICATING entry 0
     (duplicate scatter indices write identical values, so the pad rows
-    are order-safe no-ops).  Returns (first_tokens [N], cache_k,
-    cache_v, new_rng_keys)."""
+    are order-safe no-ops).  ``row_valid`` [N] bool marks the REAL
+    rows (MoE routing exclusion — see ``_prefill_forward``).  Returns
+    (first_tokens [N], cache_k, cache_v, new_rng_keys[, moe stats])."""
     N, P_b = prompts.shape
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, ks, vs = _prefill_forward(params, cfg_tuple, prompts,
-                                      prompt_lens)
+                                      prompt_lens, row_valid=row_valid,
+                                      moe_stats=sd)
     cache_k = _kv_scatter(cache_k,
                           (slice(None), slots, slice(0, P_b)), ks)
     cache_v = _kv_scatter(cache_v,
@@ -634,11 +748,19 @@ def _serve_prefill_batch(params, cfg_tuple, cache_k, cache_v, slots,
     splits = jax.vmap(jax.random.split)(rng_keys)          # [N,2,2]
     new_keys, subs = splits[:, 0], splits[:, 1]
     first = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
-    return first, cache_k, cache_v, new_keys
+    out = (first, cache_k, cache_v, new_keys)
+    if moe_on:
+        lens = jnp.clip(prompt_lens, 0, P_b)
+        if row_valid is not None:
+            lens = jnp.where(row_valid, lens, 0)
+        out = out + (_moe_stats_out(sd, _moe_of(cfg_tuple),
+                                    jnp.sum(lens)),)
+    return out
 
 
 def _serve_decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
-                       temperature, top_k, rng_keys, attn="masked"):
+                       temperature, top_k, rng_keys, attn="masked",
+                       live=None):
     """One fused decode step over ALL slots: slot b consumes ``token[b]``
     at its own position ``pos[b]`` (per-slot attention masking inside
     ``_decode_step``) and samples its next token from its own rng
@@ -647,13 +769,23 @@ def _serve_decode_step(params, cfg_tuple, cache_k, cache_v, pos, token,
     ride along harmlessly: their frozen-position writes land in rows the
     next prefill/decode overwrites before any mask admits them.
     ``attn`` (static): "masked" reference or the "ragged" paged decode
-    kernel (per-slot filled lengths bound the KV blocks fetched)."""
+    kernel (per-slot filled lengths bound the KV blocks fetched).
+    ``live`` [B] bool (MoE configs) keeps ride-along free slots out of
+    expert routing; dense configs ignore it."""
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, cache_k, cache_v = _decode_step(
-        params, cfg_tuple, cache_k, cache_v, pos, token, attn=attn)
+        params, cfg_tuple, cache_k, cache_v, pos, token, attn=attn,
+        moe_stats=sd, token_valid=live)
     splits = jax.vmap(jax.random.split)(rng_keys)          # [B,2,2]
     new_keys, subs = splits[:, 0], splits[:, 1]
     sampled = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
-    return sampled, cache_k, cache_v, new_keys
+    out = (sampled, cache_k, cache_v, new_keys)
+    if moe_on:
+        n = (token.shape[0] if live is None
+             else jnp.sum(live.astype(jnp.int32)))
+        out = out + (_moe_stats_out(sd, _moe_of(cfg_tuple), n),)
+    return out
 
 
 def _serve_decode_paged(params, cfg_tuple, cache_k, cache_v, tables,
@@ -665,13 +797,19 @@ def _serve_decode_paged(params, cfg_tuple, cache_k, cache_v, tables,
     the slots actually decoding this wave (admitted, prompt fully
     prefilled) — inert slots ride along with their writes pointed at
     scratch block 0 and their sampled token discarded by the host."""
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, cache_k, cache_v = _decode_step(
         params, cfg_tuple, cache_k, cache_v, pos, token, attn=attn,
-        block_tables=tables, live_mask=live)
+        block_tables=tables, live_mask=live, moe_stats=sd)
     splits = jax.vmap(jax.random.split)(rng_keys)          # [B,2,2]
     new_keys, subs = splits[:, 0], splits[:, 1]
     sampled = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
-    return sampled, cache_k, cache_v, new_keys
+    out = (sampled, cache_k, cache_v, new_keys)
+    if moe_on:
+        out = out + (_moe_stats_out(
+            sd, _moe_of(cfg_tuple), jnp.sum(live.astype(jnp.int32))),)
+    return out
 
 
 # ---------------------- speculative decoding ---------------------- #
@@ -694,7 +832,8 @@ def _serve_decode_paged(params, cfg_tuple, cache_k, cache_v, tables,
 
 
 def _verify_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
-                 q_len, attn="masked", block_tables=None):
+                 q_len, attn="masked", block_tables=None,
+                 moe_stats=None):
     """Multi-position verify: slot b consumes ``tokens[b, :q_len[b]]``
     at positions ``pos[b] .. pos[b]+q_len[b]-1`` in ONE batched step.
     Returns (logits [B, Q, V] f32, new cache_k, new cache_v) — row
@@ -711,7 +850,8 @@ def _verify_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
     route them to scratch block 0 like every other inert write.
     ``attn``/``block_tables`` select the implementation and layout as
     in ``_decode_step``."""
-    name, L, H, Dh, S_max = cfg_tuple
+    name, L, H, Dh, S_max = cfg_tuple[:5]
+    moe = _moe_of(cfg_tuple)
     B, Q = tokens.shape
     hdim = H * Dh
     paged = block_tables is not None
@@ -794,11 +934,8 @@ def _verify_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
         o = o @ params[f"{us}_attn_proj_weight"] \
             + params[f"{us}_attn_proj_bias"]
         h = h + o
-        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
-        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
-                       + params[f"{us}_ffn_wi_bias"])
-        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
-        h = h + f
+        h = _ffn_block(params, us, h, i, moe=moe, valid=valid,
+                       stats=moe_stats)
     h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
     logits = (h @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
         + params.get(f"{name}_head_bias", 0.0)
@@ -846,12 +983,19 @@ def _serve_verify(params, cfg_tuple, cache_k, cache_v, pos, tokens,
     """One fused VERIFY wave over all slots (contiguous layout): write
     + score the q-block, then sample every position from each slot's
     own rng stream.  Returns (sampled [B, Q], cache_k, cache_v,
-    keys_after [B, Q, 2])."""
+    keys_after [B, Q, 2][, moe stats])."""
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, cache_k, cache_v = _verify_step(
         params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
-        attn=attn)
+        attn=attn, moe_stats=sd)
     sampled, after = _spec_sample(logits, temperature, top_k, rng_keys)
-    return sampled, cache_k, cache_v, after
+    out = (sampled, cache_k, cache_v, after)
+    if moe_on:
+        out = out + (_moe_stats_out(
+            sd, _moe_of(cfg_tuple),
+            jnp.sum(jnp.clip(q_len, 0, tokens.shape[1]))),)
+    return out
 
 
 def _serve_verify_paged(params, cfg_tuple, cache_k, cache_v, tables,
@@ -860,11 +1004,18 @@ def _serve_verify_paged(params, cfg_tuple, cache_k, cache_v, tables,
     """``_serve_verify`` over the block-table paged pool (``q_len`` 0
     marks inert slots — mid-prefill or free — whose writes are routed
     to scratch and whose samples/keys the host discards)."""
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, cache_k, cache_v = _verify_step(
         params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
-        attn=attn, block_tables=tables)
+        attn=attn, block_tables=tables, moe_stats=sd)
     sampled, after = _spec_sample(logits, temperature, top_k, rng_keys)
-    return sampled, cache_k, cache_v, after
+    out = (sampled, cache_k, cache_v, after)
+    if moe_on:
+        out = out + (_moe_stats_out(
+            sd, _moe_of(cfg_tuple),
+            jnp.sum(jnp.clip(q_len, 0, tokens.shape[1]))),)
+    return out
 
 
 def _spec_propose(params, cfg_tuple, cache_k, cache_v, pos, token, k):
@@ -911,7 +1062,10 @@ def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
     the sample is meaningful only on the final chunk, and the HOST
     applies new_rng_key only then, so the request's rng stream is
     split exactly once, same as the unchunked paths."""
-    name, L, H, Dh, S_max = cfg_tuple
+    name, L, H, Dh, S_max = cfg_tuple[:5]
+    moe = _moe_of(cfg_tuple)
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     C_b = tokens.shape[0]
     T = table_row.shape[0]
     bs_blk = _kv_shape(cache_k)[2]
@@ -947,11 +1101,8 @@ def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
         o = o.reshape(C_b, hdim) @ params[f"{us}_attn_proj_weight"] \
             + params[f"{us}_attn_proj_bias"]
         h = h + o
-        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
-        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
-                       + params[f"{us}_ffn_wi_bias"])
-        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
-        h = h + f
+        h = _ffn_block(params, us, h, i, moe=moe, valid=ii < n_tok,
+                       stats=sd)
         cache_k = _kv_scatter(cache_k, (i, wblk, woff), k)
         cache_v = _kv_scatter(cache_v, (i, wblk, woff), v)
     hf = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
@@ -960,27 +1111,42 @@ def _serve_prefill_chunk(params, cfg_tuple, cache_k, cache_v, table_row,
         + params.get(f"{name}_head_bias", 0.0)
     rng_key, sub = jax.random.split(rng_key)
     first = _sample_slot(logits, temperature, top_k, sub)
-    return first, cache_k, cache_v, rng_key
+    out = (first, cache_k, cache_v, rng_key)
+    if moe_on:
+        out = out + (_moe_stats_out(sd, moe,
+                                    jnp.clip(n_tok, 0, C_b)),)
+    return out
 
 
 def _serve_prefill_batch_paged(params, cfg_tuple, cache_k, cache_v,
                                prompts, prompt_lens, temperature, top_k,
-                               rng_keys, wblk, woff):
+                               rng_keys, wblk, woff, row_valid=None):
     """Flash prefill of an admission group scattered into BLOCKS: the
     same one-dispatch ``_prefill_forward`` as the contiguous fast path,
     but every (request, position)'s K/V lands in the pool block the
     host-built ``wblk``/``woff`` [N, P_b] maps name (pad positions and
     replicated pad rows target scratch block 0 / duplicate identical
-    writes — order-safe).  Returns (first_tokens [N], cache_k, cache_v,
-    new_rng_keys)."""
+    writes — order-safe).  ``row_valid`` [N] bool marks real rows (MoE
+    routing exclusion).  Returns (first_tokens [N], cache_k, cache_v,
+    new_rng_keys[, moe stats])."""
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, ks, vs = _prefill_forward(params, cfg_tuple, prompts,
-                                      prompt_lens)
+                                      prompt_lens, row_valid=row_valid,
+                                      moe_stats=sd)
     cache_k = _kv_scatter(cache_k, (slice(None), wblk, woff), ks)
     cache_v = _kv_scatter(cache_v, (slice(None), wblk, woff), vs)
     splits = jax.vmap(jax.random.split)(rng_keys)          # [N,2,2]
     new_keys, subs = splits[:, 0], splits[:, 1]
     first = jax.vmap(_sample_slot)(logits, temperature, top_k, subs)
-    return first, cache_k, cache_v, new_keys
+    out = (first, cache_k, cache_v, new_keys)
+    if moe_on:
+        lens = jnp.clip(prompt_lens, 0, prompts.shape[1])
+        if row_valid is not None:
+            lens = jnp.where(row_valid, lens, 0)
+        out = out + (_moe_stats_out(sd, _moe_of(cfg_tuple),
+                                    jnp.sum(lens)),)
+    return out
 
 
 # --- mixed-mode ragged dispatch (ISSUE 18) ------------------------- #
@@ -1000,7 +1166,7 @@ def _serve_prefill_batch_paged(params, cfg_tuple, cache_k, cache_v,
 
 def _mixed_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
                 q_len, self_fresh, attn="masked", block_tables=None,
-                has_fresh=False):
+                has_fresh=False, moe_stats=None):
     """One MIXED wave: slot b consumes ``tokens[b, :q_len[b]]`` at
     positions ``pos[b] .. pos[b]+q_len[b]-1`` — whatever mode those
     tokens are (prompt chunk, draft+bonus verify block, single decode
@@ -1023,7 +1189,8 @@ def _mixed_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
     marks.  The ragged path hands the whole wave to the mixed-mode
     kernel, which reads everything back from the pool (the fast path's
     existing round-trip semantics)."""
-    name, L, H, Dh, S_max = cfg_tuple
+    name, L, H, Dh, S_max = cfg_tuple[:5]
+    moe = _moe_of(cfg_tuple)
     B, Q = tokens.shape
     hdim = H * Dh
     paged = block_tables is not None
@@ -1126,11 +1293,8 @@ def _mixed_step(params, cfg_tuple, cache_k, cache_v, pos, tokens,
         o = o @ params[f"{us}_attn_proj_weight"] \
             + params[f"{us}_attn_proj_bias"]
         h = h + o
-        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
-        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
-                       + params[f"{us}_ffn_wi_bias"])
-        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
-        h = h + f
+        h = _ffn_block(params, us, h, i, moe=moe, valid=valid,
+                       stats=moe_stats)
     h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
     logits = (h @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
         + params.get(f"{name}_head_bias", 0.0)
@@ -1144,13 +1308,20 @@ def _serve_mixed(params, cfg_tuple, cache_k, cache_v, pos, tokens,
     score every slot's ragged q-block, then sample each slot's live
     sampling window from its own rng stream (``first_row`` per
     ``_spec_sample``).  Returns (sampled [B, Q], cache_k, cache_v,
-    keys_after [B, Q, 2])."""
+    keys_after [B, Q, 2][, moe stats])."""
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, cache_k, cache_v = _mixed_step(
         params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
-        self_fresh, attn=attn)
+        self_fresh, attn=attn, moe_stats=sd)
     sampled, after = _spec_sample(logits, temperature, top_k, rng_keys,
                                   first_row, q_len)
-    return sampled, cache_k, cache_v, after
+    out = (sampled, cache_k, cache_v, after)
+    if moe_on:
+        out = out + (_moe_stats_out(
+            sd, _moe_of(cfg_tuple),
+            jnp.sum(jnp.clip(q_len, 0, tokens.shape[1]))),)
+    return out
 
 
 def _serve_mixed_paged(params, cfg_tuple, cache_k, cache_v, tables,
@@ -1161,13 +1332,20 @@ def _serve_mixed_paged(params, cfg_tuple, cache_k, cache_v, tables,
     marks inert slots, whose writes route to scratch block 0 and whose
     samples/keys the host discards).  ``has_fresh`` (static) marks
     waves carrying prompt-chunk slots — see ``_mixed_step``."""
+    moe_on = _moe_active(cfg_tuple)
+    sd = {} if moe_on else None
     logits, cache_k, cache_v = _mixed_step(
         params, cfg_tuple, cache_k, cache_v, pos, tokens, q_len,
         self_fresh, attn=attn, block_tables=tables,
-        has_fresh=has_fresh)
+        has_fresh=has_fresh, moe_stats=sd)
     sampled, after = _spec_sample(logits, temperature, top_k, rng_keys,
                                   first_row, q_len)
-    return sampled, cache_k, cache_v, after
+    out = (sampled, cache_k, cache_v, after)
+    if moe_on:
+        out = out + (_moe_stats_out(
+            sd, _moe_of(cfg_tuple),
+            jnp.sum(jnp.clip(q_len, 0, tokens.shape[1]))),)
+    return out
 
 
 @functools.lru_cache(maxsize=None)
@@ -1315,6 +1493,8 @@ def teacher_forced_logits(params, config, seq, kv_fake_quant=False,
     threshold are genuine near-ties where either token is defensible.
     """
     c = config
+    from .moe_decode import moe_spec_of
+    moe = moe_spec_of(c)
     name = _infer_name(params, name)
     params = {k: _prep_param(v) for k, v in params.items()
               if k.startswith(name + "_")}
@@ -1345,11 +1525,7 @@ def teacher_forced_logits(params, config, seq, kv_fake_quant=False,
         o = o @ params[f"{us}_attn_proj_weight"] \
             + params[f"{us}_attn_proj_bias"]
         h = h + o
-        x = _ln(h, params[f"{us}_ln2_scale"], params[f"{us}_ln2_bias"])
-        f = _gelu_tanh(x @ params[f"{us}_ffn_wi_weight"]
-                       + params[f"{us}_ffn_wi_bias"])
-        f = f @ params[f"{us}_ffn_wo_weight"] + params[f"{us}_ffn_wo_bias"]
-        h = h + f
+        h = _ffn_block(params, us, h, i, moe=moe)
     h = _ln(h, params[f"{name}_ln_f_scale"], params[f"{name}_ln_f_bias"])
     logits = (h @ params[f"{name}_wte_table"].T).astype(jnp.float32) \
         + params.get(f"{name}_head_bias", 0.0)
@@ -1417,8 +1593,12 @@ def _generate_spec(params, cfg_tuple, draft_layers, prompts, num_tokens,
     (PRNGKey(seed + row)), so sampled outputs match the serving
     engine's per-request streams, not the offline batch-keyed scan.
     Finished rows ride along with q_len 0 (their state frozen)."""
-    name, L, H, Dh, S_max = cfg_tuple
+    name, L, H, Dh, S_max = cfg_tuple[:5]
+    moe = _moe_of(cfg_tuple)
     cfg_d = (name, draft_layers, H, Dh, S_max)
+    if moe is not None:
+        # the draft skips routing entirely: attention-only MoE blocks
+        cfg_d = cfg_d + (moe._replace(draft=True),)
     B, P = prompts.shape
     cdtype = params[f"{name}_wte_table"].dtype
     Q = spec_k + 1
@@ -1437,10 +1617,12 @@ def _generate_spec(params, cfg_tuple, draft_layers, prompts, num_tokens,
     keys = np.stack([np.asarray(jax.random.PRNGKey(seed + r), np.uint32)
                      for r in range(B)])
     prefill = serve_prefill_batch_fn(True)
-    first, ck, cv, keys = prefill(params, cfg_tuple, ck, cv, slots,
-                                  padb, lens, temps, topks, keys)
+    first, ck, cv, keys = _strip_moe(
+        prefill(params, cfg_tuple, ck, cv, slots, padb, lens, temps,
+                topks, keys), cfg_tuple)
     # draft cache prefill: same prompts, truncated depth; its sampled
-    # tokens and key splits are discarded (the draft never samples)
+    # tokens and key splits are discarded (the draft never samples;
+    # a draft MoE spec appends no stats either)
     _, dck, dcv, _ = prefill(params, cfg_d, dck, dcv, slots, padb,
                              lens, temps, topks, np.array(keys))
     propose = spec_propose_fn(True)
@@ -1467,8 +1649,9 @@ def _generate_spec(params, cfg_tuple, draft_layers, prompts, num_tokens,
         qlen = np.where(done, 0,
                         np.minimum(Q, num_tokens - emitted)).astype(
                             np.int32)
-        tgt, ck, cv, after = verify(params, cfg_tuple, ck, cv, pos,
-                                    tokens, qlen, temps, topks, keys)
+        tgt, ck, cv, after = _strip_moe(
+            verify(params, cfg_tuple, ck, cv, pos, tokens, qlen,
+                   temps, topks, keys), cfg_tuple)
         tgt = np.asarray(tgt)
         after = np.array(after, np.uint32)
         for b in range(B):
@@ -1545,6 +1728,12 @@ def generate_fast(params, config, prompts, num_tokens, temperature=0.0,
     Dh = c.hidden_size // c.num_attention_heads
     cfg_tuple = (name, c.num_hidden_layers, c.num_attention_heads,
                  Dh, S_max)
+    from .moe_decode import moe_spec_of
+    mspec = moe_spec_of(c)
+    if mspec is not None:
+        # the hashable MoESpec rides the jit-static cfg_tuple as an
+        # optional sixth element — dense 5-tuples compile unchanged
+        cfg_tuple = cfg_tuple + (mspec,)
     # dtype=None FOLLOWS the params (bf16 weights decode bf16 with a
     # bf16 cache — the "follow the weights" contract; the old default
     # silently upcast everything to f32)

@@ -1,0 +1,471 @@
+"""MoE serving: top-k routed expert FFN inside the ONE compiled core.
+
+The fork is the MoE-oriented Hetu branch, yet PRs 2–18 built the whole
+serving stack dense-GPT-only.  This module threads the flagship model
+family through it: ``MoEDecodeConfig`` describes a GPT whose FFN blocks
+(every ``moe_every``-th layer, the BertMoE alternation) are top-k
+routed expert stacks, and :func:`moe_ffn` is the pure-jax serving twin
+of ``layers/moe.py``'s graph-op gate math — same softmax gate, same
+``capacity = k * ceil(tokens/E * cf)`` static capacity, same
+rank-offset cumsum slotting, same drop rule (a token past capacity
+takes the residual path, never a wrong token).  Every serving core in
+``models/gpt_decode.py`` (decode step, flash prefill, verify, chunk,
+mixed wave) swaps its dense FFN for this function through the shared
+``_ffn_block`` seam, so offline ``generate_fast`` and the continuous-
+batching engine keep decoding token-identically through ONE compiled
+core — the MoE spec rides the jit-static ``cfg_tuple`` as a sixth,
+hashable element.
+
+Expert parallelism follows the ``tp_shard_params`` idiom:
+:func:`ep_shard_params` places the ``*_moe_expert_stack_w1/w2`` leaves
+with the expert dim over an ``ep`` mesh axis and GSPMD materializes
+the dispatch/combine all-to-all around the per-expert matmuls — the
+model code needs no annotations.  :func:`moe_ffn_ep_reference` is the
+EXPLICIT ``shard_map`` + ``lax.all_to_all`` formulation (reference
+moe_layer.py:74 placement), parity-tested against :func:`moe_ffn` and
+carrying the optional int8 wire (``HETU_MOE_QUANT`` — the PR 9 codec:
+quantize → all_to_all → dequantize, the EQuARX direction).
+
+Routing statistics (per-expert load/drop counts) are computed IN the
+compiled step and surfaced by the serving wrappers, so expert
+imbalance — THE MoE production failure mode — is a first-class
+observable in telemetry, ``hetu_top``, and the bench artifact.
+
+Speculative decoding: the truncated-layer draft SKIPS ROUTING ENTIRELY
+(``MoESpec.draft``) — its MoE layers contribute zero FFN (attention +
+residual only), so drafting needs no dispatch, no capacity, and no
+expert weights beyond what the target already holds; acceptance stays
+exact because the target's verify pass owns every emitted token.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import envvars
+from .gpt import GPTConfig
+
+
+class MoESpec(NamedTuple):
+    """Hashable MoE routing descriptor — the sixth, jit-static element
+    of the serving ``cfg_tuple``.  ``draft=True`` marks the truncated-
+    layer speculative draft, whose MoE layers skip the FFN sublayer
+    entirely (zero contribution; the residual stream carries)."""
+
+    num_experts: int
+    top_k: int
+    capacity_factor: float
+    moe_every: int
+    draft: bool = False
+    ep_axis: Optional[str] = None
+
+    def is_moe_layer(self, i):
+        """BertMoE alternation: block i carries the MoE FFN when
+        ``i % moe_every == moe_every - 1`` (1 = every block)."""
+        return i % self.moe_every == self.moe_every - 1
+
+    def moe_layers(self, L):
+        """How many of the first ``L`` blocks are MoE blocks."""
+        return sum(1 for i in range(L) if self.is_moe_layer(i))
+
+
+class MoEDecodeConfig(GPTConfig):
+    """GPTConfig + MoE routing for the serving stack.  ``ffn_size``
+    keeps GPTConfig's meaning for the DENSE interleaved blocks;
+    ``expert_size`` (default ``ffn_size``) is each expert's hidden
+    width — equal-active-params A/Bs shrink it so that
+    ``top_k * expert_size ≈ dense ffn_size``."""
+
+    def __init__(self, num_experts=4, top_k=2, capacity_factor=1.0,
+                 moe_every=1, expert_size=None, ep_axis=None, **kw):
+        super().__init__(**kw)
+        if num_experts < 2:
+            raise ValueError(
+                f"num_experts must be >= 2, got {num_experts}")
+        if not 1 <= top_k <= num_experts:
+            raise ValueError(
+                f"top_k={top_k} outside [1, num_experts={num_experts}]")
+        if not 1 <= moe_every <= self.num_hidden_layers:
+            raise ValueError(
+                f"moe_every={moe_every} outside [1, num_hidden_layers="
+                f"{self.num_hidden_layers}]")
+        if capacity_factor <= 0:
+            raise ValueError(
+                f"capacity_factor must be > 0, got {capacity_factor}")
+        self.num_experts = int(num_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.moe_every = int(moe_every)
+        self.expert_size = int(expert_size or self.ffn_size)
+        self.ep_axis = ep_axis
+
+
+def resolve_moe_capacity(cf=None):
+    """Serving capacity-factor override: an explicit value wins, else
+    ``$HETU_MOE_CAPACITY`` (> 0), else None (the config's own)."""
+    if cf is not None:
+        return float(cf)
+    raw = envvars.get_str("HETU_MOE_CAPACITY")
+    if raw:
+        v = float(raw)
+        if v > 0:
+            return v
+    return None
+
+
+def moe_spec_of(config, draft=False):
+    """The :class:`MoESpec` a config implies, or None for a dense one.
+    Duck-typed on ``num_experts`` so ``MoEDecodeConfig`` subclasses and
+    hand-rolled config objects both route."""
+    e = getattr(config, "num_experts", None)
+    if not e:
+        return None
+    cf = resolve_moe_capacity() or float(
+        getattr(config, "capacity_factor", 1.0))
+    return MoESpec(
+        num_experts=int(e),
+        top_k=int(getattr(config, "top_k", 1)),
+        capacity_factor=cf,
+        moe_every=int(getattr(config, "moe_every", 1)),
+        draft=bool(draft),
+        ep_axis=getattr(config, "ep_axis", None))
+
+
+def moe_capacity(spec, num_tokens):
+    """Static per-expert slot count for a wave of ``num_tokens``
+    (python int) — ``layers/moe.py topkgating``'s formula verbatim:
+    ``k * ceil(tokens/E * capacity_factor)``, floored at ``k`` so a
+    single-token wave always fits its own top-k."""
+    cap = spec.top_k * math.ceil(
+        (num_tokens / spec.num_experts) * spec.capacity_factor)
+    return max(int(cap), spec.top_k)
+
+
+def moe_ffn(params, us, x, spec, valid=None, stats=None):
+    """Top-k routed expert FFN over a flat token block (the serving
+    twin of ``layers/moe.py``'s gate → capacity dispatch → batched
+    expert matmul → weighted combine).
+
+    x: [T, D] (the post-LN FFN input); valid: [T] bool or None — False
+    rows (pad positions, dead slots, inert ride-alongs) are excluded
+    from routing so they never compete for expert capacity and never
+    perturb another request's output (batch-company independence, the
+    engine's core determinism contract).  Returns y [T, D]; a token
+    dropped by EVERY rank contributes exactly 0 — its residual stream
+    carries it unchanged, never a wrong token.
+
+    Combine weights are the RAW softmax gate probabilities (reference
+    topkgating: ``gates_s`` are un-renormalized) — so with
+    ``top_k == num_experts`` the weights sum to 1 and replicated
+    experts reproduce the dense FFN exactly (the oracle test).
+
+    ``stats`` (optional dict, mutated at trace time): accumulates
+    ``load``/``drop`` int32 [E] — per-expert tokens kept / tokens past
+    capacity THIS call.  load + drop sums to valid_tokens * top_k per
+    MoE layer, the invariant ``hetu_trace --check`` enforces.
+    """
+    E, k = spec.num_experts, spec.top_k
+    T, D = x.shape
+    cap = moe_capacity(spec, T)
+    x32 = x.astype(jnp.float32)
+    gw = params[f"{us}_moe_gate_weight"].astype(jnp.float32)
+    gates = jax.nn.softmax(x32 @ gw, axis=-1)              # [T, E] f32
+    topv, topi = jax.lax.top_k(gates, k)                   # [T, k]
+    vmask = (jnp.ones((T,), bool) if valid is None
+             else valid.reshape(T).astype(bool))
+    acc = jnp.zeros((E,), jnp.int32)     # slots claimed by prior ranks
+    dispatch = jnp.zeros((T, E, cap), jnp.float32)         # 0/1
+    combine = jnp.zeros((T, E, cap), jnp.float32)          # gate-weighted
+    load = jnp.zeros((E,), jnp.int32)
+    drop = jnp.zeros((E,), jnp.int32)
+    for r in range(k):
+        mask = jax.nn.one_hot(topi[:, r], E,
+                              dtype=jnp.int32) * vmask[:, None]
+        # exclusive cumsum down the token axis + the slots prior ranks
+        # already claimed: one shared [E, cap] pool, exactly
+        # topkgating's locations1/locations2 arithmetic
+        loc = jnp.cumsum(mask, axis=0) - mask + acc[None, :]
+        pos = jnp.sum(loc * mask, axis=1)                  # [T]
+        kept = mask * (pos < cap)[:, None]                 # [T, E]
+        oh = jax.nn.one_hot(pos, cap, dtype=jnp.float32)   # [T, cap]
+        d = kept.astype(jnp.float32)[:, :, None] * oh[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + d * topv[:, r][:, None, None]
+        acc = acc + jnp.sum(mask, axis=0)
+        load = load + jnp.sum(kept, axis=0)
+        drop = drop + jnp.sum(mask - kept, axis=0)
+    cdt = x.dtype
+    w1 = params[f"{us}_moe_expert_stack_w1"]               # [E, D, F]
+    w2 = params[f"{us}_moe_expert_stack_w2"]               # [E, F, D]
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), x)
+    h = jnp.einsum("ecd,edf->ecf", xe, w1)
+    b1 = params.get(f"{us}_moe_expert_stack_b1")
+    if b1 is not None:
+        h = h + b1[:, None, :]
+    h = _gelu_tanh(h)
+    h = jnp.einsum("ecf,efd->ecd", h, w2)
+    b2 = params.get(f"{us}_moe_expert_stack_b2")
+    if b2 is not None:
+        # the bias must not leak into EMPTY capacity slots' combine
+        # terms — it doesn't (their combine weight is exactly 0) — but
+        # a DROPPED token's residual path must also see zero, which the
+        # all-zero combine row guarantees
+        h = h + b2[:, None, :]
+    y = jnp.einsum("tec,ecd->td", combine.astype(cdt), h)
+    if stats is not None:
+        stats["load"] = stats.get("load", 0) + load
+        stats["drop"] = stats.get("drop", 0) + drop
+    return y.astype(x.dtype)
+
+
+def _gelu_tanh(x):
+    # local twin of gpt_decode._gelu_tanh (kept here so models.gpt_decode
+    # -> models.moe_decode stays a one-way import)
+    return 0.5 * x * (1.0 + jnp.tanh(
+        0.7978845608028654 * (x + 0.044715 * x ** 3)))
+
+
+# ------------------- expert-parallel placement ------------------- #
+
+
+def ep_shard_params(params, mesh, config, axis="ep", name=None):
+    """Place a MoE-GPT parameter dict for EXPERT-PARALLEL decoding: the
+    ``*_moe_expert_stack_*`` leaves shard their leading expert dim over
+    ``axis``; everything else (gate, attention, embeddings, dense FFN
+    blocks) replicates.  Like ``tp_shard_params``, the decode cores
+    need no other change — GSPMD propagates the expert sharding
+    through the dispatch/combine einsums and materializes the token
+    all-to-all at the resharding boundary.
+
+    Validated up front by ``analysis.shard_check.check_expert_mesh``
+    (axis exists, num_experts divisible) so a bad mesh is rejected
+    before any buffer moves or compiles."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..analysis.shard_check import check_expert_mesh
+    check_expert_mesh(mesh, int(config.num_experts), axis=axis)
+    from .gpt_decode import _infer_name
+    name = _infer_name(params, name)
+
+    def spec_for(k):
+        if "_moe_expert_stack_w" in k:
+            return P(axis, None, None)
+        if "_moe_expert_stack_b" in k:
+            return P(axis, None)
+        return P()
+
+    return {k: jax.device_put(np.asarray(v),
+                              NamedSharding(mesh, spec_for(k)))
+            for k, v in params.items() if k.startswith(name + "_")}
+
+
+def resolve_moe_quant(mode=None):
+    """int8 dispatch/combine all-to-all wire: explicit ``mode`` wins,
+    else ``$HETU_MOE_QUANT`` (the shared quant-knob grammar)."""
+    from ..quant import resolve_quant
+    return resolve_quant(mode, "HETU_MOE_QUANT")
+
+
+def _a2a_wire(x, axis, split_axis, concat_axis, quant):
+    """One all-to-all hop, optionally int8 on the wire (the PR 9
+    codec: per-row symmetric quantize → exchange payload AND scales →
+    dequantize).  Exactness note: quantization error is bounded by
+    amax/254 per element (quant.py); the parity test pins the
+    tolerance."""
+    if not quant:
+        return jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                                  concat_axis=concat_axis)
+    from ..quant import dequantize_jax, quantize_jax
+    d = x.shape[-1]
+    q, scales = quantize_jax(x.astype(jnp.float32), chunk=d)
+    q = jax.lax.all_to_all(q, axis, split_axis=split_axis,
+                           concat_axis=concat_axis)
+    scales = jax.lax.all_to_all(scales, axis, split_axis=split_axis,
+                                concat_axis=concat_axis)
+    return dequantize_jax(q, scales, chunk=d).astype(x.dtype)
+
+
+def moe_ffn_ep_reference(params, us, x, spec, mesh, quant=None):
+    """The EXPLICIT expert-parallel formulation: tokens sharded over
+    the ``ep`` axis, per-shard gate + capacity dispatch, ``lax.
+    all_to_all`` to expert-major, local expert matmuls over the expert
+    shard, all-to-all back, per-shard combine — reference
+    moe_layer.py's ``_stacked_forward`` collective placement, written
+    in ``shard_map``.  ``quant``/"$HETU_MOE_QUANT" rides the exchange
+    in int8 (payload + per-row scales).
+
+    This is the parity/wire REFERENCE, not the serving hot path (the
+    jitted cores use GSPMD propagation from :func:`ep_shard_params`):
+    capacity is per-shard (each shard's ``T/n`` tokens), so it equals
+    :func:`moe_ffn` exactly only while capacity is un-binding — which
+    is precisely the regime the parity tests pin.
+
+    x: [T, D] with T divisible by the axis size.  Returns y [T, D].
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map  # installed by hetu_tpu._compat
+    axis = spec.ep_axis or "ep"
+    n = int(mesh.shape[axis])
+    E = spec.num_experts
+    if E % n:
+        raise ValueError(
+            f"num_experts={E} not divisible by {axis}={n}")
+    T = x.shape[0]
+    if T % n:
+        raise ValueError(
+            f"token count {T} not divisible by {axis}={n}")
+    quant = resolve_moe_quant(quant)
+    gw = params[f"{us}_moe_gate_weight"]
+    w1 = params[f"{us}_moe_expert_stack_w1"]
+    w2 = params[f"{us}_moe_expert_stack_w2"]
+    b1 = params.get(f"{us}_moe_expert_stack_b1")
+    b2 = params.get(f"{us}_moe_expert_stack_b2")
+    if b1 is None:
+        b1 = jnp.zeros((E, w1.shape[-1]), x.dtype)
+    if b2 is None:
+        b2 = jnp.zeros((E, w2.shape[-1]), x.dtype)
+    k = spec.top_k
+    cap = moe_capacity(spec, T // n)
+
+    def local(xs, gw, w1, b1, w2, b2):
+        # xs [T/n, D]; w1/w2/b1/b2 hold THIS shard's E/n experts
+        t = xs.shape[0]
+        x32 = xs.astype(jnp.float32)
+        gates = jax.nn.softmax(x32 @ gw.astype(jnp.float32), axis=-1)
+        topv, topi = jax.lax.top_k(gates, k)
+        acc = jnp.zeros((E,), jnp.int32)
+        dispatch = jnp.zeros((t, E, cap), jnp.float32)
+        combine = jnp.zeros((t, E, cap), jnp.float32)
+        for r in range(k):
+            mask = jax.nn.one_hot(topi[:, r], E, dtype=jnp.int32)
+            loc = jnp.cumsum(mask, axis=0) - mask + acc[None, :]
+            pos = jnp.sum(loc * mask, axis=1)
+            kept = mask * (pos < cap)[:, None]
+            oh = jax.nn.one_hot(pos, cap, dtype=jnp.float32)
+            d = kept.astype(jnp.float32)[:, :, None] * oh[:, None, :]
+            dispatch = dispatch + d
+            combine = combine + d * topv[:, r][:, None, None]
+            acc = acc + jnp.sum(mask, axis=0)
+        xe = jnp.einsum("tec,td->ecd", dispatch, x32)      # [E, cap, D]
+        # DISPATCH all-to-all: expert-major — each device keeps its
+        # E/n experts' slots from every peer: [E/n, n*cap, D]
+        xe = _a2a_wire(xe, axis, 0, 1, quant)
+        h = jnp.einsum("ecd,edf->ecf", xe,
+                       w1.astype(jnp.float32)) + b1.astype(
+                           jnp.float32)[:, None, :]
+        h = _gelu_tanh(h)
+        h = jnp.einsum("ecf,efd->ecd", h,
+                       w2.astype(jnp.float32)) + b2.astype(
+                           jnp.float32)[:, None, :]
+        # COMBINE all-to-all: the exact inverse hop, back to
+        # token-major [E, cap, D]
+        h = _a2a_wire(h, axis, 1, 0, quant)
+        y = jnp.einsum("tec,ecd->td", combine, h)
+        return y.astype(xs.dtype)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(axis))
+    return fn(x, gw, w1, b1, w2, b2)
+
+
+# ------------------------- param builders ------------------------- #
+
+
+def init_moe_params(config, name="moe", seed=0, scale=0.02):
+    """Random MoE-GPT serving params (numpy): the dense-GPT naming
+    contract (``{name}_wte_table`` .. per-layer attention/LN/dense FFN)
+    plus, on each MoE block, the gate ``{us}_moe_gate_weight`` [D, E]
+    and the StackedExperts-named stacks ``{us}_moe_expert_stack_w1``
+    [E, D, F] / ``_w2`` [E, F, D] / ``_b1`` [E, F] / ``_b2`` [E, D].
+    Dense interleaved blocks keep ``ffn_wi/wo`` only."""
+    c = config
+    spec = moe_spec_of(c)
+    rng = np.random.default_rng(seed)
+    D = c.hidden_size
+    F_dense, F_exp = c.ffn_size, c.expert_size
+    E = c.num_experts
+
+    def r(*shape):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    p = {
+        f"{name}_wte_table": r(c.vocab_size, D),
+        f"{name}_wpe": r(c.max_position_embeddings, D),
+        f"{name}_ln_f_scale": np.ones(D, np.float32),
+        f"{name}_ln_f_bias": np.zeros(D, np.float32),
+    }
+    for i in range(c.num_hidden_layers):
+        us = f"{name}_h{i}"
+        p.update({
+            f"{us}_ln1_scale": np.ones(D, np.float32),
+            f"{us}_ln1_bias": np.zeros(D, np.float32),
+            f"{us}_ln2_scale": np.ones(D, np.float32),
+            f"{us}_ln2_bias": np.zeros(D, np.float32),
+            f"{us}_attn_q_weight": r(D, D),
+            f"{us}_attn_q_bias": np.zeros(D, np.float32),
+            f"{us}_attn_k_weight": r(D, D),
+            f"{us}_attn_k_bias": np.zeros(D, np.float32),
+            f"{us}_attn_v_weight": r(D, D),
+            f"{us}_attn_v_bias": np.zeros(D, np.float32),
+            f"{us}_attn_proj_weight": r(D, D),
+            f"{us}_attn_proj_bias": np.zeros(D, np.float32),
+        })
+        if spec.is_moe_layer(i):
+            p.update({
+                f"{us}_moe_gate_weight": r(D, E),
+                f"{us}_moe_expert_stack_w1": r(E, D, F_exp),
+                f"{us}_moe_expert_stack_b1": np.zeros((E, F_exp),
+                                                      np.float32),
+                f"{us}_moe_expert_stack_w2": r(E, F_exp, D),
+                f"{us}_moe_expert_stack_b2": np.zeros((E, D),
+                                                      np.float32),
+            })
+        else:
+            p.update({
+                f"{us}_ffn_wi_weight": r(D, F_dense),
+                f"{us}_ffn_wi_bias": np.zeros(F_dense, np.float32),
+                f"{us}_ffn_wo_weight": r(F_dense, D),
+                f"{us}_ffn_wo_bias": np.zeros(D, np.float32),
+            })
+    return p
+
+
+def convert_dense_to_moe(params, config, moe_config, name=None):
+    """Replicate a DENSE GPT's FFN blocks into expert stacks: every
+    expert of every MoE block carries the dense layer's exact wi/wo
+    (and biases).  With ``top_k == num_experts`` the raw softmax
+    combine weights sum to 1, so routing reproduces the dense FFN —
+    the oracle the acceptance criteria pin (and a regression anchor
+    for the gate math: any renormalization bug breaks it).  Gate
+    weights are zero → uniform gates, maximally-even routing."""
+    from .gpt_decode import _infer_name
+    name = _infer_name(params, name)
+    spec = moe_spec_of(moe_config)
+    E = spec.num_experts
+    out = {k: np.asarray(v) for k, v in params.items()
+           if k.startswith(name + "_")}
+    for i in range(moe_config.num_hidden_layers):
+        if not spec.is_moe_layer(i):
+            continue
+        us = f"{name}_h{i}"
+        wi = out.pop(f"{us}_ffn_wi_weight")
+        bi = out.pop(f"{us}_ffn_wi_bias")
+        wo = out.pop(f"{us}_ffn_wo_weight")
+        bo = out.pop(f"{us}_ffn_wo_bias")
+        D = wi.shape[0]
+        out[f"{us}_moe_gate_weight"] = np.zeros((D, E), np.float32)
+        out[f"{us}_moe_expert_stack_w1"] = np.broadcast_to(
+            wi, (E,) + wi.shape).copy()
+        out[f"{us}_moe_expert_stack_b1"] = np.broadcast_to(
+            bi, (E,) + bi.shape).copy()
+        out[f"{us}_moe_expert_stack_w2"] = np.broadcast_to(
+            wo, (E,) + wo.shape).copy()
+        out[f"{us}_moe_expert_stack_b2"] = np.broadcast_to(
+            bo, (E,) + bo.shape).copy()
+    return out

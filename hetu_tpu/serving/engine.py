@@ -187,6 +187,24 @@ class ServingEngine:
                 donate, "ragged" if self.fast_path else "masked")
         self.cfg_tuple = (self._name, c.num_hidden_layers,
                           c.num_attention_heads, Dh, self.kv.s_max)
+        # ---- MoE serving (models/moe_decode.py): a MoEDecodeConfig
+        # rides the SAME compiled cores — the hashable MoESpec joins
+        # the static cfg_tuple and every serve wrapper appends one
+        # trailing (load, drop, tokens) stats element the scheduler
+        # strips + accounts below (_moe_take).  Dense configs leave
+        # self.moe None and nothing here changes. ---- #
+        from ..models.moe_decode import moe_spec_of
+        self.moe = moe_spec_of(c)
+        if self.moe is not None:
+            self.cfg_tuple = self.cfg_tuple + (self.moe,)
+            E = self.moe.num_experts
+            # lifetime per-expert routing outcome (int64 — these count
+            # token-assignments, top_k per token per MoE layer)
+            self.expert_load = np.zeros(E, np.int64)
+            self.expert_drops = np.zeros(E, np.int64)
+            self.moe_tokens = 0
+            self._moe_layers = self.moe.moe_layers(c.num_hidden_layers)
+            self._moe_step = None   # per-step [load, drop, tokens]
         self.prefill_dispatches = 0   # jitted prefill calls (the
         # batched-admission win: a burst of k same-bucket arrivals on
         # the fast path costs ONE dispatch, not k)
@@ -244,6 +262,12 @@ class ServingEngine:
             self.cfg_tuple_draft = (self._name, dl,
                                     c.num_attention_heads, Dh,
                                     self.kv.s_max)
+            if self.moe is not None:
+                # the draft SKIPS ROUTING entirely (ISSUE 20): its
+                # truncated blocks run attention-only on MoE layers
+                # and its wrappers append no stats element
+                self.cfg_tuple_draft = self.cfg_tuple_draft + (
+                    self.moe._replace(draft=True),)
             adapt = (spec_adapt if spec_adapt is not None
                      else envvars.get_bool("HETU_SPEC_ADAPT"))
             self.spec_adapt = bool(adapt) and self.spec_k > 1
@@ -336,6 +360,77 @@ class ServingEngine:
         if version is not None:
             self.set_weight_version(version)
         self.metrics.event("weight_swap", version=self.weight_version)
+
+    # ------------------------------------------------------------- #
+    # MoE accounting (models/moe_decode.py)
+    # ------------------------------------------------------------- #
+
+    def _moe_take(self, out):
+        """Strip + account the trailing ``(load, drop, tokens)`` stats
+        element the serve wrappers append under a MoE ``cfg_tuple``.
+        Identity on dense engines, so every TARGET-cfg dispatch site
+        wraps its call unconditionally; draft dispatches stay unwrapped
+        (the draft spec appends nothing — it skips routing)."""
+        if self.moe is None:
+            return out
+        load, drop, tokens = out[-1]
+        load = np.asarray(load, np.int64)
+        drop = np.asarray(drop, np.int64)
+        tokens = int(tokens)
+        self.expert_load += load
+        self.expert_drops += drop
+        self.moe_tokens += tokens
+        if self._moe_step is None:
+            self._moe_step = [load.copy(), drop.copy(), tokens]
+        else:
+            self._moe_step[0] += load
+            self._moe_step[1] += drop
+            self._moe_step[2] += tokens
+        telemetry.inc("serve.expert_load", int(load.sum()))
+        telemetry.inc("serve.expert_drops", int(drop.sum()))
+        return out[:-1]
+
+    def _moe_record(self):
+        """Drain the per-step accumulator into a ``record_step``
+        payload (None on dense engines or MoE steps that routed
+        nothing).  ``routed + dropped == tokens * k * layers`` is the
+        hetu_trace attribution invariant; ``imb`` (max/mean expert
+        load) and ``drop_rate`` are THE MoE health observables and land
+        as gauges for hetu_top."""
+        if self.moe is None or self._moe_step is None:
+            return None
+        load, drop, tokens = self._moe_step
+        self._moe_step = None
+        routed = int(load.sum())
+        dropped = int(drop.sum())
+        mean = float(load.mean())
+        imb = float(load.max()) / mean if mean > 0 else 0.0
+        total = routed + dropped
+        rate = dropped / total if total else 0.0
+        telemetry.set_gauge("serve.expert_imbalance", imb)
+        telemetry.set_gauge("serve.expert_drop_rate", rate)
+        return {"tokens": tokens, "routed": routed, "dropped": dropped,
+                "k": self.moe.top_k, "layers": self._moe_layers,
+                "imb": imb, "drop_rate": rate,
+                "load": [int(x) for x in load],
+                "drop": [int(x) for x in drop]}
+
+    @property
+    def expert_imbalance(self):
+        """Lifetime max/mean expert-load ratio (None on dense engines
+        or before any routed token)."""
+        if self.moe is None:
+            return None
+        mean = float(self.expert_load.mean())
+        return float(self.expert_load.max()) / mean if mean > 0 else 0.0
+
+    @property
+    def expert_drop_rate(self):
+        """Lifetime dropped / (routed + dropped) (None when dense)."""
+        if self.moe is None:
+            return None
+        total = int(self.expert_load.sum() + self.expert_drops.sum())
+        return int(self.expert_drops.sum()) / total if total else 0.0
 
     # ------------------------------------------------------------- #
 
@@ -479,11 +574,17 @@ class ServingEngine:
             done.extend(self._spec_wave(live, prefill_s))
         elif live:
             wave_reqs = [self._reqs[s].request_id for s in live]
+            # MoE: free (dead) slots ride the fused step but must not
+            # compete for expert capacity — the live mask gates them
+            # out of routing (dense engines ignore it)
+            mask = np.zeros(self.kv.n_slots, bool)
+            mask[live] = True
             t0 = time.perf_counter()
-            sampled, ck, cv, keys = self._decode(
+            sampled, ck, cv, keys = self._moe_take(self._decode(
                 self.params, self.cfg_tuple,
                 self.kv.cache_k, self.kv.cache_v,
-                self._pos, self._tok, self._temp, self._topk, self._keys)
+                self._pos, self._tok, self._temp, self._topk, self._keys,
+                live=mask))
             self.kv.cache_k, self.kv.cache_v = ck, cv
             sampled = np.asarray(sampled)
             # np.array copies: np.asarray on a jax array is a read-only
@@ -508,7 +609,7 @@ class ServingEngine:
                 queue_depth=len(self._queue), dt_s=dt,
                 new_tokens=len(live), prefill_s=prefill_s,
                 step=self.steps, requests=wave_reqs,
-                end_perf=t0 + dt)
+                end_perf=t0 + dt, moe=self._moe_record())
         return done
 
     # ------------------------------------------------------------- #
@@ -522,11 +623,11 @@ class ServingEngine:
             prompt = np.zeros(pb, np.int32)
             prompt[:P] = req.prompt
             key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
-            first, ck, cv, key = self._prefill(
+            first, ck, cv, key = self._moe_take(self._prefill(
                 self.params, self.cfg_tuple,
                 self.kv.cache_k, self.kv.cache_v,
                 np.int32(slot), prompt, np.int32(P),
-                np.float32(req.temperature), np.int32(req.top_k), key)
+                np.float32(req.temperature), np.int32(req.top_k), key))
             self.kv.cache_k, self.kv.cache_v = ck, cv
             self.prefill_dispatches += 1
             firsts.append(int(first))
@@ -559,10 +660,11 @@ class ServingEngine:
             topks[row] = req.top_k
             keys[row] = np.asarray(jax.random.PRNGKey(req.seed),
                                    np.uint32)
-        first, ck, cv, new_keys = self._prefill_batch(
+        first, ck, cv, new_keys = self._moe_take(self._prefill_batch(
             self.params, self.cfg_tuple,
             self.kv.cache_k, self.kv.cache_v,
-            slots, prompts, lens, temps, topks, keys)
+            slots, prompts, lens, temps, topks, keys,
+            row_valid=(np.arange(nb) < n)))
         self.kv.cache_k, self.kv.cache_v = ck, cv
         self.prefill_dispatches += 1
         first = np.asarray(first)
@@ -608,11 +710,11 @@ class ServingEngine:
             mask = np.zeros(B, bool)
             mask[decoding] = True
             t0 = time.perf_counter()
-            sampled, ck, cv, keys = self._decode(
+            sampled, ck, cv, keys = self._moe_take(self._decode(
                 self.params, self.cfg_tuple,
                 self.kv.cache_k, self.kv.cache_v,
                 self.kv.tables.copy(), self._pos, mask, self._tok,
-                self._temp, self._topk, self._keys)
+                self._temp, self._topk, self._keys))
             self.kv.cache_k, self.kv.cache_v = ck, cv
             sampled = np.asarray(sampled)
             new_keys = np.array(keys, np.uint32)
@@ -640,7 +742,7 @@ class ServingEngine:
                 queue_depth=len(self._queue), dt_s=dt,
                 new_tokens=len(decoding), prefill_s=prefill_s,
                 step=self.steps, requests=wave_reqs,
-                end_perf=t0 + dt)
+                end_perf=t0 + dt, moe=self._moe_record())
         return done
 
     def _admit_paged(self):
@@ -846,12 +948,12 @@ class ServingEngine:
             wblk[j] = self.kv.tables[slot, p // bs]
             woff[j] = p % bs
         t0 = time.perf_counter()
-        first, ck, cv, nk = self._prefill_chunk(
+        first, ck, cv, nk = self._moe_take(self._prefill_chunk(
             self.params, self.cfg_tuple,
             self.kv.cache_k, self.kv.cache_v,
             self.kv.tables[slot].copy(), tokens, np.int32(off),
             np.int32(take), np.float32(req.temperature),
-            np.int32(req.top_k), self._keys[slot].copy(), wblk, woff)
+            np.int32(req.top_k), self._keys[slot].copy(), wblk, woff))
         self.kv.cache_k, self.kv.cache_v = ck, cv
         self.prefill_dispatches += 1
         self.prefill_chunks += 1
@@ -894,10 +996,11 @@ class ServingEngine:
             for j in range(P):
                 wblk[row, j] = self.kv.tables[slot, j // bs]
                 woff[row, j] = j % bs
-        first, ck, cv, new_keys = self._prefill_batch(
+        first, ck, cv, new_keys = self._moe_take(self._prefill_batch(
             self.params, self.cfg_tuple,
             self.kv.cache_k, self.kv.cache_v,
-            prompts, lens, temps, topks, keys, wblk, woff)
+            prompts, lens, temps, topks, keys, wblk, woff,
+            row_valid=(np.arange(nb) < n)))
         self.kv.cache_k, self.kv.cache_v = ck, cv
         self.prefill_dispatches += 1
         first = np.asarray(first)
@@ -1010,20 +1113,20 @@ class ServingEngine:
             entries[s] = (toks, int(self._pos[s]), 0, False)
         wave = assemble_mixed_wave(B, entries)
         if self.paged:
-            sampled, ck, cv, after = self._mixed(
+            sampled, ck, cv, after = self._moe_take(self._mixed(
                 self.params, self.cfg_tuple,
                 self.kv.cache_k, self.kv.cache_v,
                 self.kv.tables.copy(), wave["pos"], wave["tokens"],
                 wave["q_len"], wave["first_row"], wave["self_fresh"],
                 self._temp, self._topk, self._keys,
-                has_fresh=bool(pre))
+                has_fresh=bool(pre)))
         else:
-            sampled, ck, cv, after = self._mixed(
+            sampled, ck, cv, after = self._moe_take(self._mixed(
                 self.params, self.cfg_tuple,
                 self.kv.cache_k, self.kv.cache_v,
                 wave["pos"], wave["tokens"], wave["q_len"],
                 wave["first_row"], wave["self_fresh"],
-                self._temp, self._topk, self._keys)
+                self._temp, self._topk, self._keys))
         self.kv.cache_k, self.kv.cache_v = ck, cv
         sampled = np.asarray(sampled)
         after = np.array(after, np.uint32)
@@ -1145,7 +1248,7 @@ class ServingEngine:
             prefill_s=dt * q_pre / q_tot, step=self.steps,
             requests=wave_reqs, end_perf=t0 + dt, spec=spec,
             mix={"q_prefill": q_pre, "q_verify": q_ver,
-                 "q_decode": n_dec})
+                 "q_decode": n_dec}, moe=self._moe_record())
         return done
 
     # ------------------------------------------------------------- #
@@ -1226,17 +1329,17 @@ class ServingEngine:
             qlen[s] = min(k_cur + 1, rem,
                           self.kv.s_max - int(self._pos[s]))
         if self.paged:
-            sampled, ck, cv, after = self._verify(
+            sampled, ck, cv, after = self._moe_take(self._verify(
                 self.params, self.cfg_tuple,
                 self.kv.cache_k, self.kv.cache_v,
                 self.kv.tables.copy(), self._pos, tokens, qlen,
-                self._temp, self._topk, self._keys)
+                self._temp, self._topk, self._keys))
         else:
-            sampled, ck, cv, after = self._verify(
+            sampled, ck, cv, after = self._moe_take(self._verify(
                 self.params, self.cfg_tuple,
                 self.kv.cache_k, self.kv.cache_v,
                 self._pos, tokens, qlen, self._temp, self._topk,
-                self._keys)
+                self._keys))
         self.kv.cache_k, self.kv.cache_v = ck, cv
         sampled = np.asarray(sampled)
         after = np.array(after, np.uint32)
@@ -1290,7 +1393,7 @@ class ServingEngine:
             new_tokens=wave_emit, prefill_s=prefill_s,
             step=self.steps, requests=wave_reqs, end_perf=t0 + dt,
             spec={"k": k_cur, "proposed": wave_prop,
-                  "accepted": wave_acc})
+                  "accepted": wave_acc}, moe=self._moe_record())
         return done
 
     @property

@@ -272,7 +272,7 @@ class ServingMetrics(MetricsCore):
 
     def record_step(self, live, slots, queue_depth, dt_s, new_tokens,
                     prefill_s=0.0, step=None, requests=None,
-                    end_perf=None, spec=None, mix=None):
+                    end_perf=None, spec=None, mix=None, moe=None):
         """One fused decode step; ``prefill_s`` is the prefill wall time
         this scheduler iteration paid before decoding, so the per-step
         JSONL event attributes the phases separately (the masked vs
@@ -296,7 +296,14 @@ class ServingMetrics(MetricsCore):
         engines only) stamps the wave's per-mode q-token split onto the
         event — how many of the ragged dispatch's query rows were
         prompt prefill, spec-verify, and plain decode (hetu_top's
-        mixed-wave columns and the tail report read these)."""
+        mixed-wave columns and the tail report read these).
+
+        ``moe`` (a {tokens, routed, dropped, k, layers, imb,
+        drop_rate} dict, MoE engines only) stamps the step's expert
+        routing outcome — ``routed + dropped == tokens * k * layers``
+        is the invariant hetu_trace --check enforces, ``imb`` and
+        ``drop_rate`` feed hetu_top's expert columns.  Dense steps
+        carry no moe_* fields and the checker exempts them."""
         self._mark()
         self._slots = slots
         self.step_live.append(live)
@@ -321,6 +328,15 @@ class ServingMetrics(MetricsCore):
             fields["q_prefill"] = int(mix.get("q_prefill", 0))
             fields["q_verify"] = int(mix.get("q_verify", 0))
             fields["q_decode"] = int(mix.get("q_decode", 0))
+        if moe is not None:
+            fields["moe_tokens"] = int(moe.get("tokens", 0))
+            fields["moe_routed"] = int(moe.get("routed", 0))
+            fields["moe_dropped"] = int(moe.get("dropped", 0))
+            fields["moe_k"] = int(moe.get("k", 0))
+            fields["moe_layers"] = int(moe.get("layers", 0))
+            fields["moe_imb"] = round(float(moe.get("imb", 0.0)), 4)
+            fields["moe_drop_rate"] = round(
+                float(moe.get("drop_rate", 0.0)), 6)
         self.event("serve_step", live=live, queue_depth=queue_depth,
                    slots=slots, new_tokens=int(new_tokens),
                    prefill_ms=round(prefill_s * 1e3, 3),

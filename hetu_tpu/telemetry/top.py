@@ -45,6 +45,13 @@ records); ``--fleet`` grows a per-replica ``ver`` column (the
 rollout-progress footer (``rollout   rolling 1/2 → v7``) assembled
 from the coordinator's ``rollout_*`` records.
 
+MoE serving (ISSUE 20): MoE engines stamp ``moe_*`` fields on every
+``serve_step`` — the single-engine view grows an ``experts`` panel
+(routed/dropped assignments, max/mean load imbalance, drop rate from
+the ``serve.expert_load``/``serve.expert_drops`` counters' step-level
+twins) and ``--fleet`` grows per-replica ``imb``/``drop%`` columns;
+dense replicas render "-".
+
 Elastic fleet (ISSUE 16): ``--fleet`` grows a per-replica ``life``
 column (warming/serving/draining/retired, from the router's
 ``replica_warming``/``replica_ready``/``replica_draining``/
@@ -162,6 +169,27 @@ def summarize(events, window=512):
             for f in mix_tot:
                 mix_tot[f] += s.get(f, 0) or 0
     mix = {**mix_tot, "steps": mix_steps} if mix_steps else None
+    # MoE serving (ISSUE 20): serve_step events from MoE engines carry
+    # the wave's routing outcome — expert-load imbalance (max/mean) is
+    # THE MoE production failure mode, so it gets a panel
+    moe_routed = moe_dropped = 0
+    moe_imb = None
+    moe_steps = 0
+    for s in steps:
+        if isinstance(s.get("moe_routed"), int):
+            moe_steps += 1
+            moe_routed += s["moe_routed"]
+            moe_dropped += s.get("moe_dropped", 0) or 0
+            if isinstance(s.get("moe_imb"), (int, float)):
+                moe_imb = s["moe_imb"]
+    if moe_imb is None:
+        moe_imb = gauges.get("serve.expert_imbalance")
+    moe = None
+    if moe_steps:
+        tot = moe_routed + moe_dropped
+        moe = {"routed": moe_routed, "dropped": moe_dropped,
+               "imbalance": moe_imb,
+               "drop_rate": round(moe_dropped / tot, 4) if tot else 0.0}
     spec = {
         "drafted": drafted,
         "accepted": accepted,
@@ -194,6 +222,7 @@ def summarize(events, window=512):
         "requests": counts,
         "spec": spec,
         "mix": mix,
+        "moe": moe,
         "slo": slo,
         "flight_dumps": flight_dumps,
         "weight_version": weight_version,
@@ -219,6 +248,7 @@ def summarize_fleet(events, window=4096):
             "finished": 0, "drafted": 0, "accepted": 0,
             "dir_lookups": 0, "dir_hits": 0,
             "q_prefill": 0, "q_verify": 0, "q_decode": 0,
+            "moe_routed": 0, "moe_dropped": 0, "moe_imb": None,
         })
 
     shed = {"latency": 0, "throughput": 0}
@@ -261,6 +291,14 @@ def summarize_fleet(events, window=4096):
                 r["q_prefill"] += e["q_prefill"]
                 r["q_verify"] += e.get("q_verify", 0) or 0
                 r["q_decode"] += e.get("q_decode", 0) or 0
+            if isinstance(e.get("moe_routed"), int):
+                # MoE serving: per-replica expert routing outcome —
+                # the newest imbalance stamp is the replica's current
+                # max/mean expert-load ratio
+                r["moe_routed"] += e["moe_routed"]
+                r["moe_dropped"] += e.get("moe_dropped", 0) or 0
+                if isinstance(e.get("moe_imb"), (int, float)):
+                    r["moe_imb"] = e["moe_imb"]
         elif kind == "slo_health" and rep is not None:
             row(rep)["health"] = e.get("state")
         elif kind == "serve_finish" and rep is not None:
@@ -371,6 +409,9 @@ def summarize_fleet(events, window=4096):
                            if r["drafted"] else None)
         r["dir_hit_rate"] = (round(r["dir_hits"] / r["dir_lookups"], 4)
                              if r["dir_lookups"] else None)
+        moe_tot = r["moe_routed"] + r["moe_dropped"]
+        r["moe_drop_rate"] = (round(r["moe_dropped"] / moe_tot, 4)
+                              if moe_tot else None)
     return {
         "records": len(events),
         "replicas": [per[k] for k in sorted(per)],
@@ -399,7 +440,8 @@ def render_fleet(stats, clock=None):
         f"{'live':>4} {'queue':>5} {'breaker':<9} {'routed':>6} "
         f"{'requeued':>8} {'rejects':>7} {'deaths':>6} "
         f"{'drafted':>7} {'acc':>5} {'dir%':>5} "
-        f"{'qpre':>6} {'qver':>6} {'qdec':>6}",
+        f"{'qpre':>6} {'qver':>6} {'qdec':>6} "
+        f"{'imb':>5} {'drop%':>6}",
     ]
     for r in stats["replicas"]:
         ver = r.get("version")
@@ -422,7 +464,11 @@ def render_fleet(stats, clock=None):
             f"{_fmt(r.get('dir_hit_rate'), nd=2):>5} "
             f"{_fmt(r['q_prefill'] if mixed else None):>6} "
             f"{_fmt(r['q_verify'] if mixed else None):>6} "
-            f"{_fmt(r['q_decode'] if mixed else None):>6}")
+            f"{_fmt(r['q_decode'] if mixed else None):>6} "
+            # MoE columns stay "-" for dense replicas (their
+            # serve_step events carry no moe_* fields)
+            f"{_fmt(r.get('moe_imb'), nd=2):>5} "
+            f"{_fmt(r.get('moe_drop_rate'), nd=4):>6}")
     shed = stats["shed"]
     pre = stats.get("prefix") or {}
     lines.append("-" * 72)
@@ -529,6 +575,15 @@ def render(stats, clock=None):
             f"  q_verify {mx['q_verify']}"
             f"  q_decode {mx['q_decode']}"
             f"  waves {mx['steps']}"))
+    me = s.get("moe")
+    if me:
+        # MoE serving: routed/dropped expert assignments, load
+        # imbalance (max/mean — 1.0 = perfectly balanced), drop rate
+        lines.insert(-1, (
+            f"experts   routed {me['routed']}"
+            f"  dropped {me['dropped']}"
+            f"  imbalance {_fmt(me['imbalance'], nd=2)}"
+            f"  drop_rate {_fmt(me['drop_rate'], nd=4)}"))
     return "\n".join(lines)
 
 

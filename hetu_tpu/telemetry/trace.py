@@ -559,6 +559,43 @@ def check_spec_attribution(events):
     return problems
 
 
+def check_moe_attribution(events):
+    """The MoE routing-attribution rule (ISSUE 20): per ``serve_step``
+    record, routed + dropped expert assignments must equal the wave's
+    token count × top_k × MoE layer count — capacity overflow re-routes
+    a token to the residual path (``moe_dropped``), it NEVER vanishes
+    from the ledger, so the two sides always balance.  Records without
+    ``moe_routed`` (dense engines) are exempt; a MoE record missing any
+    of its companion fields is itself a violation.  Returns problem
+    strings."""
+    problems = []
+    for e in events:
+        if e.get("event") != "serve_step":
+            continue
+        routed = e.get("moe_routed")
+        if routed is None:
+            continue
+        fields = {k: e.get(f"moe_{k}")
+                  for k in ("tokens", "dropped", "k", "layers")}
+        if not all(isinstance(v, int) for v in fields.values()) \
+                or not isinstance(routed, int):
+            problems.append(
+                f"moe-attribution: step {e.get('step')!r} carries "
+                f"moe_routed without complete integer companions "
+                f"{sorted(k for k, v in fields.items() if not isinstance(v, int))}")
+            continue
+        want = fields["tokens"] * fields["k"] * fields["layers"]
+        if routed + fields["dropped"] != want:
+            problems.append(
+                f"moe-attribution: step {e.get('step')!r} routed "
+                f"{routed} + dropped {fields['dropped']} = "
+                f"{routed + fields['dropped']} expert assignments but "
+                f"{fields['tokens']} tokens x top_k {fields['k']} x "
+                f"{fields['layers']} MoE layer(s) = {want} — a token "
+                f"left the routing ledger")
+    return problems
+
+
 def check_lockdep(events):
     """The lockdep rule (ISSUE 19): a ``lockdep_violation`` record in
     the stream IS a finding — the sanitizer only emits after it proved
@@ -661,7 +698,10 @@ def main(argv=None):
                          "for its prefix), and the lockdep rule (any "
                          "lockdep_violation record — a proved lock-"
                          "order inversion, blocking-under-lock, or "
-                         "long hold — fails the gate); exit 1 on "
+                         "long hold — fails the gate), and the MoE "
+                         "routing-attribution rule (per serve_step, "
+                         "routed + dropped == tokens x top_k x MoE "
+                         "layers; dense steps exempt); exit 1 on "
                          "violations")
     args = ap.parse_args(argv)
 
@@ -699,6 +739,8 @@ def main(argv=None):
         problems.extend(tier)
         lockdep = check_lockdep(events)
         problems.extend(lockdep)
+        moe = check_moe_attribution(events)
+        problems.extend(moe)
         for p in problems:
             print(p)
         print(json.dumps({"records": len(events), "bad_lines": bad,
@@ -711,7 +753,8 @@ def main(argv=None):
                           "version_violations": len(version),
                           "scale_balance_violations": len(scale),
                           "tier_balance_violations": len(tier),
-                          "lockdep_violations": len(lockdep)}))
+                          "lockdep_violations": len(lockdep),
+                          "moe_attribution_violations": len(moe)}))
         return 1 if problems or bad else 0
 
     if args.export:

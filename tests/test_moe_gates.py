@@ -1,0 +1,205 @@
+"""Gate-math unit tests for layers/moe.py (reference TopGate.py).
+
+The serving path (models/moe_decode.py) re-derives the same capacity
+formula and combine semantics in pure jax; these tests pin the graph-op
+originals so the two can never drift silently:
+
+- ``topkgating``: static capacity ``k * ceil(N/E * cf)``, top-k index
+  agreement with a numpy oracle, within-expert locations forming exactly
+  ``0..count_e-1``, and per-rank gate values equal to the softmax prob of
+  the chosen expert.
+- ``balance_loss``: analytic toy values (uniform gates -> 1.0 exactly).
+- ``HashGate``: fully deterministic ``token_id mod E`` routing.
+- ``KTop1Gate``: same weights + same input -> identical routing, and the
+  chosen expert always lives in the top-mass group.
+"""
+
+import math
+
+import numpy as np
+
+import hetu_tpu as ht
+from hetu_tpu.layers.moe import balance_loss, topkgating
+
+
+def _ints(a):
+    return np.asarray(a).reshape(-1).astype(np.int64)
+
+
+class TestTopKGating:
+    N, E, K, CF = 16, 4, 2, 1.5
+
+    def _run(self, seed=0):
+        rng = np.random.RandomState(seed)
+        logits_np = rng.randn(self.N, self.E).astype(np.float32)
+        x = ht.placeholder_op("logits")
+        l_aux, idx_s, loc_s, gate_s, cap = topkgating(
+            x, self.K, self.CF, self.N, self.E, embed_dim=8)
+        ex = ht.Executor({"eval": [l_aux] + idx_s + loc_s + gate_s})
+        out = ex.run("eval", feed_dict={x: logits_np})
+        k = self.K
+        return (logits_np, float(np.asarray(out[0])),
+                [_ints(o) for o in out[1:1 + k]],
+                [_ints(o) for o in out[1 + k:1 + 2 * k]],
+                [np.asarray(o).reshape(-1) for o in out[1 + 2 * k:]],
+                cap)
+
+    def test_capacity_formula(self):
+        _, _, _, _, _, cap = self._run()
+        assert cap == self.K * math.ceil((self.N / self.E) * self.CF)
+        assert cap == 12
+
+    def test_indices_match_numpy_topk(self):
+        logits, _, idx_s, _, _, _ = self._run()
+        gates = np.exp(logits - logits.max(1, keepdims=True))
+        gates /= gates.sum(1, keepdims=True)
+        order = np.argsort(-gates, axis=1)
+        for rank in range(self.K):
+            np.testing.assert_array_equal(idx_s[rank], order[:, rank])
+        # ranks pick distinct experts per token
+        assert np.all(idx_s[0] != idx_s[1])
+
+    def test_locations_enumerate_expert_slots(self):
+        _, _, idx_s, loc_s, _, _ = self._run()
+        for e in range(self.E):
+            slots = []
+            for rank in range(self.K):
+                slots.extend(loc_s[rank][idx_s[rank] == e].tolist())
+            # every token bound for expert e got a unique slot 0..count-1
+            assert sorted(slots) == list(range(len(slots)))
+
+    def test_rank0_slots_precede_rank1(self):
+        # acc_base offsets rank-1 locations past ALL rank-0 assignments
+        _, _, idx_s, loc_s, _, _ = self._run()
+        for e in range(self.E):
+            r0 = loc_s[0][idx_s[0] == e]
+            r1 = loc_s[1][idx_s[1] == e]
+            if len(r0) and len(r1):
+                assert r0.max() < r1.min()
+
+    def test_gate_values_are_softmax_probs(self):
+        logits, _, idx_s, _, gate_s, _ = self._run()
+        gates = np.exp(logits - logits.max(1, keepdims=True))
+        gates /= gates.sum(1, keepdims=True)
+        for rank in range(self.K):
+            want = gates[np.arange(self.N), idx_s[rank]]
+            np.testing.assert_allclose(gate_s[rank], want, atol=1e-5)
+
+    def test_l_aux_matches_analytic(self):
+        logits, l_aux, idx_s, _, _, _ = self._run()
+        gates = np.exp(logits - logits.max(1, keepdims=True))
+        gates /= gates.sum(1, keepdims=True)
+        me = gates.mean(0)
+        want = 0.0
+        for rank in range(self.K):
+            ce = np.eye(self.E)[idx_s[rank]].mean(0)
+            want += self.E * float((me * ce).sum())
+        np.testing.assert_allclose(l_aux, want, atol=1e-5)
+
+
+class TestBalanceLoss:
+    def _eval(self, gates_np, mask_np, E):
+        g = ht.placeholder_op("g")
+        m = ht.placeholder_op("m")
+        ex = ht.Executor({"eval": [balance_loss(g, m, E)]})
+        return float(np.asarray(
+            ex.run("eval", feed_dict={g: gates_np, m: mask_np})[0]))
+
+    def test_uniform_gates_give_exactly_one(self):
+        # me_e = 1/E for all e, so loss = E * sum_e (1/E) * f_e = sum f_e = 1
+        N, E = 12, 4
+        gates = np.full((N, E), 1.0 / E, np.float32)
+        mask = np.eye(E, dtype=np.float32)[np.arange(N) % E]
+        np.testing.assert_allclose(self._eval(gates, mask, E), 1.0, atol=1e-6)
+
+    def test_skewed_toy_value(self):
+        # 2 tokens, 2 experts, both routed to expert 0:
+        # me = [0.6, 0.4], ce = [1, 0], loss = 2 * 0.6 = 1.2
+        gates = np.array([[0.7, 0.3], [0.5, 0.5]], np.float32)
+        mask = np.array([[1.0, 0.0], [1.0, 0.0]], np.float32)
+        np.testing.assert_allclose(self._eval(gates, mask, 2), 1.2, atol=1e-6)
+
+    def test_matches_numpy_on_random(self):
+        rng = np.random.RandomState(3)
+        N, E = 24, 8
+        gates = rng.rand(N, E).astype(np.float32)
+        gates /= gates.sum(1, keepdims=True)
+        mask = np.eye(E, dtype=np.float32)[rng.randint(0, E, N)]
+        want = E * float((gates.mean(0) * mask.mean(0)).sum())
+        np.testing.assert_allclose(self._eval(gates, mask, E), want,
+                                   atol=1e-5)
+
+
+class TestHashGate:
+    def test_round_robin_and_capacity(self):
+        N, E, CF = 16, 4, 1.5
+        gate = ht.layers.HashGate(8, N, E, capacity_factor=CF)
+        x = ht.placeholder_op("x")
+        l_aux, idx_s, loc_s, gate_s, cap = gate(x)
+        assert l_aux is None
+        assert cap == math.ceil((N / E) * CF)
+        ex = ht.Executor({"eval": [idx_s[0], loc_s[0], gate_s[0]]})
+        idx, loc, g = ex.run("eval", feed_dict={
+            x: np.zeros((N, 8), np.float32)})
+        np.testing.assert_array_equal(_ints(idx), np.arange(N) % E)
+        # round-robin => token t is the (t // E)-th arrival at its expert
+        np.testing.assert_array_equal(_ints(loc), np.arange(N) // E)
+        np.testing.assert_allclose(np.asarray(g).reshape(-1), 1.0)
+
+    def test_input_independent(self):
+        N, E = 8, 4
+        gate = ht.layers.HashGate(4, N, E)
+        x = ht.placeholder_op("x")
+        _, idx_s, _, _, _ = gate(x)
+        ex = ht.Executor({"eval": [idx_s[0]]})
+        rng = np.random.RandomState(0)
+        a = _ints(ex.run("eval", feed_dict={
+            x: rng.randn(N, 4).astype(np.float32)})[0])
+        b = _ints(ex.run("eval", feed_dict={
+            x: rng.randn(N, 4).astype(np.float32)})[0])
+        np.testing.assert_array_equal(a, b)
+
+
+class TestKTop1Gate:
+    N, E, D, GPUS = 16, 8, 8, 4  # group_size = E / GPUS = 2
+
+    def _build(self):
+        gate = ht.layers.KTop1Gate(self.D, self.N, self.E,
+                                   num_local_gpus=self.GPUS)
+        x = ht.placeholder_op("x")
+        l_aux, idx_s, loc_s, gate_s, cap = gate(x)
+        ex = ht.Executor({"eval": [idx_s[0], gate_s[0], l_aux]})
+        return x, ex, cap
+
+    def test_deterministic_across_runs_and_executors(self):
+        rng = np.random.RandomState(7)
+        xb = rng.randn(self.N, self.D).astype(np.float32)
+        x, ex, cap = self._build()
+        assert cap == math.ceil(self.N / self.E)
+        a = _ints(ex.run("eval", feed_dict={x: xb})[0])
+        b = _ints(ex.run("eval", feed_dict={x: xb})[0])
+        np.testing.assert_array_equal(a, b)
+        # a fresh executor loaded with the same weights routes identically
+        x2, ex2, _ = self._build()
+        ex2.load_dict(ex.return_tensor_values())
+        c = _ints(ex2.run("eval", feed_dict={x2: xb})[0])
+        np.testing.assert_array_equal(a, c)
+
+    def test_expert_lives_in_top_mass_group(self):
+        rng = np.random.RandomState(11)
+        xb = rng.randn(self.N, self.D).astype(np.float32)
+        x, ex, _ = self._build()
+        idx = _ints(ex.run("eval", feed_dict={x: xb})[0])
+        w = None
+        for name, v in ex.return_tensor_values().items():
+            if name.endswith("_linear_weight"):
+                w = np.asarray(v)
+        assert w is not None
+        logits = xb @ w
+        gates = np.exp(logits - logits.max(1, keepdims=True))
+        gates /= gates.sum(1, keepdims=True)
+        group_size = self.E // self.GPUS
+        mass = gates.reshape(self.N, self.GPUS, group_size).sum(2)
+        want_group = mass.argmax(1)
+        np.testing.assert_array_equal(idx // group_size, want_group)
+        assert np.all((idx >= 0) & (idx < self.E))

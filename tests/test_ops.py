@@ -147,8 +147,11 @@ def test_gather_onehot_argmax():
 
 
 def test_cumsum_topk():
+    # reference CumSum.py: cumsum(x) + bias — the bias is applied ONCE
+    # after the inclusive cumsum (with bias=-1 over a one-hot routing
+    # mask this is each token's 0-based arrival slot at its expert)
     HetuTester(ht.cumsum_with_bias_op, 1, -1.0, 0).test(
-        [(5, 4)], lambda a: np.cumsum(a - 1, 0), rtol=1e-5)
+        [(5, 4)], lambda a: np.cumsum(a, 0) - 1.0, rtol=1e-5)
     x = np.random.RandomState(0).randn(6, 8).astype(np.float32)
     t = HetuTester(ht.topk_val_op, 1, 3)
     feeds, out, ex = t.build(None)

@@ -475,6 +475,27 @@ def test_bench_serve_continuous_beats_static(tmp_path, monkeypatch):
     for arm in ("phase_split", "ragged"):
         assert ra[arm]["tokens_per_sec"] > 0
         assert ra[arm]["ttft_p99_s"] is not None
+    # MoE vs dense at equal active params (ISSUE 20): greedy identity
+    # vs offline at un-binding capacity (drop rate exactly zero), the
+    # binding probe drops while load+drop still accounts for every
+    # (token, rank), and expert telemetry rides the artifact (floors
+    # also asserted in-bench; stage 4c banks moe_ab on chip)
+    ma = art["moe_ab"]
+    assert ma["provenance"] == "live" and ma["platform"] == "cpu"
+    assert ma["greedy_identical"] is True
+    assert ma["moe"]["drop_rate"] == 0.0
+    assert ma["moe"]["expert_imbalance"] >= 1.0
+    assert len(ma["moe"]["expert_load"]) == \
+        ma["equal_active_params"]["experts"]
+    assert sum(ma["moe"]["expert_load"]) > 0
+    assert ma["equal_active_params"]["active_ffn_per_token"] == \
+        ma["equal_active_params"]["dense_ffn_size"]
+    assert ma["capacity_binding"]["drop_rate"] > 0
+    assert ma["capacity_binding"]["invariant_ok"] is True
+    for arm in ("dense", "moe"):
+        assert ma[arm]["tokens_per_sec"] > 0
+        assert ma[arm]["ttft_p99_s"] is not None
+    assert ma["speedup_vs_dense"] > 0
     with open(tmp_path / "BENCH_SERVE.json") as f:
         on_disk = json.load(f)
     assert on_disk["continuous"]["tokens_per_sec"] == cont
